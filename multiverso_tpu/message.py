@@ -1,0 +1,90 @@
+"""Control-plane messages.
+
+Behavioral equivalent of reference include/multiverso/message.h: a message
+carries (src, dst, type, table_id, msg_id) plus payload. The reference packs
+these into an 8-int header + Blob list for the MPI/ZMQ wire
+(message.h:26-66); in the TPU build the data plane is jax arrays in HBM, so
+messages are in-process records routed between actors. The ``MsgType``
+numeric values are preserved (message.h:13-24) — including the sign/range
+routing convention (positive 1..31 = to server, negative = replies to
+worker, >32 = controller; reference communicator.cpp:15-27) — so the native
+C++ runtime and any future cross-host wire stay compatible.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from multiverso_tpu.utils.waiter import Waiter
+
+
+class MsgType(enum.IntEnum):
+    """Numeric values mirror reference message.h:13-24."""
+
+    Request_Get = 1
+    Request_Add = 2
+    Request_Barrier = 33
+    Request_Register = 34
+    Reply_Get = -1
+    Reply_Add = -2
+    Reply_Barrier = -33
+    Reply_Register = -34
+    Server_Finish_Train = 4
+    Control_Reply_Finish_Train = -36
+    Default = 0
+
+
+def to_server(t: MsgType) -> bool:
+    return 0 < int(t) < 32
+
+
+def to_worker(t: MsgType) -> bool:
+    return -32 < int(t) < 0
+
+
+def to_controller(t: MsgType) -> bool:
+    return int(t) > 32
+
+
+_msg_id_counter = itertools.count(1)
+_msg_id_lock = threading.Lock()
+
+
+def next_msg_id() -> int:
+    with _msg_id_lock:
+        return next(_msg_id_counter)
+
+
+@dataclass
+class Message:
+    msg_type: MsgType = MsgType.Default
+    table_id: int = -1
+    msg_id: int = 0
+    src: int = 0          # worker_id of the requester (in-process world)
+    dst: int = 0
+    payload: Dict[str, Any] = field(default_factory=dict)
+    # In-process reply channel: the server engine fulfils the request by
+    # storing the result and notifying the waiter — the collapsed version of
+    # reply-Message -> Communicator -> Worker::ProcessReplyGet
+    # (reference worker.cpp:81-91).
+    waiter: Optional[Waiter] = None
+    result: Any = None
+    on_reply: Optional[Callable[["Message"], None]] = None
+    _replied: bool = False
+
+    def reply(self, result: Any = None) -> None:
+        """First reply wins; later replies (e.g. an engine-level error after
+        a successful table reply) are dropped so a request's outcome can't be
+        rewritten or its waiter over-notified."""
+        if self._replied:
+            return
+        self._replied = True
+        self.result = result
+        if self.on_reply is not None:
+            self.on_reply(self)
+        if self.waiter is not None:
+            self.waiter.Notify()

@@ -1,0 +1,21 @@
+"""Mesh + transport layer (reference L2 replacement).
+
+Where the reference moves bytes with MPI/ZMQ point-to-point messages
+(reference src/net*, include/multiverso/net/), the TPU build places table
+shards on a ``jax.sharding.Mesh`` and lets XLA turn sharding mismatches into
+ICI/DCN collectives. The hand-rolled Bruck / recursive-halving allreduce
+engine (reference src/net/allreduce_engine.cpp) is replaced by ``psum`` —
+XLA picks the wire algorithm per size/topology, which is exactly the
+size-adaptive choice AllreduceEngine made by hand
+(reference allreduce_engine.cpp:31-55).
+"""
+
+from multiverso_tpu.parallel.mesh import (  # noqa: F401
+    MeshContext,
+    build_mesh,
+    partition_offsets,
+)
+from multiverso_tpu.parallel.allreduce import (  # noqa: F401
+    RendezvousAllreduce,
+    device_allreduce,
+)

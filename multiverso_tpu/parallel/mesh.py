@@ -1,0 +1,112 @@
+"""Device mesh construction and shard placement.
+
+The TPU-native replacement for the reference's node/rank fabric: instead of
+N MPI processes each hosting a parameter shard in its heap
+(reference src/zoo.cpp, src/net/mpi_net.h), a ``jax.sharding.Mesh`` with a
+``server`` axis hosts every table shard in HBM. ``num_servers`` is the mesh
+size along that axis; worker identity is a host-side concept (threads in one
+process, processes across hosts via ``jax.distributed``).
+
+``partition_offsets`` preserves the reference's contiguous-shard math —
+each server takes ``size // num_servers`` elements and the last takes the
+remainder (reference src/table/array_table.cpp:10-19, 101-105) — used by
+host-side partition logic and by parity unit tests
+(reference Test/unittests/test_array.cpp:47-66 tests Partition as a pure
+function).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SERVER_AXIS = "server"
+
+
+def partition_offsets(size: int, num_servers: int) -> List[Tuple[int, int]]:
+    """[(offset, count)] per server; last server takes the remainder.
+
+    Mirrors reference array_table.cpp:101-105 (server_offsets_ construction).
+    """
+    if num_servers <= 0:
+        raise ValueError("num_servers must be positive")
+    base = size // num_servers
+    out = []
+    for s in range(num_servers):
+        offset = base * s
+        count = base if s < num_servers - 1 else size - base * (num_servers - 1)
+        out.append((offset, count))
+    return out
+
+
+def row_partition_server(row: int, num_rows: int, num_servers: int) -> int:
+    """Which server owns a row: ``row / (num_row / num_server)`` with the
+    tail clamped to the last server (reference matrix_table.cpp:24-46)."""
+    base = num_rows // num_servers
+    if base == 0:
+        return 0
+    return min(row // base, num_servers - 1)
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def next_bucket(n: int, min_bucket: int = 8) -> int:
+    """Smallest power-of-two >= n (and >= min_bucket). The table layer pads
+    dynamic id batches to these buckets so XLA compiles a handful of shapes
+    instead of one per batch size."""
+    b = min_bucket
+    while b < n:
+        b <<= 1
+    return b
+
+
+def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               axis_name: str = SERVER_AXIS) -> Mesh:
+    """1-D mesh over all (or given) devices along the server axis."""
+    if devices is None:
+        devices = jax.devices()
+    dev_array = np.asarray(devices)
+    return Mesh(dev_array, (axis_name,))
+
+
+@dataclass
+class MeshContext:
+    """Owns the mesh and canonical shardings for the table layer."""
+
+    mesh: Mesh
+
+    @classmethod
+    def create(cls, devices: Optional[Sequence[jax.Device]] = None) -> "MeshContext":
+        return cls(mesh=build_mesh(devices))
+
+    @property
+    def num_servers(self) -> int:
+        return self.mesh.shape[SERVER_AXIS]
+
+    def sharding_1d(self) -> NamedSharding:
+        """Contiguous range shards of a 1-D array (ArrayTable layout)."""
+        return NamedSharding(self.mesh, P(SERVER_AXIS))
+
+    def sharding_rows(self) -> NamedSharding:
+        """Row shards of a 2-D array (MatrixTable layout)."""
+        return NamedSharding(self.mesh, P(SERVER_AXIS, None))
+
+    def sharding_worker_rows(self) -> NamedSharding:
+        """(num_workers, rows, ...) state sharded on the row axis — used for
+        per-worker server state such as AdaGrad accumulators
+        (reference adagrad_updater.h:19,26) and SparseMatrixTable dirty bits
+        (reference sparse_matrix_table.h:67-69)."""
+        return NamedSharding(self.mesh, P(None, SERVER_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def place(self, array, sharding: NamedSharding):
+        """Host -> HBM placement with an explicit layout."""
+        return jax.device_put(array, sharding)

@@ -1,0 +1,3 @@
+"""Consistency modes (reference L4 server actors)."""
+
+from multiverso_tpu.sync.server import Server, SyncServer, VectorClock  # noqa: F401

@@ -1,0 +1,203 @@
+"""Server engines: async (default) and BSP sync.
+
+Behavioral equivalent of reference src/server.cpp:
+
+* ``Server`` — async ASGD mode: applies every Get/Add as it arrives and
+  always replies (server.cpp:23-58). Workers never wait for each other;
+  the shard application itself is a jit'd XLA op dispatched asynchronously,
+  so the actor thread stays ahead of the device.
+
+* ``SyncServer`` — BSP mode (``-sync=true``): the exact vector-clock
+  protocol of server.cpp:60-222, re-implemented: Adds from workers whose Get
+  clock ran ahead of the global Get round are cached; Gets from workers with
+  outstanding/uncounted Adds are cached; completing an Add round drains
+  cached Gets and vice versa; ``Server_Finish_Train`` forces a worker's
+  clocks to infinity and drains (server.cpp:188-211). Guarantee preserved
+  (comment at server.cpp:60-67): all workers' i-th Get returns identical
+  parameters, assuming all workers issue the same number of Gets/Adds.
+
+Selection by the ``sync`` flag mirrors ``Server::GetServer``
+(server.cpp:224-232).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Dict, List
+
+from multiverso_tpu.actor import Actor, actor_names
+from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_bool, MV_DEFINE_int
+from multiverso_tpu.utils.dashboard import monitor_region
+from multiverso_tpu.utils.log import CHECK, Log
+
+MV_DEFINE_bool("sync", False, "sync or async")
+# Declared-but-dead in the reference (server.cpp:21); kept for flag parity.
+MV_DEFINE_int("backup_worker_ratio", 0, "ratio% of backup workers (dead flag, parity)")
+
+_INF = float("inf")
+
+
+class VectorClock:
+    """Per-worker progress clock (reference server.cpp:81-137).
+
+    ``Update(i)`` ticks worker i; returns True when the tick completes a
+    round (global clock catches up to the max local clock).
+    """
+
+    def __init__(self, n: int):
+        self._local: List[float] = [0] * n
+        self._global = 0
+
+    def Update(self, i: int) -> bool:
+        self._local[i] += 1
+        if self._global < min(self._local):
+            self._global += 1
+            if self._global == self._max_element():
+                return True
+        return False
+
+    def FinishTrain(self, i: int) -> bool:
+        self._local[i] = _INF
+        m = min(self._local)
+        if self._global < m:
+            self._global = m
+            if self._global == self._max_element():
+                return True
+        return False
+
+    def _max_element(self) -> float:
+        finite = [v for v in self._local if v != _INF]
+        return max([self._global] + finite)
+
+    def local_clock(self, i: int) -> float:
+        return self._local[i]
+
+    def global_clock(self) -> float:
+        return self._global
+
+    def DebugString(self) -> str:
+        local = " ".join("-1" if v == _INF else str(int(v)) for v in self._local)
+        return f"global {self._global} local: {local}"
+
+
+class Server(Actor):
+    """Async server engine (reference server.cpp:23-58)."""
+
+    def __init__(self):
+        super().__init__(actor_names.kServer)
+        self.store_: List = []  # ServerTable list (reference server.h:24)
+        self.RegisterHandler(MsgType.Request_Get, self.ProcessGet)
+        self.RegisterHandler(MsgType.Request_Add, self.ProcessAdd)
+        self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
+
+    def RegisterTable(self, server_table) -> int:
+        table_id = len(self.store_)
+        self.store_.append(server_table)
+        return table_id
+
+    def ProcessGet(self, msg: Message) -> None:
+        with monitor_region("SERVER_PROCESS_GET"):
+            table = self.store_[msg.table_id]
+            try:
+                result = table.ProcessGet(**msg.payload)
+            except Exception as exc:
+                # Deliver the failure to THIS request — critical when this
+                # message is a drained cached message processed inside
+                # another worker's request (SyncServer drain loops): the
+                # actor-level fallback would mis-attribute the error to the
+                # outer message and leave this one's waiter hung.
+                Log.Error("table %d ProcessGet failed: %r", msg.table_id, exc)
+                msg.reply(exc)
+                return
+            msg.reply(result)
+
+    def ProcessAdd(self, msg: Message) -> None:
+        with monitor_region("SERVER_PROCESS_ADD"):
+            table = self.store_[msg.table_id]
+            try:
+                table.ProcessAdd(**msg.payload)
+            except Exception as exc:
+                Log.Error("table %d ProcessAdd failed: %r", msg.table_id, exc)
+                msg.reply(exc)
+                return
+            msg.reply(None)
+
+    def ProcessFinishTrain(self, msg: Message) -> None:
+        msg.reply(None)
+
+    @staticmethod
+    def GetServer(num_workers: int) -> "Server":
+        """Factory mirroring reference server.cpp:224-232."""
+        if not GetFlag("sync"):
+            Log.Debug("Create an async server")
+            return Server()
+        Log.Debug("Create a sync server")
+        return SyncServer(num_workers)
+
+
+class SyncServer(Server):
+    """BSP server (reference server.cpp:60-222). See module docstring."""
+
+    def __init__(self, num_workers: int):
+        super().__init__()
+        self._num_workers = num_workers
+        self._get_clocks = VectorClock(num_workers)
+        self._add_clocks = VectorClock(num_workers)
+        self._num_waited_add = [0] * num_workers
+        self._add_cache: Deque[Message] = collections.deque()
+        self._get_cache: Deque[Message] = collections.deque()
+
+    def ProcessAdd(self, msg: Message) -> None:
+        worker = msg.src
+        # 1. Before add: cache faster worker (server.cpp:141-147)
+        if self._get_clocks.local_clock(worker) > self._get_clocks.global_clock():
+            self._add_cache.append(msg)
+            self._num_waited_add[worker] += 1
+            return
+        # 2. Process add
+        super().ProcessAdd(msg)
+        # 3. After add: drain cached gets when the add round completes
+        if self._add_clocks.Update(worker):
+            CHECK(not self._add_cache, "add cache must be empty at round end")
+            while self._get_cache:
+                get_msg = self._get_cache.popleft()
+                super().ProcessGet(get_msg)
+                CHECK(not self._get_clocks.Update(get_msg.src),
+                      "drained Get must not complete a round")
+
+    def ProcessGet(self, msg: Message) -> None:
+        worker = msg.src
+        # 1. Before get: wait for other workers' adds (server.cpp:164-171)
+        if (self._add_clocks.local_clock(worker) > self._add_clocks.global_clock()
+                or self._num_waited_add[worker] > 0):
+            self._get_cache.append(msg)
+            return
+        # 2. Process get
+        super().ProcessGet(msg)
+        # 3. After get: drain cached adds when the get round completes
+        if self._get_clocks.Update(worker):
+            while self._add_cache:
+                add_msg = self._add_cache.popleft()
+                super().ProcessAdd(add_msg)
+                CHECK(not self._add_clocks.Update(add_msg.src),
+                      "drained Add must not complete a round")
+                self._num_waited_add[add_msg.src] -= 1
+
+    def ProcessFinishTrain(self, msg: Message) -> None:
+        """server.cpp:188-211: force worker clocks to infinity, drain caches."""
+        worker = msg.src
+        if self._add_clocks.FinishTrain(worker):
+            CHECK(not self._add_cache, "add cache must be empty")
+            while self._get_cache:
+                get_msg = self._get_cache.popleft()
+                super().ProcessGet(get_msg)
+                CHECK(not self._get_clocks.Update(get_msg.src), "")
+        if self._get_clocks.FinishTrain(worker):
+            CHECK(not self._get_cache, "get cache must be empty")
+            while self._add_cache:
+                add_msg = self._add_cache.popleft()
+                super().ProcessAdd(add_msg)
+                CHECK(not self._add_clocks.Update(add_msg.src), "")
+                self._num_waited_add[add_msg.src] -= 1
+        msg.reply(None)

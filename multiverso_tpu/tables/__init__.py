@@ -1,0 +1,20 @@
+"""Table layer (reference L5): sharded typed parameter stores."""
+
+from multiverso_tpu.tables.base import (  # noqa: F401
+    TableOption,
+    WorkerTable,
+    ServerTable,
+    CreateTable,
+)
+from multiverso_tpu.tables.array_table import ArrayTableOption, ArrayWorker, ArrayServer  # noqa: F401
+from multiverso_tpu.tables.matrix_table import (  # noqa: F401
+    MatrixTableOption,
+    MatrixWorkerTable,
+    MatrixServerTable,
+)
+from multiverso_tpu.tables.sparse_matrix_table import (  # noqa: F401
+    SparseMatrixTableOption,
+    SparseMatrixWorkerTable,
+    SparseMatrixServerTable,
+)
+from multiverso_tpu.tables.kv_table import KVTableOption, KVWorkerTable, KVServerTable  # noqa: F401

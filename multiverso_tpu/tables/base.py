@@ -1,0 +1,144 @@
+"""Table interfaces: worker side (async handles) and server side (sharded
+HBM store + jit'd updater application).
+
+Behavioral equivalent of reference include/multiverso/table_interface.h and
+src/table.cpp:
+
+* ``WorkerTable`` — allocates per-request msg ids, keeps a Waiter per
+  in-flight request, offers sync ``Get/Add`` = ``Wait(GetAsync/AddAsync)``
+  (table.cpp:25-39), and ``Wait/Notify/Reset`` bookkeeping
+  (table.cpp:84-110).
+
+* ``ServerTable`` — ``ProcessAdd``/``ProcessGet`` virtuals plus the
+  ``Serializable`` Store/Load checkpoint contract (table_interface.h:61-79).
+
+TPU design: requests are routed to the single server engine actor which
+serializes application onto the mesh-sharded store (see sync/server.py).
+The async handle's value: ``AddAsync`` returns after *enqueueing* — the
+jit'd shard update is dispatched by the server thread and XLA executes it
+asynchronously, so worker threads overlap data prep with device work, which
+is the reference's pipeline idiom (ps_model.cpp:228-259) for free.
+
+``CreateTable`` mirrors table_factory (reference table_factory.h:16-27):
+builds the server half, registers it with the engine, builds the worker
+half bound to the same table id.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from multiverso_tpu.message import Message, MsgType, next_msg_id
+from multiverso_tpu.updaters.base import AddOption, GetOption
+from multiverso_tpu.utils.dashboard import monitor_region
+from multiverso_tpu.utils.log import CHECK
+from multiverso_tpu.utils.waiter import Waiter
+
+
+@dataclass
+class TableOption:
+    """Base table creation record (reference CreateTableOption structs)."""
+
+    dtype: Any = np.float32
+
+
+class ServerTable:
+    """Server half: owns the sharded device store (table_interface.h:61-79)."""
+
+    def ProcessAdd(self, **payload) -> None:
+        raise NotImplementedError
+
+    def ProcessGet(self, **payload) -> Any:
+        raise NotImplementedError
+
+    # Serializable (checkpoint) contract
+    def Store(self, stream) -> None:
+        raise NotImplementedError
+
+    def Load(self, stream) -> None:
+        raise NotImplementedError
+
+
+class WorkerTable:
+    """Worker half: request construction + waiter bookkeeping."""
+
+    def __init__(self):
+        from multiverso_tpu.zoo import Zoo
+        self._zoo = Zoo.Get()
+        self.table_id: int = -1
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, Waiter] = {}
+        self._results: Dict[int, Any] = {}
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _submit(self, msg_type: MsgType, payload: Dict[str, Any],
+                worker_id: Optional[int] = None) -> int:
+        """Build + enqueue a request message; returns msg_id
+        (reference table.cpp:41-82 GetAsync/AddAsync)."""
+        msg_id = next_msg_id()
+        waiter = Waiter(1)
+        with self._lock:
+            self._waiters[msg_id] = waiter
+        src = self._zoo.current_worker_id() if worker_id is None else worker_id
+        msg = Message(msg_type=msg_type, table_id=self.table_id, msg_id=msg_id,
+                      src=src, payload=payload, waiter=waiter,
+                      on_reply=self._on_reply)
+        self._zoo.SendToServer(msg)
+        return msg_id
+
+    def _on_reply(self, msg: Message) -> None:
+        with self._lock:
+            self._results[msg.msg_id] = msg.result
+
+    def Wait(self, msg_id: int) -> Any:
+        """Block until the request's reply arrived; returns its result
+        (reference table.cpp:84-95)."""
+        with self._lock:
+            waiter = self._waiters.get(msg_id)
+        CHECK(waiter is not None, f"unknown msg_id {msg_id}")
+        waiter.Wait()
+        with self._lock:
+            self._waiters.pop(msg_id, None)
+            result = self._results.pop(msg_id, None)
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    # -- public verbs (concrete tables wrap these with typed signatures) ----
+
+    def GetAsync(self, payload: Dict[str, Any],
+                 option: Optional[GetOption] = None) -> int:
+        with monitor_region("WORKER_TABLE_SYNC_GET"):  # reference table.cpp:28-38
+            opt = option or GetOption(worker_id=self._zoo.current_worker_id())
+            payload = dict(payload)
+            payload["option"] = opt
+            return self._submit(MsgType.Request_Get, payload,
+                                worker_id=opt.worker_id)
+
+    def AddAsync(self, payload: Dict[str, Any],
+                 option: Optional[AddOption] = None) -> int:
+        with monitor_region("WORKER_TABLE_SYNC_ADD"):
+            opt = option or AddOption(worker_id=self._zoo.current_worker_id())
+            payload = dict(payload)
+            payload["option"] = opt
+            return self._submit(MsgType.Request_Add, payload,
+                                worker_id=opt.worker_id)
+
+
+def CreateTable(option: TableOption):
+    """Instantiate server + worker halves and wire them to the engine
+    (reference table_factory.h:16-27 + MV_CreateTable barrier semantics are
+    in api.MV_CreateTable)."""
+    from multiverso_tpu.zoo import Zoo
+    zoo = Zoo.Get()
+    server_table = option.make_server(zoo)
+    table_id = zoo.RegisterServerTable(server_table)
+    worker_table = option.make_worker(zoo)
+    worker_table.table_id = table_id
+    zoo.RegisterWorkerTable(worker_table)
+    return worker_table

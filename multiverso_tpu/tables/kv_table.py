@@ -1,0 +1,214 @@
+"""KVTable — distributed hash map of scalar values keyed by int64.
+
+Behavioral equivalent of reference include/multiverso/table/kv_table.h
+(header-only): keys hash to servers by ``key % num_servers``
+(kv_table.h:49), the server-side Add is plain ``+=`` (kv_table.h:82-112 —
+KV does NOT route through the updater stack), Get returns current values
+(missing keys read as 0), and the worker keeps a local cache exposed via
+``raw()`` (kv_table.h:40).
+
+TPU design: control plane / data plane split — the *slot index* (key ->
+dense slot) is a host dict (dynamic key sets are host logic; static shapes
+stay on device), the *values* are one growable jax array in HBM sharded over
+the mesh ``server`` axis. Add = host slot resolution + jit'd scatter-add
+(duplicate keys in a batch accumulate natively); Get = jit'd gather with
+power-of-two bucketed batch sizes. Capacity doubles amortized on growth.
+
+``Store/Load``: the reference aborts with "Not implemented yet"
+(kv_table.h:106-112); here checkpointing IS implemented (keys + values) —
+a documented capability improvement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.parallel.mesh import next_bucket, pad_to_multiple
+from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
+from multiverso_tpu.updaters.base import AddOption, GetOption
+from multiverso_tpu.utils.log import CHECK
+
+_MIN_BUCKET = 8
+
+
+@dataclass
+class KVTableOption(TableOption):
+    init_capacity: int = 1024
+    dtype: type = np.float32
+
+    def make_server(self, zoo):
+        return KVServerTable(self.dtype, zoo, self.init_capacity)
+
+    def make_worker(self, zoo):
+        return KVWorkerTable(self.dtype)
+
+
+class KVServerTable(ServerTable):
+    def __init__(self, dtype, zoo, init_capacity: int = 1024):
+        self.dtype = np.dtype(dtype)
+        self._zoo = zoo
+        ctx = zoo.mesh_ctx
+        self._sharding = ctx.sharding_1d()
+        self.capacity = pad_to_multiple(max(init_capacity, _MIN_BUCKET),
+                                        ctx.num_servers)
+        self._index: Dict[int, int] = {}
+        # 64-bit dtypes (e.g. the WordEmbedding int64 word-count table,
+        # reference communicator.cpp:17-33) stay host-resident: jax truncates
+        # them to 32 bits without global x64 mode, and scalar counters are
+        # control-plane data with no business on the device anyway.
+        self._host_backed = self.dtype.itemsize == 8
+        if self._host_backed:
+            self._values = np.zeros(self.capacity, self.dtype)
+
+            def _scatter_add(values, slots, deltas):
+                np.add.at(values, np.asarray(slots), np.asarray(deltas))
+                return values
+
+            def _gather(values, slots):
+                return values[np.asarray(slots)]
+
+            self._scatter_add = _scatter_add
+            self._gather = _gather
+            return
+        self._values = ctx.place(jnp.zeros((self.capacity,), self.dtype),
+                                 self._sharding)
+
+        def _scatter_add(values, slots, deltas):
+            return values.at[slots].add(deltas)
+
+        self._scatter_add = jax.jit(_scatter_add, donate_argnums=(0,))
+
+        def _gather(values, slots):
+            return values[slots]
+
+        self._gather = jax.jit(_gather)
+
+    # -- slot management ----------------------------------------------------
+
+    def _slots_for(self, keys: np.ndarray, create: bool) -> np.ndarray:
+        slots = np.empty(len(keys), np.int32)
+        for i, k in enumerate(keys):
+            k = int(k)
+            slot = self._index.get(k)
+            if slot is None:
+                if not create:
+                    slot = -1  # read of absent key -> trash slot semantics
+                else:
+                    slot = len(self._index)
+                    self._index[k] = slot
+            slots[i] = slot
+        if create and len(self._index) >= self.capacity:
+            self._grow(len(self._index))
+        return slots
+
+    def _grow(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap <= needed:
+            new_cap *= 2
+        ctx = self._zoo.mesh_ctx
+        new_cap = pad_to_multiple(new_cap, ctx.num_servers)
+        host = np.zeros(new_cap, self.dtype)
+        host[: self.capacity] = np.asarray(self._values)
+        self.capacity = new_cap
+        if self._host_backed:
+            self._values = host
+        else:
+            self._values = ctx.place(jnp.asarray(host), self._sharding)
+
+    def _pad_slots(self, slots: np.ndarray) -> np.ndarray:
+        b = next_bucket(len(slots))
+        # trash = last slot of a spare padding region: use capacity-1; it may
+        # hold a live key, so padding entries carry zero delta on Add and are
+        # sliced off on Get.
+        out = np.full(b, self.capacity - 1, np.int32)
+        out[: len(slots)] = np.where(slots < 0, self.capacity - 1, slots)
+        return out
+
+    # -- server verbs (reference kv_table.h:82-112) -------------------------
+
+    def ProcessAdd(self, keys: np.ndarray, values: np.ndarray,
+                   option: Optional[AddOption] = None) -> None:
+        keys = np.asarray(keys, np.int64).ravel()
+        deltas = np.asarray(values, self.dtype).ravel()
+        CHECK(keys.size == deltas.size, "kv add size mismatch")
+        slots = self._slots_for(keys, create=True)
+        padded = self._pad_slots(slots)
+        pad_deltas = np.zeros(len(padded), self.dtype)
+        pad_deltas[: len(slots)] = deltas
+        if self._host_backed:
+            self._values = self._scatter_add(self._values, padded, pad_deltas)
+        else:
+            self._values = self._scatter_add(self._values, jnp.asarray(padded),
+                                             jnp.asarray(pad_deltas))
+
+    def ProcessGet(self, keys: np.ndarray,
+                   option: Optional[GetOption] = None) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        slots = self._slots_for(keys, create=False)
+        padded = self._pad_slots(slots)
+        vals = np.asarray(self._gather(
+            self._values, padded if self._host_backed else jnp.asarray(padded)))
+        out = vals[: len(slots)].copy()
+        out[slots < 0] = 0  # absent keys read as default-constructed (0)
+        return out
+
+    @property
+    def size(self) -> int:
+        return len(self._index)
+
+    # -- checkpoint (improvement over reference kv_table.h:106-112) ---------
+
+    def Store(self, stream) -> None:
+        keys = np.fromiter(self._index.keys(), np.int64, len(self._index))
+        slots = np.fromiter(self._index.values(), np.int64, len(self._index))
+        vals = np.asarray(self._values)[slots] if len(self._index) else \
+            np.empty(0, self.dtype)
+        stream.WriteInt(len(keys))
+        stream.Write(keys.tobytes())
+        stream.Write(vals.tobytes())
+
+    def Load(self, stream) -> None:
+        n = stream.ReadInt()
+        keys = np.frombuffer(stream.Read(n * 8), np.int64)
+        vals = np.frombuffer(stream.Read(n * self.dtype.itemsize), self.dtype)
+        self._index = {int(k): i for i, k in enumerate(keys)}
+        ctx = self._zoo.mesh_ctx
+        if n >= self.capacity:
+            self.capacity = pad_to_multiple(max(n + 1, _MIN_BUCKET),
+                                            ctx.num_servers)
+        host = np.zeros(self.capacity, self.dtype)
+        host[:n] = vals
+        if self._host_backed:
+            self._values = host
+        else:
+            self._values = ctx.place(jnp.asarray(host), self._sharding)
+
+
+class KVWorkerTable(WorkerTable):
+    """Worker half with a local cache (reference kv_table.h:19-46)."""
+
+    def __init__(self, dtype=np.float32):
+        super().__init__()
+        self.dtype = np.dtype(dtype)
+        self._cache: Dict[int, float] = {}
+
+    def Get(self, keys, option: Optional[GetOption] = None) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        vals = self.Wait(self.GetAsync({"keys": keys}, option))
+        for k, v in zip(keys, vals):
+            self._cache[int(k)] = v
+        return vals
+
+    def Add(self, keys, values, option: Optional[AddOption] = None) -> None:
+        keys = np.asarray(keys, np.int64).ravel()
+        vals = np.asarray(values, self.dtype).ravel()
+        self.Wait(self.AddAsync({"keys": keys, "values": vals}, option))
+
+    def raw(self) -> Dict[int, float]:
+        """Local cache of last-fetched values (reference kv_table.h:40)."""
+        return self._cache

@@ -1,0 +1,113 @@
+"""SparseMatrixTable — MatrixTable + per-worker row freshness tracking.
+
+Behavioral equivalent of reference
+include/multiverso/table/sparse_matrix_table.h +
+src/table/sparse_matrix_table.cpp: the server keeps an ``up_to_date`` bit
+per (worker, row). An Add from worker w marks the touched rows stale for
+every *other* worker (UpdateAddState, sparse_matrix_table.cpp:200-223); a Get
+from worker w returns only the rows stale for w and re-marks them fresh,
+falling back to row 0 when nothing changed (UpdateGetState,
+sparse_matrix_table.cpp:226-259); ``worker_id == -1`` fetches everything.
+The wire-compression (SparseFilter) of the reference's Add/Get payloads
+(sparse_matrix_table.cpp:262-266) is host-side delta compression here
+(utils/quantization.py) applied by apps before AddRows.
+
+TPU design: the freshness bits are host-side control-plane state (a numpy
+bool matrix) — deciding *which* rows to ship is host logic; only the row
+data itself lives in HBM and moves via the jit'd gather/scatter of the
+parent class.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.tables.matrix_table import (MatrixServerTable,
+                                                MatrixTableOption,
+                                                MatrixWorkerTable)
+from multiverso_tpu.updaters.base import AddOption, GetOption
+from multiverso_tpu.utils.log import CHECK
+
+
+@dataclass
+class SparseMatrixTableOption(MatrixTableOption):
+    def make_server(self, zoo):
+        return SparseMatrixServerTable(self.num_rows, self.num_cols,
+                                       self.dtype, zoo, self.updater_type,
+                                       self.initializer)
+
+    def make_worker(self, zoo):
+        return SparseMatrixWorkerTable(self.num_rows, self.num_cols, self.dtype)
+
+
+class SparseMatrixServerTable(MatrixServerTable):
+    def __init__(self, num_rows, num_cols, dtype, zoo, updater_type=None,
+                 initializer=None):
+        super().__init__(num_rows, num_cols, dtype, zoo, updater_type,
+                         initializer)
+        # all-fresh at start (reference ctor sets true,
+        # sparse_matrix_table.cpp:184-196)
+        self.up_to_date = np.ones((zoo.num_workers, num_rows), dtype=bool)
+
+    def _update_add_state(self, worker_id: int,
+                          row_ids: Optional[np.ndarray]) -> None:
+        """reference UpdateAddState (sparse_matrix_table.cpp:200-223)."""
+        mask = np.ones(self.up_to_date.shape[0], dtype=bool)
+        if 0 <= worker_id < self.up_to_date.shape[0]:
+            mask[worker_id] = False
+        if row_ids is None:
+            self.up_to_date[mask, :] = False
+        else:
+            cols = np.asarray(row_ids, np.int64).ravel()
+            self.up_to_date[np.ix_(mask, cols)] = False
+
+    def _update_get_state(self, worker_id: int,
+                          row_ids: Optional[np.ndarray]) -> np.ndarray:
+        """reference UpdateGetState (sparse_matrix_table.cpp:226-259):
+        returns the row ids to ship and re-marks them fresh."""
+        if worker_id == -1:
+            return np.arange(self.num_rows, dtype=np.int32)
+        if row_ids is None:
+            stale = np.nonzero(~self.up_to_date[worker_id])[0]
+        else:
+            ids = np.asarray(row_ids, np.int64).ravel()
+            stale = ids[~self.up_to_date[worker_id, ids]]
+        if stale.size == 0:
+            # all fresh -> still ship row 0 (sparse_matrix_table.cpp:255-257)
+            return np.zeros(1, dtype=np.int32)
+        self.up_to_date[worker_id, stale] = True
+        return stale.astype(np.int32)
+
+    def ProcessAdd(self, values, option: AddOption, row_ids=None) -> None:
+        # apply (and validate) the data first; only then mark rows stale —
+        # a rejected add must not desynchronize the freshness bits
+        super().ProcessAdd(values, option, row_ids)
+        self._update_add_state(option.worker_id, row_ids)
+
+    def ProcessGet(self, option: GetOption,
+                   row_ids=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (row_ids, rows) — the server decides which rows move."""
+        worker_id = option.worker_id if option is not None else -1
+        out_ids = self._update_get_state(worker_id, row_ids)
+        rows = super().ProcessGet(GetOption(worker_id=worker_id),
+                                  row_ids=out_ids)
+        return out_ids, rows
+
+
+class SparseMatrixWorkerTable(MatrixWorkerTable):
+    """Worker half: Get returns (row_ids, rows) since the server picks the
+    rows (reference sparse ProcessReplyGet fills only returned rows)."""
+
+    def Get(self, option: Optional[GetOption] = None):
+        if option is None:
+            option = GetOption(worker_id=self._zoo.current_worker_id())
+        return self.Wait(self.GetAsync({"row_ids": None}, option))
+
+    def GetRows(self, row_ids, option: Optional[GetOption] = None):
+        if option is None:
+            option = GetOption(worker_id=self._zoo.current_worker_id())
+        ids = np.asarray(row_ids, np.int32)
+        return self.Wait(self.GetAsync({"row_ids": ids}, option))

@@ -1,0 +1,12 @@
+"""Server-side updaters (reference include/multiverso/updater/)."""
+
+from multiverso_tpu.updaters.base import (  # noqa: F401
+    AddOption,
+    GetOption,
+    Updater,
+    AddUpdater,
+    SGDUpdater,
+    MomentumUpdater,
+    AdaGradUpdater,
+    CreateUpdater,
+)

@@ -1,0 +1,166 @@
+"""Server-side updaters as jit-able pure functions on table shards.
+
+Behavioral equivalent of the reference updater stack
+(include/multiverso/updater/updater.h + sgd/momentum/adagrad headers,
+src/updater/updater.cpp): the server applies a pluggable update rule to its
+shard for every incoming Add, parameterized per-message by an ``AddOption``
+(worker_id, momentum, learning_rate, rho, lambda — updater.h:10-70).
+
+TPU design: each updater is a *pure elementwise transform*
+``update(data, aux, delta, opt) -> (data, aux)`` that the table layer jits
+over its sharded storage (donated, so HBM is updated in place). Option
+scalars are traced ``jnp`` values, not static args — changing lr per Add
+does NOT retrigger compilation (SURVEY.md §7 "option-carrying updates").
+Per-worker state (AdaGrad's historic g², reference adagrad_updater.h:19,26)
+is an aux leaf of shape ``(num_workers,) + data.shape`` sharded along the
+same server axis as the data.
+
+Updater selection is keyed by the ``updater_type`` flag exactly like the
+reference factory (src/updater/updater.cpp:46-57).
+
+Deviation note (intentional): the reference AdaGrad has two evident defects —
+``auto g_sqr_data_ = historic_g_sqr_.at(...)`` *copies* the history so it
+never persists (adagrad_updater.h:26), and the history is *decremented* by
+delta² so sqrt sees negative numbers (adagrad_updater.h:28-30). We implement
+the evident intent: ``hist += (delta/lr)²; data -= rho * (delta/lr) /
+sqrt(hist + e)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.utils.configure import MV_DEFINE_int, MV_DEFINE_string
+
+MV_DEFINE_string("updater_type", "default", "server updater rule")
+MV_DEFINE_int("omp_threads", 4, "kept for flag parity; XLA owns threading")
+
+
+@dataclass
+class AddOption:
+    """Per-Add parameters riding along with the delta
+    (reference updater.h:10-70; defaults match AddOption())."""
+
+    worker_id: int = 0
+    momentum: float = 0.0
+    learning_rate: float = 0.01
+    rho: float = 0.1
+    lambda_: float = 0.1
+
+    def as_jnp(self) -> Dict[str, jax.Array]:
+        """Traced scalars handed to the jit'd updater (no retrace on change)."""
+        return {
+            "worker_id": jnp.asarray(self.worker_id, jnp.int32),
+            "momentum": jnp.asarray(self.momentum, jnp.float32),
+            "learning_rate": jnp.asarray(self.learning_rate, jnp.float32),
+            "rho": jnp.asarray(self.rho, jnp.float32),
+            "lambda_": jnp.asarray(self.lambda_, jnp.float32),
+        }
+
+
+@dataclass
+class GetOption:
+    """Per-Get parameters (reference updater.h:72-110): the requesting
+    worker's id — needed by per-worker server state such as the
+    SparseMatrixTable dirty-row bits."""
+
+    worker_id: int = 0
+
+
+class Updater:
+    """Base = plain accumulation: ``data += delta``
+    (reference src/updater/updater.cpp:21-29; OpenMP there, XLA here)."""
+
+    name = "default"
+
+    def init_aux(self, shape, dtype, num_workers: int) -> Dict[str, Any]:
+        """Aux state pytree. Leaves shaped like data are shared state;
+        leaves shaped (num_workers,)+shape are per-worker state."""
+        return {}
+
+    def update(self, data: jax.Array, aux: Dict[str, Any], delta: jax.Array,
+               opt: Dict[str, jax.Array]):
+        return data + delta, aux
+
+    def access(self, data: jax.Array, aux: Dict[str, Any],
+               opt: Dict[str, jax.Array]) -> jax.Array:
+        """Get path — identity for every reference updater (memcpy,
+        updater.cpp:32)."""
+        return data
+
+
+class AddUpdater(Updater):
+    name = "default"
+
+
+class SGDUpdater(Updater):
+    """``data -= delta`` — the client sends lr-scaled gradients
+    (reference sgd_updater.h:15-19)."""
+
+    name = "sgd"
+
+    def update(self, data, aux, delta, opt):
+        return data - delta, aux
+
+
+class MomentumUpdater(Updater):
+    """Smoothed-gradient descent (reference momentum_updater.h:18-26):
+    ``smooth = m * smooth + (1-m) * delta; data -= smooth``.
+    One shared smooth buffer (not per worker) like the reference."""
+
+    name = "momentum"
+
+    def init_aux(self, shape, dtype, num_workers):
+        return {"smooth": jnp.zeros(shape, dtype)}
+
+    def update(self, data, aux, delta, opt):
+        m = opt["momentum"].astype(data.dtype)
+        smooth = m * aux["smooth"] + (1 - m) * delta
+        return data - smooth, {"smooth": smooth}
+
+
+class AdaGradUpdater(Updater):
+    """Per-worker AdaGrad (reference adagrad_updater.h:15-58, intent — see
+    module deviation note): the server keeps one historic-g² buffer per
+    worker; the per-Add worker_id selects which history to advance."""
+
+    name = "adagrad"
+    eps = 1e-6
+
+    def init_aux(self, shape, dtype, num_workers):
+        return {"hist": jnp.zeros((num_workers,) + tuple(shape), dtype)}
+
+    def update(self, data, aux, delta, opt):
+        wid = opt["worker_id"]
+        lr = opt["learning_rate"].astype(data.dtype)
+        rho = opt["rho"].astype(data.dtype)
+        grad = delta / lr
+        hist = aux["hist"]
+        h = hist[wid] + grad * grad
+        data = data - rho * grad / jnp.sqrt(h + self.eps)
+        hist = hist.at[wid].set(h)
+        return data, {"hist": hist}
+
+
+_REGISTRY = {
+    "default": AddUpdater,
+    "": AddUpdater,
+    "sgd": SGDUpdater,
+    "momentum": MomentumUpdater,
+    "adagrad": AdaGradUpdater,
+}
+
+
+def CreateUpdater(updater_type: str | None = None) -> Updater:
+    """Factory keyed by the ``updater_type`` flag
+    (reference src/updater/updater.cpp:46-57; unknown -> default)."""
+    if updater_type is None:
+        from multiverso_tpu.utils.configure import GetFlag
+        updater_type = GetFlag("updater_type")
+    cls = _REGISTRY.get(updater_type, AddUpdater)
+    return cls()

@@ -1,0 +1,19 @@
+"""Utility layer (reference L0): flags, logging, timing, profiling, queues,
+stream IO, compression filters. No dependencies on the rest of the package.
+"""
+
+from multiverso_tpu.utils.configure import (  # noqa: F401
+    MV_DEFINE_bool,
+    MV_DEFINE_double,
+    MV_DEFINE_int,
+    MV_DEFINE_string,
+    GetFlag,
+    SetCMDFlag,
+    ParseCMDFlags,
+)
+from multiverso_tpu.utils.log import Log, Logger, LogLevel, CHECK, CHECK_NOTNULL  # noqa: F401
+from multiverso_tpu.utils.timer import Timer  # noqa: F401
+from multiverso_tpu.utils.dashboard import Dashboard, Monitor, monitor_region  # noqa: F401
+from multiverso_tpu.utils.waiter import Waiter  # noqa: F401
+from multiverso_tpu.utils.mt_queue import MtQueue  # noqa: F401
+from multiverso_tpu.utils.async_buffer import ASyncBuffer  # noqa: F401

@@ -1,0 +1,48 @@
+"""Double-buffer prefetcher.
+
+Behavioral equivalent of reference include/multiverso/util/async_buffer.h:11-118
+(``ASyncBuffer``): two buffers; a background fill function writes the next
+buffer while the consumer reads the ready one. ``Get()`` swaps: waits for the
+in-flight fill, returns the filled buffer, and kicks off the next fill.
+
+On TPU the same idiom overlaps host work (data prep, table Get dispatch) with
+device compute — used by the LogisticRegression pipeline mode
+(reference ps_model.cpp:228-259) and the WordEmbedding param prefetch thread
+(reference distributed_wordembedding.cpp:203-215).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class ASyncBuffer(Generic[T]):
+    def __init__(self, buffer0: T, buffer1: T, fill: Callable[[T], None]):
+        """``fill(buffer)`` populates a buffer; runs on a worker thread."""
+        self._buffers: List[T] = [buffer0, buffer1]
+        self._fill = fill
+        self._pending: threading.Thread | None = None
+        self._ready_idx = 0
+        self._launch(self._ready_idx)
+
+    def _launch(self, idx: int) -> None:
+        t = threading.Thread(target=self._fill, args=(self._buffers[idx],), daemon=True)
+        t.start()
+        self._pending = t
+
+    def Get(self) -> T:
+        """Wait for the in-flight fill, return it, prefetch the other buffer."""
+        assert self._pending is not None
+        self._pending.join()
+        ready = self._buffers[self._ready_idx]
+        self._ready_idx ^= 1
+        self._launch(self._ready_idx)
+        return ready
+
+    def Join(self) -> None:
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None

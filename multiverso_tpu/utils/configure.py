@@ -1,0 +1,174 @@
+"""Typed flag/config registry.
+
+Behavioral equivalent of the reference's configure system
+(reference include/multiverso/util/configure.h:22-113,
+src/util/configure.cpp:9-55): typed static registries keyed by string,
+``MV_DEFINE_<type>(name, default, help)`` registration, ``ParseCMDFlags``
+stripping ``-key=value`` entries from argv (trying string -> int -> double ->
+bool registries in order), and programmatic ``SetCMDFlag``.
+
+Python-side we keep one registry per type to preserve the reference's
+lookup-order semantics (a ``-foo=1`` only parses as an int flag if ``foo``
+was registered as an int flag; unknown flags are left in argv untouched —
+no, in the reference unknown ``-k=v`` args are consumed only when a registry
+claims them, otherwise kept; we match that).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+_lock = threading.RLock()
+
+
+class _FlagRegister(Generic[T]):
+    """One typed registry (reference configure.h:40-57 FlagRegister<T>)."""
+
+    def __init__(self, caster):
+        self.flags: Dict[str, T] = {}
+        self.defaults: Dict[str, T] = {}
+        self.help: Dict[str, str] = {}
+        self._caster = caster
+
+    def register(self, name: str, default: T, help_text: str = "") -> None:
+        with _lock:
+            # Re-registration keeps the existing value (tests may re-import app
+            # modules); the reference would have a duplicate static definition.
+            self.flags.setdefault(name, default)
+            self.defaults[name] = default
+            self.help[name] = help_text
+
+    def reset_to_defaults(self) -> None:
+        with _lock:
+            self.flags.update(self.defaults)
+
+    def try_set(self, name: str, raw: str) -> bool:
+        with _lock:
+            if name not in self.flags:
+                return False
+            self.flags[name] = self._caster(raw)
+            return True
+
+    def get(self, name: str) -> T:
+        with _lock:
+            return self.flags[name]
+
+    def has(self, name: str) -> bool:
+        with _lock:
+            return name in self.flags
+
+
+def _cast_bool(raw) -> bool:
+    if isinstance(raw, bool):
+        return raw
+    s = str(raw).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"not a bool: {raw!r}")
+
+
+def _cast_int(raw) -> int:
+    if isinstance(raw, bool):
+        raise ValueError("bool is not int")
+    return int(raw)
+
+
+_string_flags: _FlagRegister[str] = _FlagRegister(str)
+_int_flags: _FlagRegister[int] = _FlagRegister(_cast_int)
+_double_flags: _FlagRegister[float] = _FlagRegister(float)
+_bool_flags: _FlagRegister[bool] = _FlagRegister(_cast_bool)
+
+# Lookup order matches reference ParseCMDFlags (configure.cpp:24-41):
+# string, then int, then double, then bool.
+_REGISTRIES = (_string_flags, _int_flags, _double_flags, _bool_flags)
+
+
+def MV_DEFINE_string(name: str, default: str, help_text: str = "") -> None:
+    _string_flags.register(name, default, help_text)
+
+
+def MV_DEFINE_int(name: str, default: int, help_text: str = "") -> None:
+    _int_flags.register(name, default, help_text)
+
+
+def MV_DEFINE_double(name: str, default: float, help_text: str = "") -> None:
+    _double_flags.register(name, default, help_text)
+
+
+def MV_DEFINE_bool(name: str, default: bool, help_text: str = "") -> None:
+    _bool_flags.register(name, default, help_text)
+
+
+def GetFlag(name: str):
+    """Read a flag from whichever registry holds it (configure.h:80-85)."""
+    for reg in _REGISTRIES:
+        if reg.has(name):
+            return reg.get(name)
+    raise KeyError(f"flag {name!r} was never defined")
+
+
+def SetCMDFlag(name: str, value) -> None:
+    """Programmatic flag set (reference configure.h:87-90, MV_SetFlag)."""
+    for reg in _REGISTRIES:
+        if reg.has(name):
+            reg.try_set(name, value)
+            return
+    raise KeyError(f"flag {name!r} was never defined")
+
+
+def HasFlag(name: str) -> bool:
+    return any(reg.has(name) for reg in _REGISTRIES)
+
+
+def ParseCMDFlags(argv: List[str] | None) -> List[str]:
+    """Strip ``-key=value`` entries claimed by a registry; return leftover argv.
+
+    Mirrors reference src/util/configure.cpp:9-55: each argv entry of the form
+    ``-key=value`` (single leading dash; ``--key=value`` also accepted here
+    for CLI friendliness) is offered to the registries in order; consumed on
+    first success, otherwise left in place.
+    """
+    if not argv:
+        return []
+    remaining: List[str] = []
+    for arg in argv:
+        if arg.startswith("-") and "=" in arg:
+            body = arg.lstrip("-")
+            key, _, val = body.partition("=")
+            consumed = False
+            for reg in _REGISTRIES:
+                try:
+                    if reg.try_set(key, val):
+                        consumed = True
+                        break
+                except ValueError:
+                    # registered in this registry but value doesn't parse:
+                    # keep trying others (matches reference fallthrough).
+                    continue
+            if consumed:
+                continue
+        remaining.append(arg)
+    return remaining
+
+
+def ResetFlagsToDefaults() -> None:
+    """Restore every flag to its registered default.
+
+    Called by MV_ShutDown so one process can run successive worlds (the
+    reference never needed this — each MPI process parses flags exactly
+    once and exits)."""
+    for reg in _REGISTRIES:
+        reg.reset_to_defaults()
+
+
+def _reset_for_tests() -> None:
+    """Clear every registry. Test hook only."""
+    with _lock:
+        for reg in _REGISTRIES:
+            reg.flags.clear()
+            reg.help.clear()

@@ -1,0 +1,119 @@
+"""Profiling dashboard: named monitors accumulating count + elapsed time.
+
+Behavioral equivalent of reference include/multiverso/dashboard.h:16-73 and
+src/dashboard.cpp: a global registry of ``Monitor`` objects, each tracking
+(name, count, total elapsed). The reference instruments code regions with
+``MONITOR_BEGIN/END`` macros (dashboard.h:61-72); here the idiomatic Python
+equivalents are ``Monitor.Begin()/End()`` and the ``monitor_region``
+context manager / decorator.
+
+TPU note: device work is async-dispatched; a region that merely *launches*
+a jit'd computation measures dispatch cost. Monitors intentionally measure
+host wall-clock of the region like the reference did; device-side timing
+belongs to jax.profiler traces (see docs/DESIGN.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Monitor:
+    def __init__(self, name: str, register: bool = True):
+        self.name = name
+        self._count = 0
+        self._elapsed = 0.0  # seconds
+        self._begin: Optional[float] = None
+        self._lock = threading.Lock()
+        if register:
+            Dashboard.AddMonitor(self)
+
+    def Begin(self) -> None:
+        self._begin = time.perf_counter()
+
+    def End(self) -> None:
+        if self._begin is None:
+            return
+        dt = time.perf_counter() - self._begin
+        self._begin = None
+        with self._lock:
+            self._count += 1
+            self._elapsed += dt
+
+    def Add(self, elapsed_s: float, count: int = 1) -> None:
+        with self._lock:
+            self._count += count
+            self._elapsed += elapsed_s
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def elapse_ms(self) -> float:
+        return self._elapsed * 1e3
+
+    @property
+    def average_ms(self) -> float:
+        return self.elapse_ms / self._count if self._count else 0.0
+
+    def info_string(self) -> str:
+        return (f"[Monitor] {self.name}: count = {self._count}, "
+                f"elapse = {self.elapse_ms:.3f} ms, "
+                f"average = {self.average_ms:.3f} ms")
+
+
+class Dashboard:
+    """Global monitor registry (reference dashboard.h:16-25)."""
+
+    _records: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def AddMonitor(cls, monitor: Monitor) -> None:
+        with cls._lock:
+            cls._records[monitor.name] = monitor
+
+    @classmethod
+    def Get(cls, name: str) -> Monitor:
+        """Lazily create+register (MONITOR macros' lazy static, dashboard.h:61-66)."""
+        with cls._lock:
+            mon = cls._records.get(name)
+            if mon is None:
+                mon = Monitor(name, register=False)
+                cls._records[name] = mon
+            return mon
+
+    @classmethod
+    def Watch(cls, name: str) -> str:
+        with cls._lock:
+            mon = cls._records.get(name)
+        return mon.info_string() if mon else f"[Monitor] {name}: <absent>"
+
+    @classmethod
+    def Display(cls) -> str:
+        with cls._lock:
+            lines = [m.info_string() for m in cls._records.values()]
+        out = "\n".join(lines)
+        if out:
+            print(out, flush=True)
+        return out
+
+    @classmethod
+    def _reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._records.clear()
+
+
+@contextlib.contextmanager
+def monitor_region(name: str):
+    """``with monitor_region("worker.process_get"): ...`` — MONITOR_BEGIN/END."""
+    mon = Dashboard.Get(name)
+    start = time.perf_counter()
+    try:
+        yield mon
+    finally:
+        mon.Add(time.perf_counter() - start)

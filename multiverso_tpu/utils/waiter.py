@@ -1,0 +1,32 @@
+"""Counting-semaphore Waiter (reference include/multiverso/util/waiter.h:10-34).
+
+``Wait()`` blocks until the internal counter reaches zero; ``Notify()``
+decrements; ``Reset(n)`` re-arms for n notifications. Used by the table layer
+to wait for all per-server reply partitions of one request
+(reference src/table.cpp:84-110).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Waiter:
+    def __init__(self, num_wait: int = 1):
+        self._cv = threading.Condition()
+        self._num = num_wait
+
+    def Wait(self, timeout: float | None = None) -> bool:
+        with self._cv:
+            ok = self._cv.wait_for(lambda: self._num <= 0, timeout)
+            return ok
+
+    def Notify(self) -> None:
+        with self._cv:
+            self._num -= 1
+            if self._num <= 0:
+                self._cv.notify_all()
+
+    def Reset(self, num_wait: int) -> None:
+        with self._cv:
+            self._num = num_wait

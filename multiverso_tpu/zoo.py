@@ -1,0 +1,210 @@
+"""Zoo — the runtime singleton: mesh, roles, engine lifecycle, registries.
+
+Behavioral equivalent of reference include/multiverso/zoo.h + src/zoo.cpp:
+``Start`` parses flags, brings up the transport and actors in order, registers
+the node, and barriers (zoo.cpp:41-103); ``Stop`` drains and shuts down
+(zoo.cpp:104-113); it owns the actor registry, worker/server id maps, and the
+barrier (zoo.cpp:116-177).
+
+TPU mapping (see docs/DESIGN.md):
+
+* The *server fabric* is the device mesh: ``num_servers`` = devices along the
+  mesh ``server`` axis; shards live in HBM, so the reference's
+  controller/communicator rank handshake (controller.cpp:38-77) reduces to
+  mesh construction (+ ``jax.distributed`` across hosts).
+* *Workers* are host execution streams: threads in one process (the
+  reference's 1-process test world, multiverso_env.h) and processes across
+  hosts. ``num_workers`` comes from the ``num_workers`` flag; each worker
+  thread binds an id via ``worker_context``.
+* One server *engine* actor serializes Get/Add application per the
+  configured consistency mode (async / BSP sync — sync/server.py). In
+  model-average mode (``-ma``) no engine starts, matching zoo.cpp:24,49;
+  ``MV_Aggregate`` uses the rendezvous/psum allreduce instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.node import ROLE_NAMES, Node, Role
+from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
+from multiverso_tpu.parallel.mesh import MeshContext
+from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
+                                            MV_DEFINE_int, MV_DEFINE_string,
+                                            ParseCMDFlags)
+from multiverso_tpu.utils.log import CHECK, Log
+from multiverso_tpu.utils.waiter import Waiter
+
+MV_DEFINE_string("ps_role", "default", "none / worker / server / default")
+MV_DEFINE_bool("ma", False, "model-average mode: no parameter server")
+MV_DEFINE_int("num_workers", 1, "number of in-process worker streams")
+
+_thread_local = threading.local()
+
+
+class Zoo:
+    _instance: Optional["Zoo"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.started = False
+        self.mesh_ctx: Optional[MeshContext] = None
+        self.node = Node()
+        self.num_workers = 1
+        self.server_engine = None
+        self.worker_tables: List[Any] = []
+        self.server_tables: List[Any] = []
+        self._barrier: Optional[threading.Barrier] = None
+        self._allreduce: Optional[RendezvousAllreduce] = None
+        self._ma_mode = False
+
+    # -- singleton ----------------------------------------------------------
+
+    @classmethod
+    def Get(cls) -> "Zoo":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Zoo()
+            return cls._instance
+
+    # -- lifecycle (reference zoo.cpp:41-113) --------------------------------
+
+    def Start(self, argv: Optional[List[str]] = None,
+              devices=None) -> List[str]:
+        CHECK(not self.started, "Zoo already started")
+        rest = ParseCMDFlags(argv or [])
+        self._ma_mode = bool(GetFlag("ma"))
+        role = ROLE_NAMES.get(str(GetFlag("ps_role")).lower(), Role.ALL)
+        self.num_workers = max(1, int(GetFlag("num_workers")))
+        self.mesh_ctx = MeshContext.create(devices)
+        self.node = Node(rank=0, role=role,
+                         worker_id=0 if role & Role.WORKER else -1,
+                         server_id=0 if role & Role.SERVER else -1)
+        self._barrier = threading.Barrier(self.num_workers)
+        self._allreduce = RendezvousAllreduce(self.num_workers)
+        if not self._ma_mode:
+            from multiverso_tpu.sync.server import Server
+            self.server_engine = Server.GetServer(self.num_workers)
+            self.server_engine.Start()
+        self.started = True
+        Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
+                  "mode=%s", self.num_servers, self.num_workers,
+                  "ma" if self._ma_mode else
+                  ("sync" if GetFlag("sync") else "async"))
+        return rest
+
+    def Stop(self, finalize_net: bool = True) -> None:
+        if not self.started:
+            return
+        if self.server_engine is not None:
+            self.FinishTrain()
+            self.server_engine.Stop()
+            self.server_engine = None
+        self.worker_tables.clear()
+        self.server_tables.clear()
+        self.started = False
+        Log.Debug("Zoo stopped")
+
+    def FinishTrain(self) -> None:
+        """Send Server_Finish_Train for every worker so a SyncServer drains
+        its caches (reference zoo.cpp:152-162)."""
+        if self.server_engine is None:
+            return
+        waiters = []
+        for wid in range(self.num_workers):
+            w = Waiter(1)
+            msg = Message(msg_type=MsgType.Server_Finish_Train, src=wid,
+                          waiter=w)
+            self.server_engine.Receive(msg)
+            waiters.append(w)
+        for w in waiters:
+            w.Wait()
+
+    # -- identity (reference zoo.h:40-66) ------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self.node.rank
+
+    @property
+    def size(self) -> int:
+        return 1  # single host process; multihost via jax.distributed TBD
+
+    @property
+    def num_servers(self) -> int:
+        if self._ma_mode or self.mesh_ctx is None:
+            return 0 if self._ma_mode else 1
+        return self.mesh_ctx.num_servers
+
+    def current_worker_id(self) -> int:
+        return getattr(_thread_local, "worker_id", 0)
+
+    def worker_context(self, worker_id: int):
+        """Bind the calling thread to a worker id (thread workers stand in
+        for MPI rank workers — reference rank_to_worker_id maps)."""
+        zoo = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._prev = getattr(_thread_local, "worker_id", None)
+                CHECK(0 <= worker_id < zoo.num_workers,
+                      f"worker_id {worker_id} out of range")
+                _thread_local.worker_id = worker_id
+                return zoo
+
+            def __exit__(self, *exc):
+                if self._prev is None:
+                    del _thread_local.worker_id
+                else:
+                    _thread_local.worker_id = self._prev
+
+        return _Ctx()
+
+    def worker_id_to_rank(self, worker_id: int) -> int:
+        return 0
+
+    def server_id_to_rank(self, server_id: int) -> int:
+        return 0
+
+    # -- table registries (reference zoo.h:68-73) ---------------------------
+
+    def RegisterServerTable(self, server_table) -> int:
+        CHECK(self.server_engine is not None,
+              "cannot create tables in -ma mode (reference zoo.cpp:49)")
+        table_id = self.server_engine.RegisterTable(server_table)
+        self.server_tables.append(server_table)
+        return table_id
+
+    def RegisterWorkerTable(self, worker_table) -> int:
+        self.worker_tables.append(worker_table)
+        return len(self.worker_tables) - 1
+
+    def SendToServer(self, msg: Message) -> None:
+        CHECK(self.server_engine is not None, "no server engine (ma mode?)")
+        self.server_engine.Receive(msg)
+
+    # -- collectives --------------------------------------------------------
+
+    def Barrier(self) -> None:
+        """Worker barrier (reference zoo.cpp:164-177 controller roundtrip)."""
+        CHECK(self._barrier is not None, "Zoo not started")
+        self._barrier.wait()
+
+    def Aggregate(self, data: np.ndarray) -> np.ndarray:
+        """In-place elementwise-sum allreduce across workers
+        (reference MV_Aggregate, src/multiverso.cpp:53-56)."""
+        CHECK(self._allreduce is not None, "Zoo not started")
+        result = self._allreduce.allreduce(data)
+        np.copyto(data, result.astype(data.dtype))
+        return data
+
+    @classmethod
+    def _reset_for_tests(cls) -> None:
+        with cls._instance_lock:
+            if cls._instance is not None and cls._instance.started:
+                cls._instance.Stop()
+            cls._instance = None

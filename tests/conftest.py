@@ -1,0 +1,48 @@
+"""Test harness: force a virtual 8-device CPU platform BEFORE jax initializes.
+
+This stands in for the reference's 1-process MPI world fixture
+(reference Test/unittests/multiverso_env.h:10-29) — the whole PS path runs
+in-process, but over a *real* 8-device jax mesh so sharding/collective code
+paths are exercised without TPU hardware. Bench runs (bench.py) use the real
+chip instead.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The env var alone is NOT enough under the axon TPU shim (its get_backend
+# hook still initializes the tunnel client, which hangs if the tunnel is
+# busy) — the config switch below is authoritative. Tests must never touch
+# the real chip; bench.py owns it.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mv_env():
+    """MultiversoEnv: MV_Init'd 1-host world, torn down after the test
+    (reference Test/unittests/multiverso_env.h:10-21)."""
+    import multiverso_tpu as mv
+    mv.MV_Init([])
+    yield mv
+    mv.MV_ShutDown()
+
+
+@pytest.fixture()
+def sync_mv_env():
+    """SyncMultiversoEnv: same with -sync=true
+    (reference multiverso_env.h:23-29)."""
+    import multiverso_tpu as mv
+    mv.MV_Init(["-sync=true"])
+    yield mv
+    mv.MV_ShutDown()

@@ -1,0 +1,370 @@
+"""Tier-1 (pure sharding/updater math) and tier-2 (full in-process PS path
+over a real 8-device mesh) table tests.
+
+Counterparts of reference Test/unittests/test_array.cpp, test_kv.cpp,
+Test/test_matrix_table.cpp, and the binding accumulation invariants.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.parallel.mesh import partition_offsets, row_partition_server
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption, SparseMatrixTableOption)
+from multiverso_tpu.updaters import AddOption, GetOption
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: partition math as pure functions (reference test_array.cpp:47-66)
+# ---------------------------------------------------------------------------
+
+class TestPartitionMath:
+    def test_array_partition_even(self):
+        offs = partition_offsets(100, 4)
+        assert offs == [(0, 25), (25, 25), (50, 25), (75, 25)]
+
+    def test_array_partition_remainder_to_last(self):
+        # last server takes the remainder (reference array_table.cpp:101-105)
+        offs = partition_offsets(10, 4)
+        assert offs == [(0, 2), (2, 2), (4, 2), (6, 4)]
+        assert sum(c for _, c in offs) == 10
+
+    def test_array_partition_tiny(self):
+        offs = partition_offsets(3, 8)
+        assert sum(c for _, c in offs) == 3
+
+    def test_row_partition(self):
+        # row -> server = row / (num_rows/num_servers), tail clamped
+        # (reference matrix_table.cpp:24-46)
+        assert row_partition_server(0, 100, 4) == 0
+        assert row_partition_server(25, 100, 4) == 1
+        assert row_partition_server(99, 100, 4) == 3
+        assert row_partition_server(99, 101, 4) == 3  # tail clamp
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: full PS path (reference test_array.cpp:27-45 etc.)
+# ---------------------------------------------------------------------------
+
+class TestArrayTable:
+    def test_add_then_get(self, mv_env):
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=100))
+        delta = np.arange(100, dtype=np.float32)
+        table.Add(delta)
+        table.Add(delta)
+        np.testing.assert_allclose(table.Get(), 2 * delta)
+
+    def test_async_handles(self, mv_env):
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=50))
+        h1 = table.AddAsyncHandle(np.ones(50, np.float32))
+        h2 = table.AddAsyncHandle(np.ones(50, np.float32))
+        table.Wait(h1)
+        table.Wait(h2)
+        hg = table.GetAsyncHandle()
+        np.testing.assert_allclose(table.Wait(hg), 2.0)
+
+    def test_tiny_table_supported(self, mv_env):
+        # improvement over reference (array_table.cpp:14 CHECK forbids this)
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=3))
+        table.Add(np.array([1, 2, 3], np.float32))
+        np.testing.assert_allclose(table.Get(), [1, 2, 3])
+
+    def test_get_into_buffer(self, mv_env):
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=10))
+        table.Add(np.full(10, 5.0, np.float32))
+        buf = np.zeros(10, np.float32)
+        out = table.Get(buffer=buf)
+        assert out is buf
+        np.testing.assert_allclose(buf, 5.0)
+
+    def test_sgd_updater(self, mv_env):
+        mv_env.MV_SetFlag("updater_type", "sgd")
+        try:
+            table = mv_env.MV_CreateTable(ArrayTableOption(size=10))
+            table.Add(np.full(10, 0.5, np.float32))  # sgd: data -= delta
+            np.testing.assert_allclose(table.Get(), -0.5)
+        finally:
+            mv_env.MV_SetFlag("updater_type", "default")
+
+    def test_momentum_updater(self, mv_env):
+        table = mv_env.MV_CreateTable(
+            ArrayTableOption(size=4, updater_type="momentum"))
+        opt = AddOption(momentum=0.5)
+        delta = np.ones(4, np.float32)
+        # smooth = .5*0 + .5*1 = .5 ; data = -0.5
+        table.Add(delta, opt)
+        np.testing.assert_allclose(table.Get(), -0.5)
+        # smooth = .5*.5 + .5*1 = .75 ; data = -1.25
+        table.Add(delta, opt)
+        np.testing.assert_allclose(table.Get(), -1.25)
+
+    def test_adagrad_updater_per_worker(self, mv_env):
+        table = mv_env.MV_CreateTable(
+            ArrayTableOption(size=4, updater_type="adagrad"))
+        lr, rho = 1.0, 0.1
+        opt0 = AddOption(worker_id=0, learning_rate=lr, rho=rho)
+        delta = np.ones(4, np.float32)
+        table.Add(delta, opt0)
+        # hist=1, data -= rho*1/sqrt(1+eps)
+        expected = -rho / np.sqrt(1 + 1e-6)
+        np.testing.assert_allclose(table.Get(), expected, rtol=1e-5)
+
+    def test_store_load(self, mv_env, tmp_path):
+        from multiverso_tpu.utils.io import StreamFactory
+        from multiverso_tpu.zoo import Zoo
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=10))
+        table.Add(np.arange(10, dtype=np.float32))
+        server = Zoo.Get().server_tables[0]
+        path = str(tmp_path / "ckpt.bin")
+        with StreamFactory.GetStream(path, "w") as s:
+            server.Store(s)
+        table.Add(np.ones(10, np.float32))  # diverge
+        with StreamFactory.GetStream(path, "r") as s:
+            server.Load(s)
+        np.testing.assert_allclose(table.Get(), np.arange(10))
+
+    def test_partition_pure(self, mv_env):
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=100))
+        offs = table.Partition(num_servers=4)
+        assert offs == partition_offsets(100, 4)
+
+
+class TestMatrixTable:
+    def test_whole_add_get(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=20, num_cols=5))
+        delta = np.random.default_rng(0).normal(size=(20, 5)).astype(np.float32)
+        table.Add(delta)
+        np.testing.assert_allclose(table.Get(), delta, rtol=1e-6)
+
+    def test_row_add_get(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=100, num_cols=8))
+        ids = [3, 17, 99]
+        deltas = np.ones((3, 8), np.float32) * np.array([[1], [2], [3]],
+                                                        np.float32)
+        table.AddRows(ids, deltas)
+        rows = table.GetRows([99, 3, 17])
+        np.testing.assert_allclose(rows[:, 0], [3, 1, 2])
+        # untouched rows stay zero
+        np.testing.assert_allclose(table.GetRows([50]), 0)
+
+    def test_duplicate_row_ids_accumulate(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=10, num_cols=4))
+        table.AddRows([2, 2, 2], np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(table.GetRows([2]), 3.0)
+
+    def test_initializer(self, mv_env):
+        rng = np.random.default_rng(42)
+        init = rng.normal(size=(10, 4)).astype(np.float32)
+        table = mv_env.MV_CreateTable(
+            MatrixTableOption(num_rows=10, num_cols=4,
+                              initializer=lambda shape: init))
+        np.testing.assert_allclose(table.Get(), init, rtol=1e-6)
+
+    def test_varied_batch_sizes_bucket(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=64, num_cols=4))
+        for k in (1, 2, 3, 9, 17, 33):
+            table.AddRows(np.arange(k), np.ones((k, 4), np.float32))
+        rows = table.GetRows(np.arange(33))
+        assert rows[0, 0] == 6  # row 0 hit by all six adds
+
+    def test_store_load(self, mv_env, tmp_path):
+        from multiverso_tpu.utils.io import StreamFactory
+        from multiverso_tpu.zoo import Zoo
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=6, num_cols=3))
+        table.Add(np.full((6, 3), 2.0, np.float32))
+        server = Zoo.Get().server_tables[0]
+        path = str(tmp_path / "m.bin")
+        with StreamFactory.GetStream(path, "w") as s:
+            server.Store(s)
+        table.Add(np.ones((6, 3), np.float32))
+        with StreamFactory.GetStream(path, "r") as s:
+            server.Load(s)
+        np.testing.assert_allclose(table.Get(), 2.0)
+
+    def test_partition_by_server(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=100, num_cols=2))
+        buckets = table.Partition([0, 25, 50, 99], num_servers=4)
+        assert buckets == {0: [0], 1: [25], 2: [50], 3: [99]}
+
+
+class TestKVTable:
+    def test_add_get(self, mv_env):
+        table = mv_env.MV_CreateTable(KVTableOption())
+        table.Add([1, 2, 10**12], [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(table.Get([10**12, 2, 1]), [3.0, 2.0, 1.0])
+
+    def test_missing_key_zero(self, mv_env):
+        table = mv_env.MV_CreateTable(KVTableOption())
+        np.testing.assert_allclose(table.Get([123456]), [0.0])
+
+    def test_accumulate_and_duplicates(self, mv_env):
+        table = mv_env.MV_CreateTable(KVTableOption())
+        table.Add([7, 7, 7], [1.0, 2.0, 3.0])
+        table.Add([7], [4.0])
+        np.testing.assert_allclose(table.Get([7]), [10.0])
+
+    def test_growth(self, mv_env):
+        table = mv_env.MV_CreateTable(KVTableOption(init_capacity=8))
+        keys = np.arange(100, dtype=np.int64)
+        table.Add(keys, np.ones(100, np.float32))
+        np.testing.assert_allclose(table.Get(keys), 1.0)
+
+    def test_local_cache(self, mv_env):
+        table = mv_env.MV_CreateTable(KVTableOption())
+        table.Add([5], [2.0])
+        table.Get([5])
+        assert table.raw()[5] == 2.0
+
+    def test_int64_values(self, mv_env):
+        # WE word-count table is KVTable<int, int64> (reference
+        # communicator.cpp:17-33)
+        table = mv_env.MV_CreateTable(KVTableOption(dtype=np.int64))
+        table.Add([1], [2**40])
+        assert table.Get([1])[0] == 2**40
+
+    def test_store_load(self, mv_env, tmp_path):
+        from multiverso_tpu.utils.io import StreamFactory
+        from multiverso_tpu.zoo import Zoo
+        table = mv_env.MV_CreateTable(KVTableOption())
+        table.Add([3, 9], [1.5, 2.5])
+        server = Zoo.Get().server_tables[0]
+        path = str(tmp_path / "kv.bin")
+        with StreamFactory.GetStream(path, "w") as s:
+            server.Store(s)
+        table.Add([3], [10.0])
+        with StreamFactory.GetStream(path, "r") as s:
+            server.Load(s)
+        np.testing.assert_allclose(table.Get([3, 9]), [1.5, 2.5])
+
+
+class TestSparseMatrixTable:
+    def _make(self, mv, workers=2):
+        return mv.MV_CreateTable(
+            SparseMatrixTableOption(num_rows=10, num_cols=3))
+
+    def test_dirty_row_protocol(self):
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = self._make(mv)
+            # worker 0 adds rows 2,4 -> stale for worker 1, fresh for worker 0
+            table.AddRows([2, 4], np.ones((2, 3), np.float32),
+                          AddOption(worker_id=0))
+            ids, rows = table.Get(GetOption(worker_id=1))
+            assert sorted(ids.tolist()) == [2, 4]
+            np.testing.assert_allclose(rows, 1.0)
+            # second get: nothing stale -> row 0 fallback
+            ids2, _ = table.Get(GetOption(worker_id=1))
+            assert ids2.tolist() == [0]
+            # adder itself sees nothing stale
+            ids3, _ = table.Get(GetOption(worker_id=0))
+            assert ids3.tolist() == [0]
+        finally:
+            mv.MV_ShutDown()
+
+    def test_worker_minus_one_gets_all(self):
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = self._make(mv)
+            ids, rows = table.Get(GetOption(worker_id=-1))
+            assert len(ids) == 10
+            assert rows.shape == (10, 3)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_get_rows_subset(self):
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = self._make(mv)
+            table.AddRows([1, 5, 7], np.ones((3, 3), np.float32),
+                          AddOption(worker_id=0))
+            # worker 1 asks about rows [5, 6]: only 5 is stale
+            ids, rows = table.GetRows([5, 6], GetOption(worker_id=1))
+            assert ids.tolist() == [5]
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestErrorPropagation:
+    """Regression tests for review findings: server-side failures must reach
+    the caller's Wait() and must not corrupt neighbouring requests."""
+
+    def test_add_size_mismatch_raises_at_caller(self, mv_env):
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=10))
+        with pytest.raises(FatalError):
+            table.Add(np.ones(7, np.float32))
+        table.Add(np.ones(10, np.float32))  # table still healthy
+        np.testing.assert_allclose(table.Get(), 1.0)
+
+    def test_negative_row_id_rejected(self, mv_env):
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=15, num_cols=2))
+        with pytest.raises(FatalError):
+            table.AddRows([-3], np.ones((1, 2), np.float32))
+        with pytest.raises(FatalError):
+            table.GetRows([-1])
+        np.testing.assert_allclose(table.Get(), 0.0)  # nothing leaked in
+
+    def test_get_duplicates_exceeding_padded_rows(self, mv_env):
+        # Get path allows duplicates; batches longer than the table must work
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=5, num_cols=2))
+        table.AddRows([0, 1, 2], np.ones((3, 2), np.float32))
+        ids = [0, 1, 2, 0, 1, 2, 0, 1, 2, 0]
+        rows = table.GetRows(ids)
+        assert rows.shape == (10, 2)
+        np.testing.assert_allclose(rows, 1.0)
+
+    def test_failed_add_does_not_desync_sparse_bits(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.utils.log import FatalError
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = mv.MV_CreateTable(
+                SparseMatrixTableOption(num_rows=10, num_cols=2))
+            with pytest.raises(FatalError):
+                table.AddRows([99], np.ones((1, 2), np.float32),
+                              AddOption(worker_id=0))
+            ids, _ = table.Get(GetOption(worker_id=1))
+            assert ids.tolist() == [0]  # nothing became stale
+        finally:
+            mv.MV_ShutDown()
+
+    def test_drained_message_error_reaches_its_own_caller(self):
+        """SyncServer drain path: a failing cached Get must fail for ITS
+        worker, not poison the draining worker's request."""
+        import threading
+        import multiverso_tpu as mv
+        from multiverso_tpu.utils.log import FatalError
+        mv.MV_Init(["-num_workers=2", "-sync=true"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=5, num_cols=2))
+            outcome = {}
+
+            def worker_b():
+                from multiverso_tpu.zoo import Zoo
+                with Zoo.Get().worker_context(1):
+                    table.AddRows([0], np.ones((1, 2), np.float32),
+                                  AddOption(worker_id=1))
+                    try:
+                        table.GetRows([99], GetOption(worker_id=1))
+                        outcome["b"] = "no-error"
+                    except FatalError:
+                        outcome["b"] = "raised"
+
+            tb = threading.Thread(target=worker_b)
+            tb.start()
+            import time
+            time.sleep(0.3)  # let B's Get reach the server first
+            from multiverso_tpu.zoo import Zoo
+            with Zoo.Get().worker_context(0):
+                table.AddRows([0], np.ones((1, 2), np.float32),
+                              AddOption(worker_id=0))  # must NOT raise
+                outcome["a"] = "ok"
+            tb.join(timeout=30)
+            assert not tb.is_alive(), "worker B hung"
+            assert outcome == {"a": "ok", "b": "raised"}
+        finally:
+            mv.MV_ShutDown()

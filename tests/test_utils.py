@@ -1,0 +1,229 @@
+"""Tier-1 unit tests for the utility layer (reference L0).
+
+Counterparts of reference Test/unittests coverage for util pieces, plus the
+pure-function behaviors SURVEY.md §4.1 calls out.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.utils import configure as cfg
+from multiverso_tpu.utils.async_buffer import ASyncBuffer
+from multiverso_tpu.utils.dashboard import Dashboard, Monitor, monitor_region
+from multiverso_tpu.utils.io import URI, StreamFactory, TextReader
+from multiverso_tpu.utils.log import CHECK, FatalError, Log
+from multiverso_tpu.utils.mt_queue import MtQueue
+from multiverso_tpu.utils.quantization import SparseFilter
+from multiverso_tpu.utils.timer import Timer
+from multiverso_tpu.utils.waiter import Waiter
+
+
+class TestConfigure:
+    def test_define_parse_get(self):
+        cfg.MV_DEFINE_int("t_threads", 4, "")
+        cfg.MV_DEFINE_string("t_name", "default", "")
+        cfg.MV_DEFINE_bool("t_sync", False, "")
+        cfg.MV_DEFINE_double("t_lr", 0.1, "")
+        rest = cfg.ParseCMDFlags(
+            ["prog", "-t_threads=8", "-t_sync=true", "-t_lr=0.5",
+             "-t_name=abc", "-unknown=1", "positional"])
+        assert cfg.GetFlag("t_threads") == 8
+        assert cfg.GetFlag("t_sync") is True
+        assert cfg.GetFlag("t_lr") == 0.5
+        assert cfg.GetFlag("t_name") == "abc"
+        # unclaimed args stay (reference configure.cpp keeps unknown argv)
+        assert rest == ["prog", "-unknown=1", "positional"]
+
+    def test_set_cmd_flag(self):
+        cfg.MV_DEFINE_bool("t_flag2", False, "")
+        cfg.SetCMDFlag("t_flag2", True)
+        assert cfg.GetFlag("t_flag2") is True
+        cfg.SetCMDFlag("t_flag2", "false")
+        assert cfg.GetFlag("t_flag2") is False
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(KeyError):
+            cfg.GetFlag("never_defined_flag")
+
+
+class TestLog:
+    def test_fatal_raises(self):
+        with pytest.raises(FatalError):
+            Log.Fatal("boom %d", 42)
+
+    def test_check(self):
+        CHECK(True, "fine")
+        with pytest.raises(FatalError):
+            CHECK(1 == 2, "math broke")
+
+
+class TestMtQueue:
+    def test_fifo_and_exit(self):
+        q = MtQueue()
+        q.Push(1)
+        q.Push(2)
+        ok, v = q.Pop()
+        assert ok and v == 1
+        ok, v = q.TryPop()
+        assert ok and v == 2
+        ok, v = q.TryPop()
+        assert not ok
+        q.Exit()
+        ok, v = q.Pop()  # does not block after Exit
+        assert not ok
+
+    def test_blocking_pop_wakes(self):
+        q = MtQueue()
+        out = []
+
+        def consumer():
+            ok, v = q.Pop()
+            out.append((ok, v))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.Push("x")
+        t.join(timeout=2)
+        assert out == [(True, "x")]
+
+
+class TestWaiter:
+    def test_countdown(self):
+        w = Waiter(2)
+        done = []
+
+        def waiter_thread():
+            w.Wait()
+            done.append(True)
+
+        t = threading.Thread(target=waiter_thread)
+        t.start()
+        w.Notify()
+        assert not done
+        w.Notify()
+        t.join(timeout=2)
+        assert done == [True]
+
+    def test_reset(self):
+        w = Waiter(1)
+        w.Notify()
+        assert w.Wait(timeout=1)
+        w.Reset(1)
+        assert not w.Wait(timeout=0.05)
+
+
+class TestDashboard:
+    def test_monitor_accumulates(self):
+        mon = Monitor("test.region")
+        mon.Begin()
+        time.sleep(0.01)
+        mon.End()
+        assert mon.count == 1
+        assert mon.elapse_ms >= 5
+        assert "test.region" in Dashboard.Watch("test.region")
+
+    def test_monitor_region_ctx(self):
+        with monitor_region("test.ctx"):
+            pass
+        with monitor_region("test.ctx"):
+            pass
+        assert Dashboard.Get("test.ctx").count == 2
+
+    def test_display(self):
+        Monitor("test.display").Add(0.001)
+        out = Dashboard.Display()
+        assert "test.display" in out
+
+
+class TestIO:
+    def test_uri_parse(self):
+        u = URI("file:///tmp/x/y.bin")
+        assert u.scheme == "file" and u.path == "/tmp/x/y.bin"
+        u2 = URI("/tmp/plain")
+        assert u2.scheme == "file"
+        u3 = URI("hdfs://namenode:9000/data")
+        assert u3.scheme == "hdfs" and u3.host == "namenode:9000"
+
+    def test_stream_roundtrip(self, tmp_path):
+        path = str(tmp_path / "s.bin")
+        with StreamFactory.GetStream(path, "w") as s:
+            s.WriteInt(123)
+            s.WriteDouble(1.5)
+            s.WriteStr("hello")
+            s.Write(b"\x01\x02")
+        with StreamFactory.GetStream(path, "r") as s:
+            assert s.ReadInt() == 123
+            assert s.ReadDouble() == 1.5
+            assert s.ReadStr() == "hello"
+            assert s.Read(2) == b"\x01\x02"
+
+    def test_unknown_scheme(self):
+        with pytest.raises(NotImplementedError):
+            StreamFactory.GetStream("hdfs://h/p", "r")
+
+    def test_text_reader(self, tmp_path):
+        path = str(tmp_path / "t.txt")
+        with open(path, "w") as f:
+            f.write("line1\nline2\n")
+        with TextReader(path) as r:
+            assert r.GetLine() == "line1"
+            assert r.GetLine() == "line2"
+            assert r.GetLine() is None
+
+
+class TestQuantization:
+    def test_sparse_roundtrip(self):
+        f = SparseFilter(clip=0.0)
+        dense = np.zeros(100, np.float32)
+        dense[[3, 50, 99]] = [1.0, -2.0, 3.5]
+        is_sparse, idx, vals = f.compress(dense)
+        assert is_sparse
+        assert list(idx) == [3, 50, 99]
+        out = f.decompress(is_sparse, idx, vals, 100)
+        np.testing.assert_array_equal(out, dense)
+
+    def test_dense_passthrough(self):
+        f = SparseFilter()
+        dense = np.arange(1, 11, dtype=np.float32)  # no zeros
+        is_sparse, idx, vals = f.compress(dense)
+        assert not is_sparse
+        out = f.decompress(is_sparse, idx, vals, 10)
+        np.testing.assert_array_equal(out, dense)
+
+    def test_clip_threshold(self):
+        f = SparseFilter(clip=0.5)
+        dense = np.full(10, 0.4, np.float32)
+        dense[0] = 1.0
+        is_sparse, idx, vals = f.compress(dense)
+        assert is_sparse and list(idx) == [0]
+
+
+class TestASyncBuffer:
+    def test_double_buffer(self):
+        counter = {"n": 0}
+
+        def fill(buf):
+            counter["n"] += 1
+            buf[0] = counter["n"]
+
+        buf = ASyncBuffer([0], [0], fill)
+        # Get() hands back the filled buffer and starts refilling the other;
+        # the previously returned buffer is invalidated by the next Get
+        # (reference async_buffer.h double-buffer contract).
+        assert buf.Get()[0] == 1
+        assert buf.Get()[0] == 2
+        assert buf.Get()[0] == 3
+        buf.Join()
+
+
+class TestTimer:
+    def test_elapse(self):
+        t = Timer()
+        time.sleep(0.01)
+        assert t.elapse_ms() >= 5
+        t.Start()
+        assert t.elapse_ms() < 10
