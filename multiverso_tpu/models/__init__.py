@@ -1,0 +1,2 @@
+"""Bundled end-to-end applications (reference L8): LogisticRegression and
+WordEmbedding, rebuilt TPU-first on the table layer."""

@@ -1,0 +1,12 @@
+"""LogisticRegression application.
+
+TPU-first rebuild of reference Applications/LogisticRegression: config-file
+driven binary/multiclass logistic regression (dense or sparse libsvm data)
+with local or parameter-server training, sigmoid/softmax/FTRL objectives,
+L1/L2 regularization, an async background reader, sync_frequency-based
+pulls and a double-buffered pipeline. The per-sample scalar loops of the
+reference (objective/*.h) become one jit'd batched matmul step on the MXU.
+"""
+
+from multiverso_tpu.models.logreg.configure import Configure  # noqa: F401
+from multiverso_tpu.models.logreg.logreg import LogReg  # noqa: F401

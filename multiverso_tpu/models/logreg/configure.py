@@ -1,0 +1,90 @@
+"""LogisticRegression configuration.
+
+Key=value config-file parser with the same keys and defaults as the
+reference (Applications/LogisticRegression/src/configure.h:19-97,
+configure.cpp) so reference config files (e.g. example/mnist.config) work
+unchanged. Lines starting with '#' are comments; unknown keys warn.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+from multiverso_tpu.utils.log import Log
+
+
+@dataclass
+class Configure:
+    # dimensions (reference configure.h:20-22)
+    input_size: int = 0
+    output_size: int = 0
+    # is input data sparse (configure.h:25)
+    sparse: bool = False
+    # training (configure.h:27-34)
+    train_epoch: int = 1
+    minibatch_size: int = 20
+    read_buffer_size: int = 2048
+    show_time_per_sample: int = 10000
+    # objective/regular coefficients (configure.h:36-43)
+    regular_coef: float = 0.0005
+    learning_rate: float = 0.8
+    learning_rate_coef: float = 1e6
+    # FTRL parameters (configure.h:45-49)
+    alpha: float = 0.005
+    beta: float = 1.0
+    lambda1: float = 5.0
+    lambda2: float = 0.002
+    # files (configure.h:51-77)
+    init_model_file: str = ""
+    train_file: str = "train.data"
+    reader_type: str = "default"   # default / weight / bsparse
+    test_file: str = ""
+    output_model_file: str = "logreg.model"
+    output_file: str = "logreg.output"
+    # distributed mode (configure.h:79-87)
+    use_ps: bool = False
+    pipeline: bool = True
+    sync_frequency: int = 1
+    # algorithm selection (configure.h:89-97)
+    updater_type: str = "default"    # default / sgd / ftrl
+    objective_type: str = "default"  # default / sigmoid / softmax / ftrl
+    regular_type: str = "default"    # default / L1 / L2
+
+    @classmethod
+    def from_file(cls, config_file: str) -> "Configure":
+        cfg = cls()
+        cfg.load(config_file)
+        return cfg
+
+    def load(self, config_file: str) -> None:
+        typed = {f.name: f.type for f in fields(self)}
+        with open(config_file) as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                key, _, val = line.partition("=")
+                key, val = key.strip(), val.strip()
+                if key not in typed:
+                    Log.Error("[logreg] unknown config key %r", key)
+                    continue
+                current = getattr(self, key)
+                if isinstance(current, bool):
+                    setattr(self, key, val.lower() in ("true", "1", "yes"))
+                elif isinstance(current, int):
+                    setattr(self, key, int(float(val)))
+                elif isinstance(current, float):
+                    setattr(self, key, float(val))
+                else:
+                    setattr(self, key, val)
+        self.finalize()
+
+    def finalize(self) -> None:
+        """Normalize derived settings; idempotent. Called from_file and by
+        LogReg for programmatically-built configs."""
+        if self.objective_type == "ftrl":
+            # ftrl objective implies ftrl updater + sparse model
+            # (reference updater.cpp:106-108, ftrl uses sparse entries)
+            self.updater_type = "ftrl"
+            self.sparse = True

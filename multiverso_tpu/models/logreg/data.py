@@ -1,0 +1,201 @@
+"""Sample containers and async readers for LogisticRegression.
+
+Behavioral equivalent of reference
+Applications/LogisticRegression/src/data_type.h (dense/sparse sample
+blocks) and reader.h/.cpp (background parse thread producing sample
+buffers plus per-sync-window key sets consumed by the PS pulls,
+reference reader.h:45, ps_model.cpp:208-218).
+
+TPU-first shape: samples are batched into fixed-size minibatch tensors —
+dense (B, input) matrices, or padded (B, K) key/value/mask triples bucketed
+to powers of two — so the training step is one jit'd matmul, not a
+per-sample loop. The reader thread groups ``sync_frequency`` minibatches
+into a *window* and attaches the window's unique key set, which is exactly
+what the PS pipeline prefetches parameters for.
+
+Text formats (reference configure.h:56-70):
+  default: ``label v1 v2 ...`` (dense) or ``label k:v k:v ...`` (sparse)
+  weight:  first column is ``label:weight``; rest like default
+  bsparse: binary records: count(u64) label(i32) weight(f64) keys(u64 × count)
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.parallel.mesh import next_bucket
+from multiverso_tpu.utils.log import CHECK, Log
+from multiverso_tpu.utils.mt_queue import MtQueue
+
+
+_EMPTY_KEYS = np.empty(0, np.int64)
+
+
+@dataclass
+class SampleBatch:
+    """One minibatch, padded to static shapes."""
+
+    labels: np.ndarray                 # (B,) int32
+    weights: np.ndarray                # (B,) float32 per-sample weight
+    dense: Optional[np.ndarray] = None  # (B, input_size) float32
+    keys: Optional[np.ndarray] = None   # (B, K) int32, padded with 0
+    values: Optional[np.ndarray] = None  # (B, K) float32, padded with 0
+    mask: Optional[np.ndarray] = None    # (B, K) float32 1=valid
+    count: int = 0                       # true number of samples (<= B)
+
+    @property
+    def sparse(self) -> bool:
+        return self.dense is None
+
+
+def parse_line(line: str, input_size: int, sparse: bool,
+               weighted: bool) -> Optional[Tuple[int, float, np.ndarray, np.ndarray]]:
+    """-> (label, weight, keys, values); dense lines produce keys=arange."""
+    parts = line.split()
+    if not parts:
+        return None
+    head = parts[0]
+    if weighted and ":" in head:
+        lab, _, w = head.partition(":")
+        label, weight = int(float(lab)), float(w)
+    else:
+        label, weight = int(float(head)), 1.0
+    if sparse:
+        keys, vals = [], []
+        for tok in parts[1:]:
+            k, _, v = tok.partition(":")
+            keys.append(int(k))
+            vals.append(float(v) if v else 1.0)
+        return label, weight, np.asarray(keys, np.int64), np.asarray(vals, np.float32)
+    vals = np.asarray([float(x) for x in parts[1:]], np.float32)
+    CHECK(vals.size == input_size, f"dense sample width {vals.size} != input_size")
+    return label, weight, _EMPTY_KEYS, vals  # dense batching never reads keys
+
+
+def read_bsparse(path: str) -> Iterator[Tuple[int, float, np.ndarray, np.ndarray]]:
+    """Binary-sparse records (reference configure.h:64-69); values are 1."""
+    rec = struct.Struct("<qid")
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(rec.size)
+            if len(head) < rec.size:
+                return
+            count, label, weight = rec.unpack(head)
+            keys = np.frombuffer(f.read(8 * count), np.int64).copy()
+            yield label, weight, keys, np.ones(count, np.float32)
+
+
+def iter_samples(files: str, config) -> Iterator[Tuple[int, float, np.ndarray, np.ndarray]]:
+    """Stream samples from ';'-separated files (reference configure.h:55)."""
+    for path in [p for p in files.split(";") if p]:
+        if config.reader_type == "bsparse":
+            yield from read_bsparse(path)
+        else:
+            weighted = config.reader_type == "weight"
+            with open(path) as f:
+                for line in f:
+                    parsed = parse_line(line, config.input_size, config.sparse,
+                                        weighted)
+                    if parsed is not None:
+                        yield parsed
+
+
+def batch_samples(samples: Sequence[Tuple[int, float, np.ndarray, np.ndarray]],
+                  config, minibatch_size: int) -> SampleBatch:
+    """Pad a list of parsed samples into one static-shape SampleBatch."""
+    n = len(samples)
+    B = minibatch_size
+    labels = np.zeros(B, np.int32)
+    weights = np.zeros(B, np.float32)   # padding weight 0 => no gradient
+    for i, (lab, w, _, _) in enumerate(samples):
+        labels[i], weights[i] = lab, w
+    if not config.sparse:
+        dense = np.zeros((B, config.input_size), np.float32)
+        for i, (_, _, _, vals) in enumerate(samples):
+            dense[i] = vals
+        return SampleBatch(labels, weights, dense=dense, count=n)
+    K = next_bucket(max((len(s[2]) for s in samples), default=1))
+    keys = np.zeros((B, K), np.int64)
+    vals = np.zeros((B, K), np.float32)
+    mask = np.zeros((B, K), np.float32)
+    for i, (_, _, k, v) in enumerate(samples):
+        keys[i, : len(k)] = k
+        vals[i, : len(k)] = v
+        mask[i, : len(k)] = 1.0
+    return SampleBatch(labels, weights, keys=keys, values=vals, mask=mask,
+                       count=n)
+
+
+@dataclass
+class Window:
+    """``sync_frequency`` minibatches + the unique keys they touch
+    (reference reader emits key sets per sync window, reader.h:45)."""
+
+    batches: List[SampleBatch]
+    keys: np.ndarray  # unique int64 keys (empty for dense)
+
+
+class WindowReader:
+    """Background thread parsing samples into Windows ahead of training
+    (reference SampleReader's parse thread, reader.cpp)."""
+
+    def __init__(self, files: str, config, sync_frequency: int = 1):
+        self._config = config
+        self._files = files
+        self._sync = max(1, sync_frequency)
+        cap = max(2, config.read_buffer_size //
+                  max(1, config.minibatch_size * self._sync))
+        self._queue: MtQueue[Window] = MtQueue()
+        self._cap = cap
+        self._space = threading.Semaphore(cap)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        cfg = self._config
+        batches: List[SampleBatch] = []
+        key_sets: List[np.ndarray] = []
+        pending: List = []
+        try:
+            for sample in iter_samples(self._files, cfg):
+                pending.append(sample)
+                if len(pending) == cfg.minibatch_size:
+                    batches.append(batch_samples(pending, cfg,
+                                                 cfg.minibatch_size))
+                    if cfg.sparse:
+                        key_sets.append(np.concatenate([s[2] for s in pending]))
+                    pending = []
+                    if len(batches) == self._sync:
+                        self._emit(batches, key_sets)
+                        batches, key_sets = [], []
+            if pending:
+                batches.append(batch_samples(pending, cfg, cfg.minibatch_size))
+                if cfg.sparse:
+                    key_sets.append(np.concatenate([s[2] for s in pending]))
+            if batches:
+                self._emit(batches, key_sets)
+        except Exception as exc:  # surface parse errors to the consumer
+            Log.Error("[logreg reader] %r", exc)
+        finally:
+            self._queue.Exit()
+
+    def _emit(self, batches, key_sets) -> None:
+        keys = (np.unique(np.concatenate(key_sets)) if key_sets
+                else np.empty(0, np.int64))
+        self._space.acquire()
+        self._queue.Push(Window(batches=list(batches), keys=keys))
+
+    def next_window(self) -> Optional[Window]:
+        ok, window = self._queue.Pop()
+        if not ok:
+            return None
+        self._space.release()
+        return window
+
+    def join(self) -> None:
+        self._thread.join()

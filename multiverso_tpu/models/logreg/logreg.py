@@ -1,0 +1,130 @@
+"""LogReg driver: config-file-driven train/test loop.
+
+Behavioral equivalent of reference
+Applications/LogisticRegression/src/logreg.cpp: construct from a config
+file (main.cpp:8-12), ``Train`` streams windows from the async reader
+through the model (logreg.cpp:40-87, with per-``show_time_per_sample``
+throughput logging), ``Test`` scores the test file and writes predictions
+(logreg.cpp:121-172), ``SaveModel`` persists the weights.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Union
+
+import numpy as np
+
+from multiverso_tpu.models.logreg.configure import Configure
+from multiverso_tpu.models.logreg.data import (WindowReader, batch_samples,
+                                               iter_samples)
+from multiverso_tpu.models.logreg.model import Model
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.timer import Timer
+
+
+class LogReg:
+    def __init__(self, config: Union[str, Configure]):
+        if isinstance(config, str):
+            config = Configure.from_file(config)
+        config.finalize()
+        self.config = config
+        self._owns_mv = False
+        if config.use_ps:
+            import multiverso_tpu as mv
+            from multiverso_tpu.zoo import Zoo
+            if not Zoo.Get().started:
+                mv.MV_Init([])
+                self._owns_mv = True
+        self.model = Model.Get(config)
+        if config.init_model_file and not config.use_ps:
+            self.model.Load(config.init_model_file)
+
+    def Train(self, train_file: Optional[str] = None) -> float:
+        """One full training run (config.train_epoch epochs); returns the
+        final epoch's average train loss per sample."""
+        cfg = self.config
+        files = train_file or cfg.train_file
+        avg_loss = 0.0
+        for epoch in range(cfg.train_epoch):
+            reader = WindowReader(files, cfg, cfg.sync_frequency)
+            timer = Timer()
+            samples = 0
+            loss_sum = 0.0
+            next_report = cfg.show_time_per_sample
+            while True:
+                window = reader.next_window()
+                if window is None:
+                    break
+                loss_sum += self.model.train_window(window)
+                samples += sum(b.count for b in window.batches)
+                if samples >= next_report:
+                    Log.Info("[logreg] epoch %d: %d samples, "
+                             "%.1f samples/s, avg loss %.5f", epoch, samples,
+                             samples / max(timer.elapse(), 1e-9),
+                             loss_sum / max(samples, 1))
+                    next_report += cfg.show_time_per_sample
+                    self.model.DisplayTime()
+            avg_loss = loss_sum / max(samples, 1)
+            Log.Info("[logreg] epoch %d done: %d samples, avg loss %.5f, "
+                     "%.2fs", epoch, samples, avg_loss, timer.elapse())
+        if cfg.use_ps:
+            import multiverso_tpu as mv
+            mv.MV_Barrier()
+        if cfg.output_model_file:
+            self.SaveModel()
+        return avg_loss
+
+    def Test(self, test_file: Optional[str] = None) -> float:
+        """Score the test set; writes per-sample predictions to
+        config.output_file; returns accuracy (reference logreg.cpp:121-172
+        counts correct predictions)."""
+        cfg = self.config
+        files = test_file or cfg.test_file
+        if not files:
+            Log.Info("[logreg] no test file; skip test")
+            return 0.0
+        correct = 0
+        total = 0
+        out_lines = []
+        pending = []
+        for sample in iter_samples(files, cfg):
+            pending.append(sample)
+            if len(pending) == cfg.minibatch_size:
+                correct_, total_ = self._score(pending, out_lines)
+                correct += correct_
+                total += total_
+                pending = []
+        if pending:
+            correct_, total_ = self._score(pending, out_lines)
+            correct += correct_
+            total += total_
+        if cfg.output_file:
+            with open(cfg.output_file, "w") as f:
+                f.write("\n".join(out_lines) + "\n")
+        acc = correct / max(total, 1)
+        Log.Info("[logreg] test: %d/%d correct (%.4f)", correct, total, acc)
+        return acc
+
+    def _score(self, pending, out_lines):
+        cfg = self.config
+        batch = batch_samples(pending, cfg, cfg.minibatch_size)
+        preds = self.model.predict_batch(batch)
+        labels = batch.labels[: batch.count]
+        if cfg.output_size > 1:
+            hard = np.argmax(preds, axis=1)
+        else:
+            hard = (preds[:, 0] >= 0.5).astype(np.int32)
+        for p, h in zip(preds, hard):
+            out_lines.append(" ".join(f"{x:.6f}" for x in np.atleast_1d(p))
+                             + f" -> {h}")
+        return int(np.sum(hard == labels)), int(batch.count)
+
+    def SaveModel(self, path: Optional[str] = None) -> None:
+        self.model.Store(path or self.config.output_model_file)
+
+    def close(self) -> None:
+        if self._owns_mv:
+            import multiverso_tpu as mv
+            mv.MV_ShutDown()
+            self._owns_mv = False

@@ -1,0 +1,373 @@
+"""Local and parameter-server models for LogisticRegression.
+
+Behavioral equivalent of reference
+Applications/LogisticRegression/src/model/model.cpp (local minibatch
+train/update loop, factory at model.cpp:208) and ps_model.cpp (PS variant:
+push lr-scaled deltas per minibatch, pull every ``sync_frequency``
+minibatches, optional double-buffered pipelined pulls ps_model.cpp:228-259,
+server updater forced to sgd ps_model.cpp:24).
+
+TPU design
+----------
+* Local mode: the whole train step — forward, gradient, regularization,
+  lr-scaled subtraction — is ONE jit'd donated device computation; weights
+  never leave HBM during an epoch.
+* PS dense mode: weights live in an ArrayTable (flat, output-major like the
+  reference key layout); the worker trains on a device-resident cache and
+  pushes flat deltas asynchronously.
+* PS sparse mode: weights live in a row-sharded MatrixTable; the reader's
+  per-window key sets drive row pulls; batch keys are remapped to
+  window-local indices so the jit'd sparse step sees a dense (R, out) row
+  block.
+* FTRL: (z, n) state rows; local mode keeps them on device, PS mode in two
+  KVTables keyed ``feature*output_size + o``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.models.logreg import objective as obj
+from multiverso_tpu.models.logreg.data import SampleBatch, Window
+from multiverso_tpu.models.logreg.updater import create_client_updater
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils.log import CHECK, Log
+from multiverso_tpu.utils.timer import Timer
+
+
+class Model:
+    """Base/local model (reference model/model.h + model.cpp)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.updater = create_client_updater(config)
+        self.ftrl = config.objective_type == "ftrl"
+        self.computation_time_ms = 0.0
+        self.compute_count = 0
+        self._timer = Timer()
+        # predict fns are cached here and reused for every test minibatch —
+        # building them per call would recompile per batch
+        self._dense_predict = obj.make_dense_predict_fn(config)
+        self._sparse_predict = obj.make_sparse_predict_fn(config)
+        if self.ftrl:
+            self._ftrl_grad = obj.make_ftrl_grad_fn(config)
+            self._ftrl_weights = obj.make_ftrl_weights_fn(config)
+            self.z = jnp.zeros((config.input_size, config.output_size),
+                               jnp.float32)
+            self.n = jnp.zeros((config.input_size, config.output_size),
+                               jnp.float32)
+        elif config.sparse:
+            self._sparse_grad = obj.make_sparse_grad_fn(config)
+            self.W = jnp.zeros((config.input_size, config.output_size),
+                               jnp.float32)
+        else:
+            self._dense_grad = obj.make_dense_grad_fn(config)
+            self.W = jnp.zeros((config.input_size, config.output_size),
+                               jnp.float32)
+        self._build_local_steps()
+
+    # -- factory (reference model.cpp:208) ----------------------------------
+
+    @staticmethod
+    def Get(config) -> "Model":
+        if config.use_ps:
+            return PSModel(config)
+        return Model(config)
+
+    def _build_local_steps(self):
+        cfg = self.config
+
+        if self.ftrl:
+            def ftrl_step(z, n, keys, values, mask, labels, weights):
+                dz, dn, loss = self._ftrl_grad(z, n, keys, values, mask,
+                                               labels, weights)
+                return z - dz, n - dn, loss
+
+            self._ftrl_step = jax.jit(ftrl_step, donate_argnums=(0, 1))
+            return
+
+        if cfg.sparse:
+            def sparse_step(W, keys, values, mask, labels, weights, lr):
+                grad, loss = self._sparse_grad(W, keys, values, mask, labels,
+                                               weights)
+                return W - lr * grad, loss
+
+            self._sparse_step = jax.jit(sparse_step, donate_argnums=(0,))
+        else:
+            def dense_step(W, X, labels, weights, lr):
+                grad, loss = self._dense_grad(W, X, labels, weights)
+                return W - lr * grad, loss
+
+            self._dense_step = jax.jit(dense_step, donate_argnums=(0,))
+
+    # -- training -----------------------------------------------------------
+
+    def train_window(self, window: Window) -> float:
+        """Train on one window of minibatches; returns summed train loss
+        (reference Model::Update, model.cpp:64-110)."""
+        loss_total = 0.0
+        for batch in window.batches:
+            self._timer.Start()
+            lr = jnp.float32(self.updater.learning_rate())
+            if self.ftrl:
+                self.z, self.n, loss = self._ftrl_step(
+                    self.z, self.n, jnp.asarray(batch.keys.astype(np.int32)),
+                    jnp.asarray(batch.values), jnp.asarray(batch.mask),
+                    jnp.asarray(batch.labels), jnp.asarray(batch.weights))
+            elif self.config.sparse:
+                self.W, loss = self._sparse_step(
+                    self.W, jnp.asarray(batch.keys.astype(np.int32)),
+                    jnp.asarray(batch.values), jnp.asarray(batch.mask),
+                    jnp.asarray(batch.labels), jnp.asarray(batch.weights), lr)
+            else:
+                self.W, loss = self._dense_step(
+                    self.W, jnp.asarray(batch.dense),
+                    jnp.asarray(batch.labels), jnp.asarray(batch.weights), lr)
+            self.updater.tick()
+            loss_total += float(loss)
+            self.computation_time_ms += self._timer.elapse_ms()
+            self.compute_count += 1
+        return loss_total
+
+    # -- inference ----------------------------------------------------------
+
+    def weights(self) -> np.ndarray:
+        """(input, output) weight matrix (derived for FTRL)."""
+        if self.ftrl:
+            return np.asarray(self._ftrl_weights(self.z, self.n))
+        return np.asarray(self.W)
+
+    def predict_batch(self, batch: SampleBatch) -> np.ndarray:
+        W = jnp.asarray(self.weights())
+        if batch.sparse:
+            return np.asarray(self._sparse_predict(
+                W, jnp.asarray(batch.keys.astype(np.int32)),
+                jnp.asarray(batch.values),
+                jnp.asarray(batch.mask)))[: batch.count]
+        return np.asarray(self._dense_predict(
+            W, jnp.asarray(batch.dense)))[: batch.count]
+
+    def DisplayTime(self) -> None:
+        if self.compute_count:
+            Log.Info("average computation time: %.3fms",
+                     self.computation_time_ms / self.compute_count)
+            self.computation_time_ms = 0.0
+            self.compute_count = 0
+
+    # -- checkpoint (binary: dims header + output-major f32 weights,
+    #    matching the reference's flat output-major key layout) -------------
+
+    def Store(self, path: str) -> None:
+        W = self.weights()
+        with open(path, "wb") as f:
+            f.write(struct.pack("<qq", self.config.input_size,
+                                self.config.output_size))
+            f.write(np.ascontiguousarray(W.T, np.float32).tobytes())
+
+    def Load(self, path: str) -> None:
+        with open(path, "rb") as f:
+            n_in, n_out = struct.unpack("<qq", f.read(16))
+            CHECK(n_in == self.config.input_size and
+                  n_out == self.config.output_size, "model file shape mismatch")
+            flat = np.frombuffer(f.read(n_in * n_out * 4), np.float32)
+        W = flat.reshape(n_out, n_in).T.copy()
+        if self.ftrl:
+            Log.Error("FTRL warm-start from derived weights is lossy; "
+                      "starting z from scaled weights")
+            self.z = jnp.asarray(-W * (self.config.beta / self.config.alpha +
+                                       self.config.lambda2))
+            self.n = jnp.zeros_like(self.z)
+        else:
+            self.W = jnp.asarray(W)
+
+
+class PSModel(Model):
+    """Parameter-server model (reference model/ps_model.cpp)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        import multiverso_tpu as mv
+        self._mv = mv
+        # server-side rule is sgd (data -= delta); the client pre-scales
+        # (reference ps_model.cpp:24 forces updater_type=sgd)
+        if self.ftrl:
+            self.z_table = mv.MV_CreateTable(KVTableOption())
+            self.n_table = mv.MV_CreateTable(KVTableOption())
+        elif config.sparse:
+            self.table = mv.MV_CreateTable(MatrixTableOption(
+                num_rows=config.input_size, num_cols=config.output_size,
+                updater_type="sgd"))
+        else:
+            self.table = mv.MV_CreateTable(ArrayTableOption(
+                size=config.input_size * config.output_size,
+                updater_type="sgd"))
+        self._batch_count = 0
+        self._pending_get: Optional[int] = None   # pipelined pull handle
+        if config.init_model_file:
+            self.Load(config.init_model_file)
+            self._push_initial_model()
+        if not config.sparse and not self.ftrl:
+            self._pull_dense()
+
+    # -- dense path ---------------------------------------------------------
+
+    def _pull_dense(self) -> None:
+        flat = self.table.Get()
+        self.W = jnp.asarray(flat.reshape(self.config.output_size,
+                                          self.config.input_size).T)
+
+    def _push_initial_model(self) -> None:
+        """Warm start: worker 0 pushes loaded weights as a delta
+        (reference ps_model.cpp:117-152)."""
+        if self._mv.MV_WorkerId() != 0:
+            return
+        if self.ftrl:
+            # push the Load()-reconstructed (z, n) state so PS training
+            # actually starts from the warm-started model (still lossy —
+            # n restarts at zero — but not silently dropped)
+            flat = self._flat_keys(np.arange(self.config.input_size,
+                                             dtype=np.int64))
+            self.z_table.Add(flat, np.asarray(self.z, np.float32).ravel())
+            self.n_table.Add(flat, np.asarray(self.n, np.float32).ravel())
+            return
+        W = self.weights()
+        flat = np.ascontiguousarray(-W.T, np.float32).ravel()  # server does -=
+        if self.config.sparse:
+            self.table.AddRows(np.arange(self.config.input_size,
+                                         dtype=np.int32),
+                               -W.astype(np.float32))
+        else:
+            self.table.Add(flat)
+
+    def train_window(self, window: Window) -> float:
+        if self.ftrl:
+            return self._train_window_ftrl(window)
+        if self.config.sparse:
+            return self._train_window_sparse(window)
+        return self._train_window_dense(window)
+
+    def _train_window_dense(self, window: Window) -> float:
+        cfg = self.config
+        loss_total = 0.0
+        for batch in window.batches:
+            self._timer.Start()
+            lr = self.updater.learning_rate()
+            grad, loss = self._dense_grad(self.W, jnp.asarray(batch.dense),
+                                          jnp.asarray(batch.labels),
+                                          jnp.asarray(batch.weights))
+            delta = np.ascontiguousarray(
+                (lr * np.asarray(grad)).T, np.float32).ravel()
+            self.table.AddFireForget(delta)
+            self.updater.tick()
+            loss_total += float(loss)
+            self.computation_time_ms += self._timer.elapse_ms()
+            self.compute_count += 1
+            self._batch_count += 1
+            if self._batch_count % cfg.sync_frequency == 0:
+                self._sync_dense()
+        return loss_total
+
+    def _sync_dense(self) -> None:
+        """Pull the merged model (reference DoesNeedSync + PullModel,
+        ps_model.cpp:172-181; pipelined variant GetPipelineTable :228-259)."""
+        if self.config.pipeline:
+            if self._pending_get is not None:
+                flat = self.table.Wait(self._pending_get)
+                self.W = jnp.asarray(flat.reshape(self.config.output_size,
+                                                  self.config.input_size).T)
+            self._pending_get = self.table.GetAsyncHandle()
+        else:
+            self._pull_dense()
+
+    # -- sparse path ----------------------------------------------------------
+
+    def _train_window_sparse(self, window: Window) -> float:
+        cfg = self.config
+        keys = window.keys.astype(np.int32)
+        if keys.size == 0:
+            return 0.0
+        rows = self.table.GetRows(keys)          # (R, out)
+        W_rows = jnp.asarray(rows)
+        loss_total = 0.0
+        delta_rows = np.zeros_like(rows)
+        for batch in window.batches:
+            self._timer.Start()
+            lr = self.updater.learning_rate()
+            local_keys = np.searchsorted(keys, batch.keys).astype(np.int32)
+            grad, loss = self._sparse_grad(
+                W_rows, jnp.asarray(local_keys), jnp.asarray(batch.values),
+                jnp.asarray(batch.mask), jnp.asarray(batch.labels),
+                jnp.asarray(batch.weights))
+            delta_rows += lr * np.asarray(grad)
+            self.updater.tick()
+            loss_total += float(loss)
+            self.computation_time_ms += self._timer.elapse_ms()
+            self.compute_count += 1
+            self._batch_count += 1
+        self.table.AddFireForget(delta_rows, row_ids=keys)
+        return loss_total
+
+    # -- ftrl path ------------------------------------------------------------
+
+    def _flat_keys(self, keys: np.ndarray) -> np.ndarray:
+        out = self.config.output_size
+        return (keys[:, None] * out + np.arange(out)[None, :]).ravel()
+
+    def _train_window_ftrl(self, window: Window) -> float:
+        cfg = self.config
+        keys = window.keys
+        if keys.size == 0:
+            return 0.0
+        flat = self._flat_keys(keys)
+        out = cfg.output_size
+        z_rows = jnp.asarray(self.z_table.Get(flat).reshape(-1, out))
+        n_rows = jnp.asarray(self.n_table.Get(flat).reshape(-1, out))
+        loss_total = 0.0
+        dz_acc = np.zeros((len(keys), out), np.float32)
+        dn_acc = np.zeros((len(keys), out), np.float32)
+        for batch in window.batches:
+            self._timer.Start()
+            local_keys = np.searchsorted(keys, batch.keys).astype(np.int32)
+            dz, dn, loss = self._ftrl_grad(
+                z_rows, n_rows, jnp.asarray(local_keys),
+                jnp.asarray(batch.values), jnp.asarray(batch.mask),
+                jnp.asarray(batch.labels), jnp.asarray(batch.weights))
+            dz_acc += np.asarray(dz)
+            dn_acc += np.asarray(dn)
+            self.updater.tick()
+            loss_total += float(loss)
+            self.computation_time_ms += self._timer.elapse_ms()
+            self.compute_count += 1
+            self._batch_count += 1
+        # deltas are signed for subtraction; KV servers accumulate (+=),
+        # so push the negation (z += g - sigma*w, n += g^2)
+        self.n_table.Add(flat, (-dn_acc).ravel())
+        self.z_table.Add(flat, (-dz_acc).ravel())
+        return loss_total
+
+    def weights(self) -> np.ndarray:
+        if self.ftrl:
+            # derive from current server state over all features
+            flat = self._flat_keys(np.arange(self.config.input_size,
+                                             dtype=np.int64))
+            out = self.config.output_size
+            z = jnp.asarray(self.z_table.Get(flat).reshape(-1, out))
+            n = jnp.asarray(self.n_table.Get(flat).reshape(-1, out))
+            return np.asarray(self._ftrl_weights(z, n))
+        if self.config.sparse:
+            return self.table.Get()
+        self._flush()
+        return np.asarray(self.W)
+
+    def _flush(self) -> None:
+        if self._pending_get is not None:
+            self.table.Wait(self._pending_get)
+            self._pending_get = None
+        self._pull_dense()

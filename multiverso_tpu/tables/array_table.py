@@ -154,6 +154,13 @@ class ArrayWorker(WorkerTable):
                        option: Optional[AddOption] = None) -> int:
         return self.AddAsync({"values": np.asarray(delta, self.dtype)}, option)
 
+    def AddFireForget(self, delta: np.ndarray,
+                      option: Optional[AddOption] = None) -> None:
+        """Untracked async push — no Waiter/result bookkeeping (used by
+        training loops that push every minibatch and never wait)."""
+        self.AddAsync({"values": np.asarray(delta, self.dtype)}, option,
+                      track=False)
+
     def Partition(self, num_servers: Optional[int] = None) -> List[Tuple[int, int]]:
         """Pure sharding math, unit-testable without a server
         (reference Test/unittests/test_array.cpp:47-66 pattern)."""

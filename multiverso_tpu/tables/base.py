@@ -77,17 +77,27 @@ class WorkerTable:
     # -- request plumbing ---------------------------------------------------
 
     def _submit(self, msg_type: MsgType, payload: Dict[str, Any],
-                worker_id: Optional[int] = None) -> int:
+                worker_id: Optional[int] = None, track: bool = True) -> int:
         """Build + enqueue a request message; returns msg_id
-        (reference table.cpp:41-82 GetAsync/AddAsync)."""
+        (reference table.cpp:41-82 GetAsync/AddAsync).
+
+        ``track=False`` is fire-and-forget: no Waiter or result slot is
+        allocated, so high-rate async pushes (one per minibatch for a whole
+        training run) don't leak bookkeeping; server-side failures are still
+        logged by the engine. Per-table FIFO ordering at the server mailbox
+        guarantees a later tracked Get observes the push."""
         msg_id = next_msg_id()
-        waiter = Waiter(1)
-        with self._lock:
-            self._waiters[msg_id] = waiter
         src = self._zoo.current_worker_id() if worker_id is None else worker_id
-        msg = Message(msg_type=msg_type, table_id=self.table_id, msg_id=msg_id,
-                      src=src, payload=payload, waiter=waiter,
-                      on_reply=self._on_reply)
+        if track:
+            waiter = Waiter(1)
+            with self._lock:
+                self._waiters[msg_id] = waiter
+            msg = Message(msg_type=msg_type, table_id=self.table_id,
+                          msg_id=msg_id, src=src, payload=payload,
+                          waiter=waiter, on_reply=self._on_reply)
+        else:
+            msg = Message(msg_type=msg_type, table_id=self.table_id,
+                          msg_id=msg_id, src=src, payload=payload)
         self._zoo.SendToServer(msg)
         return msg_id
 
@@ -121,13 +131,14 @@ class WorkerTable:
                                 worker_id=opt.worker_id)
 
     def AddAsync(self, payload: Dict[str, Any],
-                 option: Optional[AddOption] = None) -> int:
+                 option: Optional[AddOption] = None,
+                 track: bool = True) -> int:
         with monitor_region("WORKER_TABLE_SYNC_ADD"):
             opt = option or AddOption(worker_id=self._zoo.current_worker_id())
             payload = dict(payload)
             payload["option"] = opt
             return self._submit(MsgType.Request_Add, payload,
-                                worker_id=opt.worker_id)
+                                worker_id=opt.worker_id, track=track)
 
 
 def CreateTable(option: TableOption):

@@ -250,6 +250,13 @@ class MatrixWorkerTable(WorkerTable):
         return self.AddAsync(
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)}, option)
 
+    def AddFireForget(self, deltas, row_ids=None, option=None) -> None:
+        """Untracked async push (no Waiter/result bookkeeping)."""
+        ids = None if row_ids is None else np.asarray(row_ids, np.int32)
+        self.AddAsync(
+            {"row_ids": ids, "values": np.asarray(deltas, self.dtype)},
+            option, track=False)
+
     # -- pure partition math (reference matrix_table.cpp:235-296) -----------
 
     def Partition(self, row_ids, num_servers: Optional[int] = None) -> Dict[int, list]:

@@ -31,6 +31,11 @@ import numpy as np
 
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.node import ROLE_NAMES, Node, Role
+# Imported for their flag registrations (sync, backup_worker_ratio,
+# updater_type, omp_threads) — they MUST be registered before Start()'s
+# ParseCMDFlags runs, or a first-call "-sync=true" would be silently dropped.
+import multiverso_tpu.sync.server  # noqa: F401
+import multiverso_tpu.updaters.base  # noqa: F401
 from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
 from multiverso_tpu.parallel.mesh import MeshContext
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,

@@ -1,0 +1,213 @@
+"""Tier-3 E2E tests for the LogisticRegression app.
+
+Counterparts of the reference's app-as-test usage (SURVEY.md §4.2: LR MNIST
+example run). Synthetic linearly-separable data; the invariant is high test
+accuracy + decreasing loss for every objective/mode combination.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.logreg.configure import Configure
+from multiverso_tpu.models.logreg.logreg import LogReg
+
+
+def _write_dense(path, X, y):
+    with open(path, "w") as f:
+        for row, lab in zip(X, y):
+            f.write(f"{lab} " + " ".join(f"{v:.5f}" for v in row) + "\n")
+
+
+def _write_sparse(path, X, y, weighted=False):
+    with open(path, "w") as f:
+        for row, lab in zip(X, y):
+            nz = np.nonzero(row)[0]
+            head = f"{lab}:1.0" if weighted else f"{lab}"
+            f.write(head + " " + " ".join(f"{k}:{row[k]:.5f}" for k in nz) + "\n")
+
+
+@pytest.fixture(scope="module")
+def dense_binary(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    d = tmp_path_factory.mktemp("lr_dense")
+    w_true = rng.normal(size=8)
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X @ w_true > 0).astype(int)
+    _write_dense(d / "train.data", X[:500], y[:500])
+    _write_dense(d / "test.data", X[500:], y[500:])
+    return d
+
+
+@pytest.fixture(scope="module")
+def sparse_binary(tmp_path_factory):
+    rng = np.random.default_rng(1)
+    d = tmp_path_factory.mktemp("lr_sparse")
+    dim = 50
+    w_true = rng.normal(size=dim)
+    X = rng.normal(size=(600, dim)).astype(np.float32)
+    X[rng.random(X.shape) < 0.7] = 0  # sparsify
+    y = (X @ w_true > 0).astype(int)
+    _write_sparse(d / "train.data", X[:500], y[:500])
+    _write_sparse(d / "test.data", X[500:], y[500:])
+    return d
+
+
+def _config(d, **kw):
+    cfg = Configure()
+    cfg.train_file = str(d / "train.data")
+    cfg.test_file = str(d / "test.data")
+    cfg.output_model_file = str(d / "model.bin")
+    cfg.output_file = str(d / "test.out")
+    cfg.show_time_per_sample = 10 ** 9
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+class TestLocalDense:
+    def test_sigmoid_learns(self, dense_binary):
+        cfg = _config(dense_binary, input_size=8, output_size=1,
+                      objective_type="sigmoid", updater_type="sgd",
+                      learning_rate=0.5, train_epoch=5)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        assert acc > 0.9
+        assert os.path.exists(cfg.output_model_file)
+        assert os.path.exists(cfg.output_file)
+
+    def test_softmax_multiclass(self, tmp_path):
+        rng = np.random.default_rng(2)
+        centers = np.array([[2, 0, 0], [0, 2, 0], [0, 0, 2]], np.float32)
+        X = np.vstack([rng.normal(c, 0.4, size=(150, 3)) for c in centers])
+        y = np.repeat([0, 1, 2], 150)
+        perm = rng.permutation(len(X))
+        X, y = X[perm].astype(np.float32), y[perm]
+        _write_dense(tmp_path / "train.data", X[:380], y[:380])
+        _write_dense(tmp_path / "test.data", X[380:], y[380:])
+        cfg = _config(tmp_path, input_size=3, output_size=3,
+                      objective_type="softmax", updater_type="sgd",
+                      learning_rate=0.5, train_epoch=6, regular_type="L2")
+        lr = LogReg(cfg)
+        lr.Train()
+        assert lr.Test() > 0.9
+
+    def test_model_store_load_roundtrip(self, dense_binary):
+        cfg = _config(dense_binary, input_size=8, output_size=1,
+                      objective_type="sigmoid", updater_type="sgd",
+                      learning_rate=0.5, train_epoch=3)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc1 = lr.Test()
+        cfg2 = _config(dense_binary, input_size=8, output_size=1,
+                       objective_type="sigmoid",
+                       init_model_file=cfg.output_model_file)
+        lr2 = LogReg(cfg2)
+        acc2 = lr2.Test()
+        assert abs(acc1 - acc2) < 1e-9
+
+
+class TestLocalSparse:
+    def test_sparse_sigmoid(self, sparse_binary):
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      sparse=True, objective_type="sigmoid",
+                      updater_type="sgd", learning_rate=0.5, train_epoch=5)
+        lr = LogReg(cfg)
+        lr.Train()
+        assert lr.Test() > 0.85
+
+    def test_ftrl(self, sparse_binary):
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      objective_type="ftrl", alpha=1.0, beta=1.0,
+                      lambda1=0.01, lambda2=0.01, train_epoch=8)
+        lr = LogReg(cfg)
+        lr.Train()
+        assert lr.Test() > 0.85
+
+    def test_weight_reader(self, tmp_path):
+        rng = np.random.default_rng(3)
+        w_true = rng.normal(size=10)
+        X = rng.normal(size=(200, 10)).astype(np.float32)
+        y = (X @ w_true > 0).astype(int)
+        _write_sparse(tmp_path / "train.data", X, y, weighted=True)
+        cfg = _config(tmp_path, input_size=10, output_size=1, sparse=True,
+                      reader_type="weight", objective_type="sigmoid",
+                      updater_type="sgd", train_epoch=3)
+        cfg.test_file = ""
+        lr = LogReg(cfg)
+        loss = lr.Train()
+        assert loss < 0.3
+
+
+class TestPSModes:
+    def test_ps_dense(self, dense_binary):
+        cfg = _config(dense_binary, input_size=8, output_size=1,
+                      use_ps=True, objective_type="sigmoid",
+                      updater_type="sgd", learning_rate=0.5, train_epoch=5,
+                      sync_frequency=1, pipeline=False)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        lr.close()
+        assert acc > 0.9
+
+    def test_ps_dense_pipelined(self, dense_binary):
+        cfg = _config(dense_binary, input_size=8, output_size=1,
+                      use_ps=True, objective_type="sigmoid",
+                      updater_type="sgd", learning_rate=0.5, train_epoch=5,
+                      sync_frequency=2, pipeline=True)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        lr.close()
+        assert acc > 0.85
+
+    def test_ps_sparse(self, sparse_binary):
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      use_ps=True, sparse=True, objective_type="sigmoid",
+                      updater_type="sgd", learning_rate=0.5, train_epoch=5)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        lr.close()
+        assert acc > 0.85
+
+    def test_ps_ftrl(self, sparse_binary):
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      use_ps=True, objective_type="ftrl", alpha=1.0,
+                      beta=1.0, lambda1=0.01, lambda2=0.01, train_epoch=8)
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        lr.close()
+        assert acc > 0.85
+
+
+class TestConfigFile:
+    def test_reference_style_config(self, dense_binary, tmp_path):
+        cfg_text = f"""# mnist-style config (reference example/mnist.config keys)
+input_size=8
+output_size=1
+objective_type=sigmoid
+regular_type=L2
+updater_type=sgd
+train_epoch=4
+sparse=false
+use_ps=false
+minibatch_size=20
+train_file={dense_binary}/train.data
+test_file={dense_binary}/test.data
+output_file={tmp_path}/test.out
+output_model_file={tmp_path}/model.bin
+learning_rate_coef=7e6
+regular_coef=0.0007
+"""
+        path = tmp_path / "run.config"
+        path.write_text(cfg_text)
+        cfg = Configure.from_file(str(path))
+        assert cfg.input_size == 8 and cfg.regular_type == "L2"
+        lr = LogReg(cfg)
+        lr.Train()
+        assert lr.Test() > 0.85
