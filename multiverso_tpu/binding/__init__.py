@@ -29,7 +29,6 @@ import numpy as np
 
 import multiverso_tpu as _core
 from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
-from multiverso_tpu.updaters import AddOption, GetOption
 
 
 def init(sync: bool = False, args: Optional[Sequence[str]] = None) -> None:

@@ -71,7 +71,11 @@ def parse_line(line: str, input_size: int, sparse: bool,
             k, _, v = tok.partition(":")
             keys.append(int(k))
             vals.append(float(v) if v else 1.0)
-        return label, weight, np.asarray(keys, np.int64), np.asarray(vals, np.float32)
+        key_arr = np.asarray(keys, np.int64)
+        if key_arr.size:
+            CHECK(0 <= key_arr.min() and key_arr.max() < input_size,
+                  f"sparse feature id out of range [0, {input_size})")
+        return label, weight, key_arr, np.asarray(vals, np.float32)
     vals = np.asarray([float(x) for x in parts[1:]], np.float32)
     CHECK(vals.size == input_size, f"dense sample width {vals.size} != input_size")
     return label, weight, _EMPTY_KEYS, vals  # dense batching never reads keys
@@ -153,6 +157,7 @@ class WindowReader:
         self._queue: MtQueue[Window] = MtQueue()
         self._cap = cap
         self._space = threading.Semaphore(cap)
+        self._error: Optional[Exception] = None
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -179,8 +184,10 @@ class WindowReader:
                     key_sets.append(np.concatenate([s[2] for s in pending]))
             if batches:
                 self._emit(batches, key_sets)
-        except Exception as exc:  # surface parse errors to the consumer
+        except Exception as exc:
             Log.Error("[logreg reader] %r", exc)
+            self._error = exc  # re-raised at the consumer: a parse error
+            # must fail the run, not truncate the dataset silently
         finally:
             self._queue.Exit()
 
@@ -193,6 +200,8 @@ class WindowReader:
     def next_window(self) -> Optional[Window]:
         ok, window = self._queue.Pop()
         if not ok:
+            if self._error is not None:
+                raise self._error
             return None
         self._space.release()
         return window

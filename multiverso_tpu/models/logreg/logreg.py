@@ -37,6 +37,15 @@ class LogReg:
                 mv.MV_Init([])
                 self._owns_mv = True
         self.model = Model.Get(config)
+        if config.use_ps:
+            # per-worker output files so concurrent workers don't clobber
+            # each other (reference ps_model.cpp:43-46 appends -<worker_id>)
+            import multiverso_tpu as mv
+            wid = mv.MV_WorkerId()
+            if config.output_model_file:
+                config.output_model_file += f"-{wid}"
+            if config.output_file:
+                config.output_file += f"-{wid}"
         if config.init_model_file and not config.use_ps:
             self.model.Load(config.init_model_file)
 
@@ -88,15 +97,16 @@ class LogReg:
         total = 0
         out_lines = []
         pending = []
+        W = self.model.weights()  # one pull for the whole test pass
         for sample in iter_samples(files, cfg):
             pending.append(sample)
             if len(pending) == cfg.minibatch_size:
-                correct_, total_ = self._score(pending, out_lines)
+                correct_, total_ = self._score(pending, out_lines, W)
                 correct += correct_
                 total += total_
                 pending = []
         if pending:
-            correct_, total_ = self._score(pending, out_lines)
+            correct_, total_ = self._score(pending, out_lines, W)
             correct += correct_
             total += total_
         if cfg.output_file:
@@ -106,10 +116,10 @@ class LogReg:
         Log.Info("[logreg] test: %d/%d correct (%.4f)", correct, total, acc)
         return acc
 
-    def _score(self, pending, out_lines):
+    def _score(self, pending, out_lines, W=None):
         cfg = self.config
         batch = batch_samples(pending, cfg, cfg.minibatch_size)
-        preds = self.model.predict_batch(batch)
+        preds = self.model.predict_batch(batch, W)
         labels = batch.labels[: batch.count]
         if cfg.output_size > 1:
             hard = np.argmax(preds, axis=1)

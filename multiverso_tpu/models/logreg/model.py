@@ -144,8 +144,11 @@ class Model:
             return np.asarray(self._ftrl_weights(self.z, self.n))
         return np.asarray(self.W)
 
-    def predict_batch(self, batch: SampleBatch) -> np.ndarray:
-        W = jnp.asarray(self.weights())
+    def predict_batch(self, batch: SampleBatch,
+                      W: Optional[np.ndarray] = None) -> np.ndarray:
+        """Pass a pre-pulled ``W`` when scoring many batches — for PS models
+        ``weights()`` is a full server pull per call."""
+        W = jnp.asarray(self.weights() if W is None else W)
         if batch.sparse:
             return np.asarray(self._sparse_predict(
                 W, jnp.asarray(batch.keys.astype(np.int32)),
@@ -289,7 +292,6 @@ class PSModel(Model):
     # -- sparse path ----------------------------------------------------------
 
     def _train_window_sparse(self, window: Window) -> float:
-        cfg = self.config
         keys = window.keys.astype(np.int32)
         if keys.size == 0:
             return 0.0

@@ -25,8 +25,7 @@ keys match the reference convention.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

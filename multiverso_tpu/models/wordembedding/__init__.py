@@ -1,0 +1,12 @@
+"""WordEmbedding application (word2vec CBOW/skip-gram, HS/negative sampling).
+
+TPU-first rebuild of reference Applications/WordEmbedding: streaming corpus
+reader into sentence DataBlocks, per-block parameter fetch from 4 matrix
+tables (+ KV word-count table), batched jit'd training kernels replacing
+the per-sample dot/axpy loops (reference wordembedding.cpp:58-160), delta
+push-back, block pipeline, and word2vec-format embedding export.
+"""
+
+from multiverso_tpu.models.wordembedding.option import Option  # noqa: F401
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary  # noqa: F401
+from multiverso_tpu.models.wordembedding.distributed import DistributedWordEmbedding  # noqa: F401

@@ -1,0 +1,131 @@
+"""App-level communicator: the WordEmbedding parameter tables.
+
+Behavioral equivalent of reference
+Applications/WordEmbedding/src/communicator.h/.cpp: owns 4 matrix tables —
+input embeddings, output embeddings, and (when AdaGrad) the two
+sum-of-squared-gradient tables — plus the int64 KV word-count table
+(communicator.cpp:17-33, table ids constant.h:16-20). ``RequestParameter``
+fetches the block's touched rows (communicator.cpp:117); ``AddDeltaParameter``
+pushes back ``trained - fetched`` (communicator.cpp:157-206) so concurrent
+workers' progress merges additively on the default (+=) server updater.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding.model import TrainState, init_embedding
+from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+
+WORD_COUNT_KEY = 0
+
+
+class Communicator:
+    def __init__(self, option, vocab_size: int):
+        self.opt = option
+        self.vocab_size = vocab_size
+        dim = option.embedding_size
+        seed = option.seed
+        # output-embedding rows: HS uses vocab_size-1 inner nodes but we
+        # allocate vocab_size for both modes like the reference
+        self.input_table = mv.MV_CreateTable(MatrixTableOption(
+            num_rows=vocab_size, num_cols=dim,
+            initializer=lambda shape: init_embedding(shape[0], shape[1], seed)))
+        self.output_table = mv.MV_CreateTable(MatrixTableOption(
+            num_rows=vocab_size, num_cols=dim))  # zeros like word2vec syn1
+        self.ie_g2_table = None
+        self.eo_g2_table = None
+        if option.use_adagrad:
+            self.ie_g2_table = mv.MV_CreateTable(MatrixTableOption(
+                num_rows=vocab_size, num_cols=dim))
+            self.eo_g2_table = mv.MV_CreateTable(MatrixTableOption(
+                num_rows=vocab_size, num_cols=dim))
+        self.word_count_table = mv.MV_CreateTable(KVTableOption(dtype=np.int64))
+
+    # -- parameter movement -------------------------------------------------
+
+    def request_parameter(self, input_rows: np.ndarray,
+                          output_rows: np.ndarray) -> Tuple[TrainState, dict]:
+        """Fetch the block's rows; returns (device state, fetched host copy)."""
+        ie = self.input_table.GetRows(input_rows)
+        eo = self.output_table.GetRows(output_rows)
+        fetched = {"ie": ie, "eo": eo}
+        ie_g2 = eo_g2 = None
+        if self.opt.use_adagrad:
+            ie_g2 = self.ie_g2_table.GetRows(input_rows)
+            eo_g2 = self.eo_g2_table.GetRows(output_rows)
+            fetched["ie_g2"] = ie_g2
+            fetched["eo_g2"] = eo_g2
+        state = TrainState(
+            ie=jnp.asarray(ie), eo=jnp.asarray(eo),
+            ie_g2=None if ie_g2 is None else jnp.asarray(ie_g2),
+            eo_g2=None if eo_g2 is None else jnp.asarray(eo_g2))
+        return state, fetched
+
+    def request_parameter_async(self, input_rows: np.ndarray,
+                                output_rows: np.ndarray) -> dict:
+        """Issue async row gets for the NEXT block (pipeline prefetch,
+        reference distributed_wordembedding.cpp:203-215)."""
+        handles = {
+            "ie": self.input_table.GetAsyncHandle(input_rows),
+            "eo": self.output_table.GetAsyncHandle(output_rows),
+        }
+        if self.opt.use_adagrad:
+            handles["ie_g2"] = self.ie_g2_table.GetAsyncHandle(input_rows)
+            handles["eo_g2"] = self.eo_g2_table.GetAsyncHandle(output_rows)
+        return handles
+
+    def wait_parameter(self, handles: dict) -> Tuple[TrainState, dict]:
+        fetched = {"ie": self.input_table.Wait(handles["ie"]),
+                   "eo": self.output_table.Wait(handles["eo"])}
+        if self.opt.use_adagrad:
+            fetched["ie_g2"] = self.ie_g2_table.Wait(handles["ie_g2"])
+            fetched["eo_g2"] = self.eo_g2_table.Wait(handles["eo_g2"])
+        state = TrainState(
+            ie=jnp.asarray(fetched["ie"]), eo=jnp.asarray(fetched["eo"]),
+            ie_g2=(jnp.asarray(fetched["ie_g2"])
+                   if self.opt.use_adagrad else None),
+            eo_g2=(jnp.asarray(fetched["eo_g2"])
+                   if self.opt.use_adagrad else None))
+        return state, fetched
+
+    def add_delta_parameter(self, state: TrainState, fetched: dict,
+                            input_rows: np.ndarray,
+                            output_rows: np.ndarray) -> None:
+        """Push trained - fetched (reference AddDeltaParameter,
+        communicator.cpp:157-206)."""
+        self.input_table.AddFireForget(
+            np.asarray(state.ie) - fetched["ie"], row_ids=input_rows)
+        self.output_table.AddFireForget(
+            np.asarray(state.eo) - fetched["eo"], row_ids=output_rows)
+        if self.opt.use_adagrad:
+            self.ie_g2_table.AddFireForget(
+                np.asarray(state.ie_g2) - fetched["ie_g2"],
+                row_ids=input_rows)
+            self.eo_g2_table.AddFireForget(
+                np.asarray(state.eo_g2) - fetched["eo_g2"],
+                row_ids=output_rows)
+
+    # -- word count (lr decay coordination) ---------------------------------
+
+    def add_word_count(self, count: int) -> None:
+        self.word_count_table.Add([WORD_COUNT_KEY], [count])
+
+    def get_word_count(self) -> int:
+        return int(self.word_count_table.Get([WORD_COUNT_KEY])[0])
+
+    # -- export -------------------------------------------------------------
+
+    def pull_embeddings(self, batch: int = 4096) -> np.ndarray:
+        """Whole input-embedding matrix via batched row gets
+        (reference SaveEmbedding, distributed_wordembedding.cpp:263-306)."""
+        rows = []
+        for start in range(0, self.vocab_size, batch):
+            ids = np.arange(start, min(start + batch, self.vocab_size),
+                            dtype=np.int32)
+            rows.append(self.input_table.GetRows(ids))
+        return np.vstack(rows)

@@ -1,0 +1,215 @@
+"""Corpus reader, DataBlocks, and batched training-pair construction.
+
+Behavioral equivalent of reference Applications/WordEmbedding/src/reader.*
+(tokenize + vocab lookup, MAX_SENTENCE_LENGTH clipping), data_block.*
+(sentences + the block's input/output node sets) and block_queue.* (the
+loader-thread -> trainer-thread handoff).
+
+TPU-first: a DataBlock eagerly expands into padded *pair batches* — the
+static-shape tensors the jit'd kernel consumes:
+
+  skip-gram: inputs (P, 1); CBOW: inputs (P, 2*window) + mask
+  NEG: outputs (P, 1+negative) with labels [1, 0...]; negatives pre-sampled
+  HS:  outputs (P, max_code) = Huffman points, labels = 1 - code
+       (folding the reference's ``error = 1 - label - f`` into ``label - f``)
+
+The block's unique touched rows (inputs + outputs) form its vocab —
+exactly the row set the communicator fetches (reference PrepareData /
+RequestParameter, communicator.cpp:117).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.sampler import Sampler
+from multiverso_tpu.parallel.mesh import next_bucket
+from multiverso_tpu.utils.mt_queue import MtQueue
+
+MAX_SENTENCE_LENGTH = 1000  # reference constant.h kMaxSentenceLength
+
+
+@dataclass
+class PairBatch:
+    """Static-shape batch of training pairs."""
+
+    inputs: np.ndarray        # (P, Cin) int32 local or global row ids
+    input_mask: np.ndarray    # (P, Cin) float32
+    outputs: np.ndarray       # (P, Cout) int32
+    labels: np.ndarray        # (P, Cout) float32 (already HS-folded)
+    output_mask: np.ndarray   # (P, Cout) float32
+    count: int                # true number of pairs
+
+
+@dataclass
+class DataBlock:
+    """Sentences + derived pair batches + touched row sets."""
+
+    batches: List[PairBatch] = field(default_factory=list)
+    input_rows: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
+    output_rows: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
+    word_count: int = 0
+
+
+def sentences_from_file(path: str, dictionary: Dictionary) -> Iterator[Tuple[np.ndarray, int]]:
+    """Tokenize -> word ids; yields (ids, raw_token_count) per sentence
+    (line), clipped to MAX_SENTENCE_LENGTH (reference reader.cpp)."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            tokens = line.split()
+            if not tokens:
+                continue
+            ids = [dictionary.GetWordIdx(t) for t in tokens]
+            ids = np.asarray([i for i in ids if i >= 0], np.int32)
+            for start in range(0, len(ids), MAX_SENTENCE_LENGTH):
+                chunk = ids[start: start + MAX_SENTENCE_LENGTH]
+                if chunk.size:
+                    yield chunk, len(chunk)
+
+
+class PairGenerator:
+    """Expands sentences into padded pair batches."""
+
+    def __init__(self, option, dictionary: Dictionary,
+                 sampler: Sampler, huffman: Optional[HuffmanEncoder]):
+        self.opt = option
+        self.dict = dictionary
+        self.sampler = sampler
+        self.huffman = huffman
+        if option.hs and huffman is None:
+            raise ValueError("hs mode needs a HuffmanEncoder")
+
+    def pairs_from_sentence(self, ids: np.ndarray):
+        """-> list of (input_ids list, output_ids list, labels list)."""
+        opt = self.opt
+        keep = self.sampler.KeepMask(ids, opt.sample)
+        ids = ids[keep]
+        n = len(ids)
+        if n < 2:
+            return []
+        windows = self.sampler.rand_windows(n, opt.window_size)
+        out = []
+        for i in range(n):
+            b = windows[i]
+            lo, hi = max(0, i - b), min(n, i + b + 1)
+            context = [int(ids[j]) for j in range(lo, hi) if j != i]
+            if not context:
+                continue
+            center = int(ids[i])
+            if opt.hs:
+                info = self.huffman.GetLabelInfo(center)
+                outputs = list(info.points)
+                labels = [1 - c for c in info.codes]  # fold (1-label-f)
+            else:
+                negs = self.sampler.SampleNegatives(opt.negative_num)
+                outputs = [center] + [int(x) for x in negs]
+                labels = [1.0] + [0.0] * opt.negative_num
+            if opt.cbow:
+                out.append((context, outputs, labels))
+            else:
+                # skip-gram: each context word is an input pair
+                for c in context:
+                    out.append(([c], outputs, labels))
+        return out
+
+    def batch_pairs(self, pairs, batch_size: int) -> List[PairBatch]:
+        opt = self.opt
+        cin_max = (2 * opt.window_size) if opt.cbow else 1
+        if opt.hs:
+            cout_max = self.huffman.max_code_length
+        else:
+            cout_max = 1 + opt.negative_num
+        batches = []
+        for start in range(0, len(pairs), batch_size):
+            chunk = pairs[start: start + batch_size]
+            P = batch_size
+            inputs = np.zeros((P, cin_max), np.int32)
+            imask = np.zeros((P, cin_max), np.float32)
+            outputs = np.zeros((P, cout_max), np.int32)
+            labels = np.zeros((P, cout_max), np.float32)
+            omask = np.zeros((P, cout_max), np.float32)
+            for i, (ins, outs, labs) in enumerate(chunk):
+                inputs[i, : len(ins)] = ins
+                imask[i, : len(ins)] = 1.0
+                outputs[i, : len(outs)] = outs
+                labels[i, : len(labs)] = labs
+                omask[i, : len(outs)] = 1.0
+            batches.append(PairBatch(inputs, imask, outputs, labels, omask,
+                                     count=len(chunk)))
+        return batches
+
+    def make_block(self, sentences: List[np.ndarray],
+                   word_count: int) -> DataBlock:
+        pairs = []
+        for ids in sentences:
+            pairs.extend(self.pairs_from_sentence(ids))
+        batches = self.batch_pairs(pairs, self.opt.pair_batch_size)
+        if batches:
+            input_rows = np.unique(np.concatenate(
+                [(b.inputs[b.input_mask > 0]) for b in batches]))
+            output_rows = np.unique(np.concatenate(
+                [(b.outputs[b.output_mask > 0]) for b in batches]))
+        else:
+            input_rows = np.empty(0, np.int32)
+            output_rows = np.empty(0, np.int32)
+        return DataBlock(batches=batches,
+                         input_rows=input_rows.astype(np.int32),
+                         output_rows=output_rows.astype(np.int32),
+                         word_count=word_count)
+
+
+class BlockQueue:
+    """Loader thread -> trainer handoff (reference block_queue.h)."""
+
+    def __init__(self, capacity: int = 2):
+        self._q: MtQueue[DataBlock] = MtQueue()
+        self._space = threading.Semaphore(capacity)
+
+    def push(self, block: DataBlock) -> None:
+        self._space.acquire()
+        self._q.Push(block)
+
+    def pop(self) -> Optional[DataBlock]:
+        ok, block = self._q.Pop()
+        if not ok:
+            return None
+        self._space.release()
+        return block
+
+    def close(self) -> None:
+        self._q.Exit()
+
+
+def start_loader(option, dictionary: Dictionary, generator: PairGenerator,
+                 queue: BlockQueue, epochs: int) -> threading.Thread:
+    """Background loader: stream the corpus into DataBlocks
+    (reference distributed_wordembedding.cpp:33-57 loader thread)."""
+
+    def run():
+        try:
+            for _ in range(epochs):
+                sentences: List[np.ndarray] = []
+                n_words = 0
+                n_bytes = 0
+                for ids, raw_count in sentences_from_file(option.train_file,
+                                                          dictionary):
+                    sentences.append(ids)
+                    n_words += raw_count
+                    n_bytes += raw_count * 8
+                    if n_bytes >= option.data_block_size:
+                        queue.push(generator.make_block(sentences, n_words))
+                        sentences, n_words, n_bytes = [], 0, 0
+                if sentences:
+                    queue.push(generator.make_block(sentences, n_words))
+        finally:
+            queue.close()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,213 @@
+"""DistributedWordEmbedding driver.
+
+Behavioral equivalent of reference
+Applications/WordEmbedding/src/distributed_wordembedding.h/.cpp: Run ->
+Train -> per-block loop (loader thread fills a BlockQueue; each block:
+fetch params for the block vocab, train all pairs, push deltas; optional
+pipeline prefetching the NEXT block's params while training the current —
+distributed_wordembedding.cpp:147-252), words/sec logging (trainer.cpp:45-49),
+and rank-0 embedding export in word2vec text/binary format (:263-306).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Optional
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding.communicator import Communicator
+from multiverso_tpu.models.wordembedding.data import (BlockQueue, DataBlock,
+                                                      PairGenerator,
+                                                      start_loader)
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.model import (decayed_lr,
+                                                       make_train_step)
+from multiverso_tpu.models.wordembedding.option import Option
+from multiverso_tpu.models.wordembedding.sampler import Sampler
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.timer import Timer
+
+
+class DistributedWordEmbedding:
+    def __init__(self, option: Option):
+        self.opt = option
+        self.dictionary: Optional[Dictionary] = None
+        self.huffman: Optional[HuffmanEncoder] = None
+        self.sampler: Optional[Sampler] = None
+        self.comm: Optional[Communicator] = None
+        self._owns_mv = False
+        self.total_loss = 0.0
+        self.total_pairs = 0
+
+    # -- setup --------------------------------------------------------------
+
+    def prepare(self) -> None:
+        opt = self.opt
+        stop = set()
+        if opt.stopwords and opt.sw_file:
+            with open(opt.sw_file, encoding="utf-8") as f:
+                stop = set(f.read().split())
+        if opt.read_vocab_file:
+            self.dictionary = Dictionary.load_vocab(opt.read_vocab_file, stop)
+        else:
+            self.dictionary = Dictionary(stop)
+            self.dictionary.build_from_corpus(opt.train_file)
+        self.dictionary.RemoveWordsLessThan(max(opt.min_count, 1))
+        if self.dictionary.Size() == 0:
+            raise ValueError("empty vocabulary after min_count pruning")
+        if opt.total_words <= 0:
+            opt.total_words = self.dictionary.WordCount()
+        counts = self.dictionary.counts()
+        self.sampler = Sampler(counts, seed=opt.seed)
+        if opt.hs:
+            self.huffman = HuffmanEncoder()
+            self.huffman.BuildFromTermFrequency(counts)
+        from multiverso_tpu.zoo import Zoo
+        if not Zoo.Get().started:
+            mv.MV_Init([])
+            self._owns_mv = True
+        self.comm = Communicator(opt, self.dictionary.Size())
+
+    # -- training -----------------------------------------------------------
+
+    def train(self) -> float:
+        """Returns average pair loss of the run."""
+        opt = self.opt
+        generator = PairGenerator(opt, self.dictionary, self.sampler,
+                                  self.huffman)
+        queue = BlockQueue(capacity=3 if opt.is_pipeline else 1)
+        loader = start_loader(opt, self.dictionary, generator, queue,
+                              opt.epoch)
+        step = make_train_step(opt.use_adagrad)
+        timer = Timer()
+        words_done = 0
+        self.total_loss = 0.0
+        self.total_pairs = 0
+
+        current = queue.pop()
+        prefetch = None
+        next_block: Optional[DataBlock] = None
+        while current is not None:
+            if opt.is_pipeline:
+                next_block = queue.pop()
+                if next_block is not None and next_block.batches:
+                    prefetch = self.comm.request_parameter_async(
+                        next_block.input_rows, next_block.output_rows)
+            loss, pairs = self._train_block(current, step)
+            self.total_loss += loss
+            self.total_pairs += pairs
+            words_done += current.word_count
+            self.comm.add_word_count(current.word_count)
+            rate = words_done / max(timer.elapse(), 1e-9)
+            Log.Info("[wordembedding] %d words (%.0f words/s), "
+                     "avg pair loss %.4f, lr %.5f", words_done, rate,
+                     self.total_loss / max(self.total_pairs, 1),
+                     self._current_lr())
+            if opt.is_pipeline:
+                if next_block is not None and next_block.batches \
+                        and prefetch is not None:
+                    next_block._prefetched = self.comm.wait_parameter(prefetch)
+                current, prefetch = next_block, None
+            else:
+                current = queue.pop()
+        loader.join()
+        return self.total_loss / max(self.total_pairs, 1)
+
+    def _current_lr(self) -> float:
+        opt = self.opt
+        if opt.use_adagrad:
+            return opt.init_learning_rate
+        return decayed_lr(opt.init_learning_rate, self.comm.get_word_count(),
+                          opt.total_words, opt.epoch)
+
+    def _train_block(self, block: DataBlock, step) -> tuple:
+        if not block.batches:
+            return 0.0, 0
+        import jax.numpy as jnp
+        pre = getattr(block, "_prefetched", None)
+        if pre is not None:
+            state, fetched = pre
+        else:
+            state, fetched = self.comm.request_parameter(block.input_rows,
+                                                         block.output_rows)
+        # remap global row ids -> block-local indices
+        in_map = block.input_rows
+        out_map = block.output_rows
+        loss_sum = 0.0
+        pairs = 0
+        lr = jnp.float32(self._current_lr())
+        for batch in block.batches:
+            local_in = np.searchsorted(in_map, batch.inputs).astype(np.int32)
+            local_out = np.searchsorted(out_map, batch.outputs).astype(np.int32)
+            state, loss = step(state, jnp.asarray(local_in),
+                               jnp.asarray(batch.input_mask),
+                               jnp.asarray(local_out),
+                               jnp.asarray(batch.labels),
+                               jnp.asarray(batch.output_mask), lr)
+            loss_sum += float(loss)
+            pairs += batch.count
+        self.comm.add_delta_parameter(state, fetched, block.input_rows,
+                                      block.output_rows)
+        return loss_sum, pairs
+
+    # -- export (word2vec format) -------------------------------------------
+
+    def save_embeddings(self, path: Optional[str] = None) -> None:
+        path = path or self.opt.output_file
+        emb = self.comm.pull_embeddings()
+        words = self.dictionary.words()
+        if self.opt.output_binary:
+            with open(path, "wb") as f:
+                f.write(f"{len(words)} {self.opt.embedding_size}\n"
+                        .encode())
+                for w, row in zip(words, emb):
+                    f.write(w.encode("utf-8") + b" ")
+                    f.write(np.asarray(row, np.float32).tobytes())
+                    f.write(b"\n")
+        else:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(f"{len(words)} {self.opt.embedding_size}\n")
+                for w, row in zip(words, emb):
+                    f.write(w + " " + " ".join(f"{x:.6f}" for x in row) + "\n")
+        Log.Info("[wordembedding] saved %d x %d embeddings to %s",
+                 len(words), self.opt.embedding_size, path)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> float:
+        """Full job (reference Run, distributed_wordembedding.cpp:366)."""
+        self.prepare()
+        avg_loss = self.train()
+        mv.MV_Barrier()
+        if mv.MV_WorkerId() == 0:
+            self.save_embeddings()
+        return avg_loss
+
+    def close(self) -> None:
+        if self._owns_mv:
+            mv.MV_ShutDown()
+            self._owns_mv = False
+
+
+def main(argv=None) -> int:
+    import sys
+    argv = argv if argv is not None else sys.argv[1:]
+    opt = Option.parse_args(argv)
+    if not opt.train_file:
+        Log.Error("usage: python -m multiverso_tpu.models.wordembedding."
+                  "distributed -train_file corpus.txt [-size 100 ...]")
+        return 1
+    opt.print_args()
+    we = DistributedWordEmbedding(opt)
+    we.run()
+    we.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

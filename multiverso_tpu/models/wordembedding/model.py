@@ -1,0 +1,100 @@
+"""Batched word2vec training kernels.
+
+Behavioral equivalent of the reference's per-sample training loop
+(Applications/WordEmbedding/src/wordembedding.cpp:58-160: FeedForward mean
+of input embeddings, BPOutputLayer sigmoid + error, AdaGrad or decayed-lr
+updates) — recast as ONE jit'd computation over a (P, ·) pair batch:
+
+  h        = mean_masked(IE[inputs])                       (P, D)
+  f        = sigmoid(h · EO[outputs])                      (P, C)
+  err      = (labels - f) * mask                           (P, C)
+  hid_err  = err @ EO[outputs]                             (P, D)
+  EO grads = segment-sum over outputs of err ⊗ h
+  IE grads = segment-sum over inputs of hid_err
+
+plain mode:    rows += lr * grad      (lr decays per word count,
+               reference UpdateLearningRate, wordembedding.cpp:38-47)
+adagrad mode:  sum_g2 += grad²; rows += init_lr * grad / sqrt(sum_g2)
+               (reference wordembedding.cpp:101-109, 131-144; batched —
+               a batch's g² lands before its update, a documented
+               deviation from the reference's per-pair sequencing)
+
+The kernel operates on block-local row matrices (fetched from the tables
+by the communicator); all indices are block-local.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TrainState(NamedTuple):
+    ie: jax.Array            # (R_in, D) input-embedding rows
+    eo: jax.Array            # (R_out, D) output-embedding rows
+    ie_g2: Optional[jax.Array]  # adagrad accumulators (or None)
+    eo_g2: Optional[jax.Array]
+
+
+def make_train_step(use_adagrad: bool, eps: float = 1e-10):
+    """Build the jit'd pair-batch step.
+
+    signature: step(state, inputs, imask, outputs, labels, omask, lr)
+    -> (state, pairs_loss_sum)
+    ``lr`` is the decayed rate (plain) or init rate (adagrad).
+    """
+
+    def step(state: TrainState, inputs, imask, outputs, labels, omask, lr):
+        ie, eo = state.ie, state.eo
+        D = ie.shape[1]
+        # forward: mean of masked input embeddings (FeedForward)
+        in_rows = ie[inputs]                              # (P, Cin, D)
+        denom = jnp.maximum(imask.sum(axis=1, keepdims=True), 1.0)
+        h = (in_rows * imask[:, :, None]).sum(axis=1) / denom   # (P, D)
+        out_rows = eo[outputs]                            # (P, Cout, D)
+        logits = jnp.einsum("pd,pcd->pc", h, out_rows)
+        f = jax.nn.sigmoid(logits)
+        err = (labels - f) * omask                        # (P, Cout)
+        # loss metric: masked logistic loss (for monitoring only)
+        loss = -jnp.sum(omask * (labels * jnp.log(f + 1e-7) +
+                                 (1 - labels) * jnp.log(1 - f + 1e-7)))
+        # backward
+        hid_err = jnp.einsum("pc,pcd->pd", err, out_rows)  # (P, D)
+        eo_contrib = err[:, :, None] * h[:, None, :]       # (P, Cout, D)
+        eo_grad = jnp.zeros_like(eo).at[outputs.reshape(-1)].add(
+            eo_contrib.reshape(-1, D))
+        ie_contrib = (hid_err[:, None, :] * imask[:, :, None])  # (P, Cin, D)
+        ie_grad = jnp.zeros_like(ie).at[inputs.reshape(-1)].add(
+            ie_contrib.reshape(-1, D))
+        if use_adagrad:
+            eo_g2 = state.eo_g2 + eo_grad * eo_grad
+            ie_g2 = state.ie_g2 + ie_grad * ie_grad
+            eo = eo + jnp.where(eo_g2 > eps,
+                                lr * eo_grad / jnp.sqrt(eo_g2 + 1e-12), 0.0)
+            ie = ie + jnp.where(ie_g2 > eps,
+                                lr * ie_grad / jnp.sqrt(ie_g2 + 1e-12), 0.0)
+            return TrainState(ie, eo, ie_g2, eo_g2), loss
+        eo = eo + lr * eo_grad
+        ie = ie + lr * ie_grad
+        return TrainState(ie, eo, None, None), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def init_embedding(vocab_size: int, dim: int, seed: int = 1) -> np.ndarray:
+    """word2vec input-embedding init: uniform(-0.5, 0.5)/dim
+    (reference matrix random-init ctor, matrix_table.cpp:372-384 usage)."""
+    rng = np.random.default_rng(seed)
+    return ((rng.random((vocab_size, dim), np.float32) - 0.5) /
+            dim).astype(np.float32)
+
+
+def decayed_lr(init_lr: float, word_count_actual: int, total_words: int,
+               epochs: int) -> float:
+    """reference UpdateLearningRate (wordembedding.cpp:38-47)."""
+    lr = init_lr * (1 - word_count_actual /
+                    (float(total_words) * max(epochs, 1) + 1.0))
+    return max(lr, init_lr * 1e-4)

@@ -1,0 +1,49 @@
+"""Subsampling + negative-sampling distributions.
+
+Behavioral equivalent of reference
+Applications/WordEmbedding/src/util.h Sampler (+ util.cpp): the
+``unigram^(3/4)`` negative table and the word2vec subsampling keep-rule
+``(sqrt(cnt/(sample*total)) + 1) * (sample*total)/cnt``.
+
+TPU-first twist: sampling is vectorized numpy on the host (it feeds batch
+construction, not device compute); the negative table is an alias-free
+cumulative-probability table sampled with ``searchsorted`` instead of the
+reference's 1e8-slot int table — same distribution, ~0 memory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class Sampler:
+    def __init__(self, counts: Sequence[int], power: float = 0.75,
+                 seed: int = 1):
+        counts = np.asarray(counts, np.float64)
+        self._rng = np.random.default_rng(seed)
+        probs = counts ** power
+        self._cum = np.cumsum(probs / probs.sum())
+        self._counts = counts
+        self._total = counts.sum()
+
+    def SampleNegatives(self, shape) -> np.ndarray:
+        """Vocabulary ids ~ unigram^0.75 (reference SetNegativeSamplingDistribution)."""
+        u = self._rng.random(shape)
+        return np.searchsorted(self._cum, u).astype(np.int32)
+
+    def KeepMask(self, word_ids: np.ndarray, sample: float) -> np.ndarray:
+        """Subsampling keep decisions for a sentence
+        (reference WordSampling, util.h:55)."""
+        if sample <= 0:
+            return np.ones(len(word_ids), bool)
+        cnt = self._counts[word_ids]
+        ratio = (sample * self._total) / np.maximum(cnt, 1)
+        keep_prob = np.minimum((np.sqrt(1.0 / ratio) + 1.0) * ratio, 1.0)
+        return self._rng.random(len(word_ids)) < keep_prob
+
+    def rand_windows(self, n: int, window: int) -> np.ndarray:
+        """Per-position random effective window in [1, window] (word2vec's
+        ``b = rand % window`` shrink)."""
+        return self._rng.integers(1, window + 1, size=n)

@@ -1,0 +1,170 @@
+"""WordEmbedding tests: tier-1 (dictionary/huffman/sampler math) and
+tier-3 E2E training on a tiny structured corpus (the reference's
+app-as-test pattern, SURVEY.md §4.2)."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
+from multiverso_tpu.models.wordembedding.option import Option
+from multiverso_tpu.models.wordembedding.sampler import Sampler
+
+
+class TestDictionary:
+    def test_build_and_prune(self, tmp_path):
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("a a a b b c\n a b d\n")
+        d = Dictionary()
+        d.build_from_corpus(str(corpus))
+        d.RemoveWordsLessThan(2)
+        assert d.Size() == 2  # a (4), b (3)
+        assert d.GetWordIdx("a") == 0  # most frequent first
+        assert d.GetWordIdx("c") == -1
+        assert d.WordCount() == 7
+
+    def test_vocab_roundtrip(self, tmp_path):
+        d = Dictionary()
+        for w, c in [("x", 10), ("y", 5)]:
+            d.Insert(w, c)
+        path = str(tmp_path / "vocab.txt")
+        d.save_vocab(path)
+        d2 = Dictionary.load_vocab(path)
+        assert d2.Size() == 2 and d2.GetWordInfo(0).freq == 10
+
+    def test_stopwords(self):
+        d = Dictionary(stopwords={"the"})
+        d.Insert("the", 100)
+        d.Insert("cat", 5)
+        assert d.Size() == 1
+
+
+class TestHuffman:
+    def test_codes_prefix_free_and_frequency_ordered(self):
+        counts = [100, 50, 20, 10, 5]
+        enc = HuffmanEncoder()
+        enc.BuildFromTermFrequency(counts)
+        codes = []
+        for i in range(len(counts)):
+            info = enc.GetLabelInfo(i)
+            assert len(info.codes) == len(info.points)
+            assert all(0 <= p < len(counts) - 1 for p in info.points)
+            codes.append("".join(map(str, info.codes)))
+        # prefix-free
+        for i, a in enumerate(codes):
+            for j, b in enumerate(codes):
+                if i != j:
+                    assert not b.startswith(a)
+        # most frequent word gets the shortest code
+        assert len(codes[0]) == min(len(c) for c in codes)
+        assert enc.max_code_length == max(len(c) for c in codes)
+
+    def test_expected_code_length_optimal(self):
+        # Huffman minimizes expected length; against a known small case
+        counts = [5, 5, 5, 5]
+        enc = HuffmanEncoder()
+        enc.BuildFromTermFrequency(counts)
+        assert all(len(enc.GetLabelInfo(i).codes) == 2 for i in range(4))
+
+
+class TestSampler:
+    def test_negative_distribution_follows_power_law(self):
+        counts = [1000, 100, 10, 1]
+        s = Sampler(counts, seed=0)
+        draws = s.SampleNegatives(20000)
+        freq = np.bincount(draws, minlength=4) / 20000
+        assert freq[0] > freq[1] > freq[2]
+        expect = np.array(counts, float) ** 0.75
+        expect /= expect.sum()
+        np.testing.assert_allclose(freq, expect, atol=0.02)
+
+    def test_subsample_keeps_rare_drops_frequent(self):
+        counts = [10 ** 6, 10]
+        s = Sampler(counts, seed=0)
+        ids = np.array([0] * 1000 + [1] * 1000)
+        keep = s.KeepMask(ids, sample=1e-3)
+        assert keep[1000:].mean() > 0.99     # rare word kept
+        assert keep[:1000].mean() < 0.5      # frequent word mostly dropped
+
+    def test_no_subsample_when_disabled(self):
+        s = Sampler([5, 5], seed=0)
+        assert s.KeepMask(np.array([0, 1]), 0.0).all()
+
+
+def _make_corpus(path, n_sentences=300, seed=0):
+    """Structured corpus: each sentence draws all words from ONE topic of 5
+    words (4 topics, 20-word vocab) so same-topic words co-occur heavily."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n_sentences):
+            topic = rng.integers(4)
+            words = [f"w{topic * 5 + rng.integers(5)}" for _ in range(12)]
+            f.write(" ".join(words) + "\n")
+
+
+def _run(tmp_path, **kw):
+    from multiverso_tpu.models.wordembedding.distributed import (
+        DistributedWordEmbedding)
+    corpus = tmp_path / "corpus.txt"
+    _make_corpus(str(corpus))
+    opt = Option(train_file=str(corpus),
+                 output_file=str(tmp_path / "vec.txt"),
+                 embedding_size=16, window_size=2, negative_num=3,
+                 min_count=1, epoch=2, data_block_size=4000,
+                 pair_batch_size=256, init_learning_rate=0.05)
+    for k, v in kw.items():
+        setattr(opt, k, v)
+    we = DistributedWordEmbedding(opt)
+    avg_loss = we.run()
+    we.close()
+    return opt, avg_loss
+
+
+class TestEndToEnd:
+    def test_skipgram_neg_trains_and_saves(self, tmp_path):
+        opt, avg_loss = _run(tmp_path)
+        # random sigmoid loss per pair is ~(1+K)*0.69; training must beat it
+        assert avg_loss < 0.69 * (1 + opt.negative_num) * 0.9
+        header = open(opt.output_file).readline().split()
+        assert int(header[0]) == 20 and int(header[1]) == 16
+        # same-topic words must be closer than cross-topic words
+        lines = open(opt.output_file).read().splitlines()[1:]
+        vecs = {l.split()[0]: np.array(l.split()[1:], float) for l in lines}
+
+        def cos(a, b):
+            return a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+
+        same = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{5*t + k}"])
+                        for t in range(4) for k in range(1, 5)])
+        cross = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{(5*t + 7) % 20}"])
+                         for t in range(4)])
+        assert same > cross
+
+    def test_cbow(self, tmp_path):
+        _, avg_loss = _run(tmp_path, cbow=True)
+        assert avg_loss < 0.69 * 4 * 0.9
+
+    def test_hierarchical_softmax(self, tmp_path):
+        _, avg_loss = _run(tmp_path, hs=True, negative_num=0)
+        assert avg_loss > 0  # hs loss normalized differently; just trains
+
+    def test_adagrad(self, tmp_path):
+        _, avg_loss = _run(tmp_path, use_adagrad=True,
+                           init_learning_rate=0.1)
+        assert avg_loss < 0.69 * 4 * 0.9
+
+    def test_no_pipeline(self, tmp_path):
+        _, avg_loss = _run(tmp_path, is_pipeline=False)
+        assert avg_loss < 0.69 * 4 * 0.9
+
+    def test_binary_output(self, tmp_path):
+        opt, _ = _run(tmp_path, output_binary=True)
+        raw = open(opt.output_file, "rb").read()
+        assert raw.split(b"\n", 1)[0] == b"20 16"
+
+    def test_option_parse_args(self):
+        opt = Option.parse_args(["-size", "64", "-train_file", "x.txt",
+                                 "-cbow", "1", "-negative", "10",
+                                 "-use_adagrad", "1", "-epoch", "3"])
+        assert opt.embedding_size == 64 and opt.cbow and \
+            opt.negative_num == 10 and opt.use_adagrad and opt.epoch == 3
