@@ -94,19 +94,70 @@ def read_bsparse(path: str) -> Iterator[Tuple[int, float, np.ndarray, np.ndarray
             yield label, weight, keys, np.ones(count, np.float32)
 
 
+_NATIVE_CHUNK = 8 << 20  # parse ~8MB of text at a time (bounded memory)
+
+
+def _iter_samples_native(path: str, config) -> Optional[Iterator]:
+    """Fast path: parse newline-aligned chunks with the native C++ reader
+    (native/src/reader.cc) — sparse text formats only. Chunking keeps peak
+    memory bounded on multi-GB files (the reference workload scale)."""
+    from multiverso_tpu import native
+    if native.lib() is None:
+        return None
+    weighted = config.reader_type == "weight"
+
+    def gen():
+        with open(path, "rb") as f:
+            tail = b""
+            while True:
+                chunk = f.read(_NATIVE_CHUNK)
+                if not chunk:
+                    text = tail
+                    tail = b""
+                else:
+                    block = tail + chunk
+                    cut = block.rfind(b"\n")
+                    if cut < 0:
+                        tail = block
+                        continue
+                    text, tail = block[: cut + 1], block[cut + 1:]
+                if text:
+                    parsed = native.parse_libsvm(text, weighted=weighted)
+                    if parsed is None:
+                        raise RuntimeError("native parser unavailable mid-file")
+                    labels, weights, offsets, keys, values = parsed
+                    if keys.size:
+                        CHECK(0 <= keys.min() and keys.max() < config.input_size,
+                              f"sparse feature id out of range "
+                              f"[0, {config.input_size})")
+                    for i in range(len(labels)):
+                        lo, hi = offsets[i], offsets[i + 1]
+                        yield (int(labels[i]), float(weights[i]),
+                               keys[lo:hi], values[lo:hi])
+                if not chunk:
+                    return
+
+    return gen()
+
+
 def iter_samples(files: str, config) -> Iterator[Tuple[int, float, np.ndarray, np.ndarray]]:
     """Stream samples from ';'-separated files (reference configure.h:55)."""
     for path in [p for p in files.split(";") if p]:
         if config.reader_type == "bsparse":
             yield from read_bsparse(path)
-        else:
-            weighted = config.reader_type == "weight"
-            with open(path) as f:
-                for line in f:
-                    parsed = parse_line(line, config.input_size, config.sparse,
-                                        weighted)
-                    if parsed is not None:
-                        yield parsed
+            continue
+        if config.sparse:
+            fast = _iter_samples_native(path, config)
+            if fast is not None:
+                yield from fast
+                continue
+        weighted = config.reader_type == "weight"
+        with open(path) as f:
+            for line in f:
+                parsed = parse_line(line, config.input_size, config.sparse,
+                                    weighted)
+                if parsed is not None:
+                    yield parsed
 
 
 def batch_samples(samples: Sequence[Tuple[int, float, np.ndarray, np.ndarray]],

@@ -37,15 +37,19 @@ class LogReg:
                 mv.MV_Init([])
                 self._owns_mv = True
         self.model = Model.Get(config)
+        # per-worker output files in PS mode so concurrent workers don't
+        # clobber each other (reference ps_model.cpp:43-46 appends
+        # -<worker_id>); kept as instance paths — the caller's Configure is
+        # never mutated
+        self.output_model_file = config.output_model_file
+        self.output_file = config.output_file
         if config.use_ps:
-            # per-worker output files so concurrent workers don't clobber
-            # each other (reference ps_model.cpp:43-46 appends -<worker_id>)
             import multiverso_tpu as mv
             wid = mv.MV_WorkerId()
-            if config.output_model_file:
-                config.output_model_file += f"-{wid}"
-            if config.output_file:
-                config.output_file += f"-{wid}"
+            if self.output_model_file:
+                self.output_model_file += f"-{wid}"
+            if self.output_file:
+                self.output_file += f"-{wid}"
         if config.init_model_file and not config.use_ps:
             self.model.Load(config.init_model_file)
 
@@ -80,7 +84,7 @@ class LogReg:
         if cfg.use_ps:
             import multiverso_tpu as mv
             mv.MV_Barrier()
-        if cfg.output_model_file:
+        if self.output_model_file:
             self.SaveModel()
         return avg_loss
 
@@ -109,8 +113,8 @@ class LogReg:
             correct_, total_ = self._score(pending, out_lines, W)
             correct += correct_
             total += total_
-        if cfg.output_file:
-            with open(cfg.output_file, "w") as f:
+        if self.output_file:
+            with open(self.output_file, "w") as f:
                 f.write("\n".join(out_lines) + "\n")
         acc = correct / max(total, 1)
         Log.Info("[logreg] test: %d/%d correct (%.4f)", correct, total, acc)
@@ -131,7 +135,7 @@ class LogReg:
         return int(np.sum(hard == labels)), int(batch.count)
 
     def SaveModel(self, path: Optional[str] = None) -> None:
-        self.model.Store(path or self.config.output_model_file)
+        self.model.Store(path or self.output_model_file)
 
     def close(self) -> None:
         if self._owns_mv:

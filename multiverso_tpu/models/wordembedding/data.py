@@ -29,7 +29,6 @@ import numpy as np
 from multiverso_tpu.models.wordembedding.dictionary import Dictionary
 from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
 from multiverso_tpu.models.wordembedding.sampler import Sampler
-from multiverso_tpu.parallel.mesh import next_bucket
 from multiverso_tpu.utils.mt_queue import MtQueue
 
 MAX_SENTENCE_LENGTH = 1000  # reference constant.h kMaxSentenceLength
@@ -107,9 +106,14 @@ class PairGenerator:
                 outputs = list(info.points)
                 labels = [1 - c for c in info.codes]  # fold (1-label-f)
             else:
-                negs = self.sampler.SampleNegatives(opt.negative_num)
-                outputs = [center] + [int(x) for x in negs]
-                labels = [1.0] + [0.0] * opt.negative_num
+                # drop negatives that hit the target itself (reference
+                # wordembedding.cpp skips target==word_idx draws); the
+                # output mask absorbs the shorter list
+                negs = [int(x) for x in
+                        self.sampler.SampleNegatives(opt.negative_num)
+                        if int(x) != center]
+                outputs = [center] + negs
+                labels = [1.0] + [0.0] * len(negs)
             if opt.cbow:
                 out.append((context, outputs, labels))
             else:

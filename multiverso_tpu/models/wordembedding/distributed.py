@@ -11,8 +11,6 @@ and rank-0 embedding export in word2vec text/binary format (:263-306).
 
 from __future__ import annotations
 
-import struct
-import time
 from typing import Optional
 
 import numpy as np

@@ -1,0 +1,98 @@
+"""Native runtime loader (ctypes over native/libmultiverso_tpu.so).
+
+The C++ runtime mirrors the reference's native core (actors, store,
+updaters, BSP sync, c_api — see native/) and additionally exports fast
+text parsers used by the python data pipelines. The library is built on
+demand with ``make`` and loaded via ctypes; everything degrades gracefully
+to pure python when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libmultiverso_tpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        result = subprocess.run(["make", "-C", _NATIVE_DIR, "-j4",
+                                 "libmultiverso_tpu.so"],
+                                capture_output=True, text=True, timeout=300)
+        return result.returncode == 0
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            handle = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        _configure_signatures(handle)
+        _lib = handle
+        return _lib
+
+
+def _configure_signatures(h: ctypes.CDLL) -> None:
+    i64 = ctypes.c_int64
+    h.MV_CountLibsvm.restype = i64
+    h.MV_CountLibsvm.argtypes = [ctypes.c_char_p, i64,
+                                 ctypes.POINTER(i64), ctypes.POINTER(i64)]
+    h.MV_ParseLibsvm.restype = i64
+    h.MV_ParseLibsvm.argtypes = [
+        ctypes.c_char_p, i64, ctypes.c_int,
+        np.ctypeslib.ndpointer(np.int32), np.ctypeslib.ndpointer(np.float32),
+        np.ctypeslib.ndpointer(np.int64), np.ctypeslib.ndpointer(np.int64),
+        np.ctypeslib.ndpointer(np.float32)]
+
+
+def parse_libsvm(text: bytes, weighted: bool = False
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                     np.ndarray, np.ndarray]]:
+    """Fast parse of a libsvm text chunk.
+
+    -> (labels i32, weights f32, offsets i64[n+1], keys i64, values f32)
+    or None when the native lib is unavailable.
+    """
+    h = lib()
+    if h is None:
+        return None
+    n_samples = ctypes.c_int64()
+    n_entries = ctypes.c_int64()
+    h.MV_CountLibsvm(text, len(text), ctypes.byref(n_samples),
+                     ctypes.byref(n_entries))
+    ns, ne = n_samples.value, n_entries.value
+    labels = np.empty(max(ns, 1), np.int32)
+    weights = np.empty(max(ns, 1), np.float32)
+    offsets = np.zeros(ns + 1, np.int64)
+    keys = np.empty(max(ne, 1), np.int64)
+    values = np.empty(max(ne, 1), np.float32)
+    parsed = h.MV_ParseLibsvm(text, len(text), int(weighted), labels, weights,
+                              offsets, keys, values)
+    if parsed < 0:
+        raise ValueError("native libsvm parser: malformed input")
+    if parsed != ns:
+        return None
+    return labels[:ns], weights[:ns], offsets, keys[:ne], values[:ne]

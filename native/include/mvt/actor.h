@@ -1,0 +1,48 @@
+// Actor runtime: one thread + mailbox + per-MsgType handler map.
+// Behavioral equivalent of reference include/multiverso/actor.h:18-57 /
+// src/actor.cpp (dispatch loop over registered handlers; clean stop via
+// queue Exit — the reference's spin-wait stop is deliberately not copied).
+#ifndef MVT_ACTOR_H_
+#define MVT_ACTOR_H_
+
+#include <functional>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "mvt/message.h"
+#include "mvt/mt_queue.h"
+
+namespace mvt {
+
+class Actor {
+ public:
+  explicit Actor(std::string name) : name_(std::move(name)) {}
+  virtual ~Actor() { Stop(); }
+
+  using Handler = std::function<void(MessagePtr&)>;
+
+  void RegisterHandler(MsgType type, Handler handler) {
+    handlers_[type] = std::move(handler);
+  }
+
+  void Start();
+  void Stop();
+
+  void Receive(MessagePtr msg) { mailbox_.Push(std::move(msg)); }
+
+  const std::string& name() const { return name_; }
+
+ protected:
+  void Main();
+
+  std::string name_;
+  MtQueue<MessagePtr> mailbox_;
+  std::unordered_map<MsgType, Handler> handlers_;
+  std::thread thread_;
+  bool running_ = false;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_ACTOR_H_

@@ -1,0 +1,50 @@
+// Pooled, ref-counted allocator with size-bucketed free lists.
+// Behavioral equivalent of reference include/multiverso/util/allocator.h
+// (SmartAllocator: power-of-two size classes, per-class free list, a
+// refcount header ahead of each returned block, Alloc/Free/Refer). Fresh
+// C++17 implementation.
+#ifndef MVT_ALLOCATOR_H_
+#define MVT_ALLOCATOR_H_
+
+#include <atomic>
+#include <cstddef>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace mvt {
+
+class Allocator {
+ public:
+  static Allocator& Get();
+
+  // Returns a data pointer whose block carries an internal refcount of 1.
+  char* Alloc(size_t size);
+  // Increment the block's refcount (shared Blob views).
+  void Refer(char* data);
+  // Decrement; when it hits zero the block returns to its free list.
+  void Free(char* data);
+
+  size_t allocated_blocks() const { return live_.load(); }
+
+  ~Allocator();
+
+ private:
+  Allocator() = default;
+  struct Header {
+    std::atomic<int> refs;
+    uint32_t bucket;
+  };
+  static constexpr size_t kHeader = 16;  // aligned space ahead of data
+  static Header* header_of(char* data) {
+    return reinterpret_cast<Header*>(data - kHeader);
+  }
+
+  std::mutex mu_;
+  std::unordered_map<uint32_t, std::vector<char*>> free_lists_;  // raw blocks
+  std::atomic<size_t> live_{0};
+};
+
+}  // namespace mvt
+
+#endif  // MVT_ALLOCATOR_H_

@@ -1,0 +1,45 @@
+// Ref-counted byte buffer; shallow copy by default.
+// Behavioral equivalent of reference include/multiverso/blob.h:13-53
+// (allocator-backed, copies share the block via refcount).
+#ifndef MVT_BLOB_H_
+#define MVT_BLOB_H_
+
+#include <cstddef>
+#include <cstring>
+
+#include "mvt/allocator.h"
+
+namespace mvt {
+
+class Blob {
+ public:
+  Blob() = default;
+  explicit Blob(size_t size);
+  Blob(const void* data, size_t size);  // copies
+  Blob(const Blob& other);
+  Blob(Blob&& other) noexcept;
+  Blob& operator=(const Blob& other);
+  Blob& operator=(Blob&& other) noexcept;
+  ~Blob();
+
+  char* data() const { return data_; }
+  size_t size() const { return size_; }
+
+  template <typename T>
+  T* As() const {
+    return reinterpret_cast<T*>(data_);
+  }
+  template <typename T>
+  size_t Count() const {
+    return size_ / sizeof(T);
+  }
+
+ private:
+  void release();
+  char* data_ = nullptr;
+  size_t size_ = 0;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_BLOB_H_

@@ -1,0 +1,70 @@
+// C API — float-only Array + Matrix tables over the native runtime.
+// Same surface as reference include/multiverso/c_api.h:16-56 (function
+// names, Array/Matrix verbs, async add variants) plus the reader entry
+// points used by the python data pipeline.
+#ifndef MVT_C_API_H_
+#define MVT_C_API_H_
+
+#include <cstdint>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* TableHandler;
+
+void MV_Init(int* argc, char* argv[]);
+void MV_ShutDown();
+void MV_Barrier();
+int MV_NumWorkers();
+int MV_WorkerId();
+int MV_ServerId();
+
+// Array table (1 x size matrix underneath)
+void MV_NewArrayTable(int size, TableHandler* out);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+
+// Matrix table
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n);
+
+// Worker identity for multi-threaded native clients (thread-local).
+void MV_SetThreadWorkerId(int worker_id);
+
+// -- fast data readers (TPU-build addition: the host-side parse loop is the
+//    reader bottleneck; python calls these via ctypes) ----------------------
+
+// Parse libsvm-ish text ("label k:v k:v ..." or weighted "label:w ...").
+// Returns number of samples parsed; fills caller-provided arrays sized by a
+// prior MV_CountLibsvm call. offsets has n_samples+1 entries.
+int64_t MV_CountLibsvm(const char* text, int64_t text_len,
+                       int64_t* n_samples, int64_t* n_entries);
+int64_t MV_ParseLibsvm(const char* text, int64_t text_len, int weighted,
+                       int32_t* labels, float* weights, int64_t* offsets,
+                       int64_t* keys, float* values);
+
+// Tokenize whitespace-separated text into vocabulary ids via a hash of the
+// caller-provided (sorted) vocab. Used by the WordEmbedding reader.
+// vocab_hash: open-addressing table built by MV_BuildVocabHash.
+int64_t MV_BuildVocabHash(const char** words, int32_t n_words,
+                          int64_t* table, int64_t capacity);
+int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
+                         const char** words, int32_t n_words,
+                         const int64_t* table, int64_t capacity,
+                         int32_t* out_ids, int64_t out_cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // MVT_C_API_H_

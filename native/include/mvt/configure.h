@@ -1,0 +1,42 @@
+// Typed flag registry + "-key=value" argv parser.
+// Behavioral equivalent of reference include/multiverso/util/configure.h
+// (MV_DEFINE_* registration, ParseCMDFlags stripping recognized entries,
+// programmatic SetCMDFlag). Fresh C++17 implementation using one variant
+// registry instead of four template singletons.
+#ifndef MVT_CONFIGURE_H_
+#define MVT_CONFIGURE_H_
+
+#include <string>
+#include <variant>
+
+namespace mvt {
+namespace config {
+
+using FlagValue = std::variant<int, double, bool, std::string>;
+
+// Registers (or re-registers) a flag with its default.
+void Define(const std::string& name, FlagValue default_value,
+            const std::string& help = "");
+
+bool Has(const std::string& name);
+
+// Typed getters abort (CHECK) on unknown flag.
+int GetInt(const std::string& name);
+double GetDouble(const std::string& name);
+bool GetBool(const std::string& name);
+std::string GetString(const std::string& name);
+
+// Sets from a string, coercing to the registered type; false if unknown or
+// unparseable (mirrors the registry try-order semantics of the reference).
+bool TrySet(const std::string& name, const std::string& raw);
+
+// Strips recognized "-key=value" entries from argv in place; returns new argc.
+int ParseCMDFlags(int* argc, char* argv[]);
+
+// Restore all flags to their registered defaults (multi-world processes).
+void ResetToDefaults();
+
+}  // namespace config
+}  // namespace mvt
+
+#endif  // MVT_CONFIGURE_H_

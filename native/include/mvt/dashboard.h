@@ -1,0 +1,44 @@
+// Profiling dashboard: named monitors accumulating count + elapsed time.
+// Behavioral equivalent of reference include/multiverso/dashboard.h:16-73
+// (global Monitor registry; Begin/End regions; Display dump).
+#ifndef MVT_DASHBOARD_H_
+#define MVT_DASHBOARD_H_
+
+#include <chrono>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace mvt {
+
+class Monitor {
+ public:
+  void Begin() { begin_ = Clock::now(); }
+  void End() {
+    elapsed_ms_ += std::chrono::duration<double, std::milli>(
+        Clock::now() - begin_).count();
+    ++count_;
+  }
+  double elapsed_ms() const { return elapsed_ms_; }
+  long count() const { return count_; }
+
+ private:
+  using Clock = std::chrono::steady_clock;
+  Clock::time_point begin_;
+  double elapsed_ms_ = 0;
+  long count_ = 0;
+};
+
+class Dashboard {
+ public:
+  static Monitor& Get(const std::string& name);
+  static std::string Display();
+
+ private:
+  static std::mutex mu_;
+  static std::map<std::string, Monitor> records_;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_DASHBOARD_H_

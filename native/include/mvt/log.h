@@ -1,0 +1,55 @@
+// Leveled logger + CHECK macros for the native runtime.
+// Behavioral equivalent of reference include/multiverso/util/log.h:22-146
+// (Debug/Info/Error/Fatal levels, optional file sink, "[LEVEL] [TIME] msg"
+// format, Fatal aborts). Fresh C++17 implementation.
+#ifndef MVT_LOG_H_
+#define MVT_LOG_H_
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+
+namespace mvt {
+
+enum class LogLevel : int { kDebug = 0, kInfo = 1, kError = 2, kFatal = 3 };
+
+class Logger {
+ public:
+  static Logger& Get();
+
+  void ResetLevel(LogLevel level) { level_ = level; }
+  void ResetFile(const std::string& path);
+
+  void Write(LogLevel level, const char* fmt, ...);
+
+ private:
+  Logger() = default;
+  ~Logger();
+  LogLevel level_ = LogLevel::kInfo;
+  std::FILE* file_ = nullptr;
+  std::mutex mu_;
+};
+
+void LogDebug(const char* fmt, ...);
+void LogInfo(const char* fmt, ...);
+void LogError(const char* fmt, ...);
+[[noreturn]] void LogFatal(const char* fmt, ...);
+
+}  // namespace mvt
+
+#define MVT_CHECK(cond)                                                   \
+  do {                                                                    \
+    if (!(cond)) ::mvt::LogFatal("Check failed: %s (%s:%d)", #cond,       \
+                                 __FILE__, __LINE__);                     \
+  } while (0)
+
+#define MVT_CHECK_NOTNULL(ptr)                                            \
+  do {                                                                    \
+    if ((ptr) == nullptr)                                                 \
+      ::mvt::LogFatal("Check notnull failed: %s (%s:%d)", #ptr, __FILE__, \
+                      __LINE__);                                          \
+  } while (0)
+
+#endif  // MVT_LOG_H_

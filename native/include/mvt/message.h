@@ -1,0 +1,58 @@
+// Control-plane message: typed header + blob payload + in-process reply.
+// Behavioral equivalent of reference include/multiverso/message.h (8-int
+// header + blob list; MsgType numeric values preserved, message.h:13-24).
+// In-process the reply channel is a Waiter + result slots instead of a
+// network round trip.
+#ifndef MVT_MESSAGE_H_
+#define MVT_MESSAGE_H_
+
+#include <memory>
+#include <vector>
+
+#include "mvt/blob.h"
+#include "mvt/waiter.h"
+
+namespace mvt {
+
+enum class MsgType : int {
+  kRequestGet = 1,
+  kRequestAdd = 2,
+  kServerFinishTrain = 4,
+  kRequestBarrier = 33,
+  kReplyGet = -1,
+  kReplyAdd = -2,
+  kDefault = 0,
+};
+
+struct Message {
+  MsgType type = MsgType::kDefault;
+  int table_id = -1;
+  int msg_id = 0;
+  int src_worker = 0;
+  std::vector<Blob> data;          // request payload
+  // in-process reply channel
+  std::vector<Blob>* result = nullptr;  // filled by the server for Gets
+  Waiter* waiter = nullptr;             // notified when processed
+  bool failed = false;
+
+  void Reply() {
+    if (waiter != nullptr) {
+      Waiter* w = waiter;
+      waiter = nullptr;  // first reply wins
+      w->Notify();
+    }
+  }
+};
+
+using MessagePtr = std::shared_ptr<Message>;
+
+inline bool to_server(MsgType t) {
+  return static_cast<int>(t) > 0 && static_cast<int>(t) < 32;
+}
+inline bool to_worker(MsgType t) {
+  return static_cast<int>(t) < 0 && static_cast<int>(t) > -32;
+}
+
+}  // namespace mvt
+
+#endif  // MVT_MESSAGE_H_

@@ -1,0 +1,70 @@
+// Thread-safe blocking queue with Exit semantics.
+// Behavioral equivalent of reference include/multiverso/util/mt_queue.h
+// (Push / blocking Pop returning false after Exit / TryPop / Size / Exit
+// waking all blocked poppers). Fresh C++17 implementation.
+#ifndef MVT_MT_QUEUE_H_
+#define MVT_MT_QUEUE_H_
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <utility>
+
+namespace mvt {
+
+template <typename T>
+class MtQueue {
+ public:
+  void Push(T item) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      items_.push_back(std::move(item));
+    }
+    cv_.notify_one();
+  }
+
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return !items_.empty() || exit_; });
+    if (items_.empty()) return false;
+    *out = std::move(items_.front());
+    items_.pop_front();
+    return true;
+  }
+
+  bool TryPop(T* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (items_.empty()) return false;
+    *out = std::move(items_.front());
+    items_.pop_front();
+    return true;
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return items_.size();
+  }
+
+  bool Empty() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return items_.empty();
+  }
+
+  void Exit() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      exit_ = true;
+    }
+    cv_.notify_all();
+  }
+
+ private:
+  std::deque<T> items_;
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  bool exit_ = false;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_MT_QUEUE_H_

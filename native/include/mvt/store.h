@@ -1,0 +1,142 @@
+// Server-side table store + updaters + server engine (async / BSP sync).
+//
+// Native CPU data plane for the C API: float tables with the reference's
+// updater rules applied by a single server actor. Behavioral equivalent of
+// reference src/server.cpp (async Server + vector-clock SyncServer,
+// :60-222), src/table/array_table.cpp and matrix_table.cpp server halves,
+// and include/multiverso/updater/* (default +=, sgd -=, momentum smoothed,
+// per-worker adagrad).
+//
+// The TPU data plane lives in the Python/JAX layer; this store serves
+// native (C/C++/Lua/C#) clients with identical semantics.
+#ifndef MVT_STORE_H_
+#define MVT_STORE_H_
+
+#include <cmath>
+#include <deque>
+#include <limits>
+#include <memory>
+#include <vector>
+
+#include "mvt/actor.h"
+
+namespace mvt {
+
+struct AddOptionC {
+  int worker_id = 0;
+  float momentum = 0.0f;
+  float learning_rate = 0.01f;
+  float rho = 0.1f;
+  float lambda = 0.1f;
+};
+
+// -- updaters ---------------------------------------------------------------
+
+class UpdaterC {
+ public:
+  virtual ~UpdaterC() = default;
+  // apply delta[0..n) onto data[offset..offset+n)
+  virtual void Update(size_t n, float* data, const float* delta,
+                      const AddOptionC& opt, size_t offset);
+  virtual void InitState(size_t size, int num_workers) {}
+  static std::unique_ptr<UpdaterC> Create(const std::string& type,
+                                          size_t size, int num_workers);
+};
+
+class SgdUpdaterC : public UpdaterC {
+ public:
+  void Update(size_t n, float* data, const float* delta,
+              const AddOptionC& opt, size_t offset) override;
+};
+
+class MomentumUpdaterC : public UpdaterC {
+ public:
+  void InitState(size_t size, int) override { smooth_.assign(size, 0.f); }
+  void Update(size_t n, float* data, const float* delta,
+              const AddOptionC& opt, size_t offset) override;
+
+ private:
+  std::vector<float> smooth_;
+};
+
+class AdaGradUpdaterC : public UpdaterC {
+ public:
+  void InitState(size_t size, int num_workers) override {
+    hist_.assign(static_cast<size_t>(num_workers) * size, 0.f);
+    size_ = size;
+  }
+  void Update(size_t n, float* data, const float* delta,
+              const AddOptionC& opt, size_t offset) override;
+
+ private:
+  std::vector<float> hist_;
+  size_t size_ = 0;
+};
+
+// -- tables -----------------------------------------------------------------
+
+class TableC {
+ public:
+  TableC(size_t num_rows, size_t num_cols, const std::string& updater_type,
+         int num_workers);
+
+  size_t size() const { return data_.size(); }
+  size_t num_rows() const { return rows_; }
+  size_t num_cols() const { return cols_; }
+
+  void AddAll(const float* delta, size_t n, const AddOptionC& opt);
+  void AddRows(const int* row_ids, int n_rows, const float* deltas,
+               const AddOptionC& opt);
+  void GetAll(float* out, size_t n) const;
+  void GetRows(const int* row_ids, int n_rows, float* out) const;
+
+ private:
+  size_t rows_, cols_;
+  std::vector<float> data_;
+  std::unique_ptr<UpdaterC> updater_;
+};
+
+// -- server engine ----------------------------------------------------------
+
+// Vector clock (reference server.cpp:81-137).
+class VectorClockC {
+ public:
+  explicit VectorClockC(int n)
+      : local_(n, 0), global_(0) {}
+  bool Update(int i);
+  bool FinishTrain(int i);
+  double local_clock(int i) const { return local_[i]; }
+  double global_clock() const { return global_; }
+
+ private:
+  double max_element() const;
+  std::vector<double> local_;
+  double global_;
+};
+
+class ServerC : public Actor {
+ public:
+  explicit ServerC(int num_workers, bool sync);
+
+  int RegisterTable(std::unique_ptr<TableC> table);
+  TableC* table(int id) { return store_[id].get(); }
+
+ protected:
+  void HandleGet(MessagePtr& msg);
+  void HandleAdd(MessagePtr& msg);
+  void HandleFinish(MessagePtr& msg);
+  void DoGet(MessagePtr& msg);
+  void DoAdd(MessagePtr& msg);
+
+  std::vector<std::unique_ptr<TableC>> store_;
+  // BSP state (only used when sync_)
+  bool sync_;
+  int num_workers_;
+  std::unique_ptr<VectorClockC> get_clocks_, add_clocks_;
+  std::vector<int> num_waited_add_;
+  std::deque<MessagePtr> add_cache_, get_cache_;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_STORE_H_

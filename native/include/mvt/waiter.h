@@ -1,0 +1,43 @@
+// Counting-semaphore waiter.
+// Behavioral equivalent of reference include/multiverso/util/waiter.h:10-34
+// (Wait blocks until count reaches zero; Notify decrements; Reset re-arms).
+#ifndef MVT_WAITER_H_
+#define MVT_WAITER_H_
+
+#include <condition_variable>
+#include <mutex>
+
+namespace mvt {
+
+class Waiter {
+ public:
+  explicit Waiter(int num_wait = 1) : num_(num_wait) {}
+
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return num_ <= 0; });
+  }
+
+  void Notify() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      --num_;
+      if (num_ > 0) return;
+    }
+    cv_.notify_all();
+  }
+
+  void Reset(int num_wait) {
+    std::lock_guard<std::mutex> lk(mu_);
+    num_ = num_wait;
+  }
+
+ private:
+  int num_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+};
+
+}  // namespace mvt
+
+#endif  // MVT_WAITER_H_

@@ -1,0 +1,36 @@
+#include "mvt/actor.h"
+
+#include "mvt/log.h"
+
+namespace mvt {
+
+void Actor::Start() {
+  if (running_) return;
+  running_ = true;
+  thread_ = std::thread([this] { Main(); });
+}
+
+void Actor::Stop() {
+  if (!running_) return;
+  mailbox_.Exit();
+  if (thread_.joinable()) thread_.join();
+  running_ = false;
+}
+
+void Actor::Main() {
+  MessagePtr msg;
+  while (mailbox_.Pop(&msg)) {
+    auto it = handlers_.find(msg->type);
+    if (it == handlers_.end()) {
+      LogError("actor %s: unhandled message type %d", name_.c_str(),
+               static_cast<int>(msg->type));
+      msg->failed = true;
+      msg->Reply();
+      continue;
+    }
+    it->second(msg);
+    msg.reset();
+  }
+}
+
+}  // namespace mvt

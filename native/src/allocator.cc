@@ -1,0 +1,65 @@
+#include "mvt/allocator.h"
+
+#include <cstdlib>
+#include <new>
+
+#include "mvt/log.h"
+
+namespace mvt {
+
+namespace {
+uint32_t bucket_for(size_t size) {
+  uint32_t b = 5;  // min 32-byte class
+  while ((1ull << b) < size) ++b;
+  return b;
+}
+}  // namespace
+
+Allocator& Allocator::Get() {
+  static Allocator* instance = new Allocator();  // leaked: outlives actors
+  return *instance;
+}
+
+char* Allocator::Alloc(size_t size) {
+  uint32_t bucket = bucket_for(size + kHeader);
+  char* raw = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& list = free_lists_[bucket];
+    if (!list.empty()) {
+      raw = list.back();
+      list.pop_back();
+    }
+  }
+  if (raw == nullptr) {
+    raw = static_cast<char*>(std::malloc(1ull << bucket));
+    if (raw == nullptr) throw std::bad_alloc();
+  }
+  auto* header = reinterpret_cast<Header*>(raw);
+  header->refs.store(1, std::memory_order_relaxed);
+  header->bucket = bucket;
+  live_.fetch_add(1, std::memory_order_relaxed);
+  return raw + kHeader;
+}
+
+void Allocator::Refer(char* data) {
+  header_of(data)->refs.fetch_add(1, std::memory_order_relaxed);
+}
+
+void Allocator::Free(char* data) {
+  Header* header = header_of(data);
+  if (header->refs.fetch_sub(1, std::memory_order_acq_rel) != 1) return;
+  live_.fetch_sub(1, std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(mu_);
+  free_lists_[header->bucket].push_back(reinterpret_cast<char*>(header));
+}
+
+Allocator::~Allocator() {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& [bucket, list] : free_lists_) {
+    for (char* raw : list) std::free(raw);
+  }
+  free_lists_.clear();
+}
+
+}  // namespace mvt

@@ -1,0 +1,53 @@
+#include "mvt/blob.h"
+
+namespace mvt {
+
+Blob::Blob(size_t size) : size_(size) {
+  if (size_ > 0) data_ = Allocator::Get().Alloc(size_);
+}
+
+Blob::Blob(const void* data, size_t size) : Blob(size) {
+  if (size_ > 0) std::memcpy(data_, data, size_);
+}
+
+Blob::Blob(const Blob& other) : data_(other.data_), size_(other.size_) {
+  if (data_ != nullptr) Allocator::Get().Refer(data_);
+}
+
+Blob::Blob(Blob&& other) noexcept : data_(other.data_), size_(other.size_) {
+  other.data_ = nullptr;
+  other.size_ = 0;
+}
+
+Blob& Blob::operator=(const Blob& other) {
+  if (this != &other) {
+    release();
+    data_ = other.data_;
+    size_ = other.size_;
+    if (data_ != nullptr) Allocator::Get().Refer(data_);
+  }
+  return *this;
+}
+
+Blob& Blob::operator=(Blob&& other) noexcept {
+  if (this != &other) {
+    release();
+    data_ = other.data_;
+    size_ = other.size_;
+    other.data_ = nullptr;
+    other.size_ = 0;
+  }
+  return *this;
+}
+
+Blob::~Blob() { release(); }
+
+void Blob::release() {
+  if (data_ != nullptr) {
+    Allocator::Get().Free(data_);
+    data_ = nullptr;
+    size_ = 0;
+  }
+}
+
+}  // namespace mvt

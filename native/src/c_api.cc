@@ -1,0 +1,189 @@
+// C API implementation: a single-process native runtime (the reference's
+// 1-process world, multiverso_env.h) — server actor + CPU store. See
+// include/mvt/c_api.h for surface parity notes.
+#include "mvt/c_api.h"
+
+#include <atomic>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "mvt/configure.h"
+#include "mvt/log.h"
+#include "mvt/store.h"
+
+namespace {
+
+struct Runtime {
+  std::unique_ptr<mvt::ServerC> server;
+  int num_workers = 1;
+  std::mutex mu;
+};
+
+Runtime& rt() {
+  static Runtime r;
+  return r;
+}
+
+thread_local int tls_worker_id = 0;
+
+struct TableRef {
+  int table_id;
+  size_t rows, cols;
+};
+
+void submit(mvt::MessagePtr msg, bool wait) {
+  mvt::Waiter waiter(1);
+  if (wait) msg->waiter = &waiter;
+  rt().server->Receive(msg);
+  if (wait) waiter.Wait();
+}
+
+mvt::MessagePtr make_add(TableRef* ref, const int* row_ids, int n_rows,
+                         const float* data, int n_floats) {
+  auto msg = std::make_shared<mvt::Message>();
+  msg->type = mvt::MsgType::kRequestAdd;
+  msg->table_id = ref->table_id;
+  msg->src_worker = tls_worker_id;
+  msg->data.emplace_back(row_ids,
+                         static_cast<size_t>(n_rows) * sizeof(int));
+  msg->data.emplace_back(data, static_cast<size_t>(n_floats) * sizeof(float));
+  mvt::AddOptionC opt;
+  opt.worker_id = tls_worker_id;
+  msg->data.emplace_back(&opt, sizeof(opt));
+  return msg;
+}
+
+}  // namespace
+
+extern "C" {
+
+void MV_Init(int* argc, char* argv[]) {
+  using mvt::config::Define;
+  Define("sync", false);
+  Define("num_workers", 1);
+  Define("updater_type", std::string("default"));
+  if (argc != nullptr) mvt::config::ParseCMDFlags(argc, argv);
+  std::lock_guard<std::mutex> lk(rt().mu);
+  MVT_CHECK(rt().server == nullptr);
+  rt().num_workers = mvt::config::GetInt("num_workers");
+  rt().server = std::make_unique<mvt::ServerC>(rt().num_workers,
+                                               mvt::config::GetBool("sync"));
+  rt().server->Start();
+}
+
+void MV_ShutDown() {
+  std::lock_guard<std::mutex> lk(rt().mu);
+  if (rt().server == nullptr) return;
+  // drain BSP caches (reference Zoo::FinishTrain, zoo.cpp:152-162)
+  for (int w = 0; w < rt().num_workers; ++w) {
+    auto msg = std::make_shared<mvt::Message>();
+    msg->type = mvt::MsgType::kServerFinishTrain;
+    msg->src_worker = w;
+    mvt::Waiter waiter(1);
+    msg->waiter = &waiter;
+    rt().server->Receive(msg);
+    waiter.Wait();
+  }
+  rt().server->Stop();
+  rt().server.reset();
+  mvt::config::ResetToDefaults();
+}
+
+void MV_Barrier() {
+  // single-process world: in-flight messages drain through the mailbox; a
+  // ping round-trip gives the happens-before callers expect (it must not
+  // use FinishTrain, which would advance BSP clocks mid-training)
+  auto msg = std::make_shared<mvt::Message>();
+  msg->type = mvt::MsgType::kRequestBarrier;
+  msg->src_worker = tls_worker_id;
+  submit(msg, true);
+}
+
+int MV_NumWorkers() { return rt().num_workers; }
+int MV_WorkerId() { return tls_worker_id; }
+int MV_ServerId() { return 0; }
+void MV_SetThreadWorkerId(int worker_id) { tls_worker_id = worker_id; }
+
+// -- tables -----------------------------------------------------------------
+
+static TableRef* new_table(size_t rows, size_t cols) {
+  MVT_CHECK(rt().server != nullptr);
+  auto table = std::make_unique<mvt::TableC>(
+      rows, cols, mvt::config::GetString("updater_type"), rt().num_workers);
+  int id = rt().server->RegisterTable(std::move(table));
+  return new TableRef{id, rows, cols};
+}
+
+void MV_NewArrayTable(int size, TableHandler* out) {
+  *out = new_table(1, static_cast<size_t>(size));
+}
+
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
+  *out = new_table(static_cast<size_t>(num_row),
+                   static_cast<size_t>(num_col));
+}
+
+static void do_get(TableHandler handler, float* data, int size,
+                   const int* row_ids, int n_rows) {
+  auto* ref = static_cast<TableRef*>(handler);
+  auto msg = std::make_shared<mvt::Message>();
+  msg->type = mvt::MsgType::kRequestGet;
+  msg->table_id = ref->table_id;
+  msg->src_worker = tls_worker_id;
+  msg->data.emplace_back(row_ids, static_cast<size_t>(n_rows) * sizeof(int));
+  std::vector<mvt::Blob> result;
+  msg->result = &result;
+  submit(msg, true);
+  MVT_CHECK(!result.empty());
+  MVT_CHECK(result[0].size() == static_cast<size_t>(size) * sizeof(float));
+  std::memcpy(data, result[0].data(), result[0].size());
+}
+
+void MV_GetArrayTable(TableHandler handler, float* data, int size) {
+  do_get(handler, data, size, nullptr, 0);
+}
+
+void MV_AddArrayTable(TableHandler handler, float* data, int size) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, nullptr, 0, data, size), true);
+}
+
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, nullptr, 0, data, size), false);
+}
+
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size) {
+  do_get(handler, data, size, nullptr, 0);
+}
+
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, nullptr, 0, data, size), true);
+}
+
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, nullptr, 0, data, size), false);
+}
+
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  do_get(handler, data, size, row_ids, row_ids_n);
+}
+
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, row_ids, row_ids_n, data, size), true);
+}
+
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n) {
+  auto* ref = static_cast<TableRef*>(handler);
+  submit(make_add(ref, row_ids, row_ids_n, data, size), false);
+}
+
+}  // extern "C"

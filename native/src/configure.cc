@@ -1,0 +1,121 @@
+#include "mvt/configure.h"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+#include "mvt/log.h"
+
+namespace mvt {
+namespace config {
+
+namespace {
+
+struct Registry {
+  std::map<std::string, FlagValue> values;
+  std::map<std::string, FlagValue> defaults;
+  std::mutex mu;
+};
+
+Registry& reg() {
+  static Registry r;
+  return r;
+}
+
+}  // namespace
+
+void Define(const std::string& name, FlagValue default_value,
+            const std::string&) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  reg().values.emplace(name, default_value);  // keep existing value
+  reg().defaults[name] = std::move(default_value);
+}
+
+bool Has(const std::string& name) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  return reg().values.count(name) != 0;
+}
+
+int GetInt(const std::string& name) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  auto it = reg().values.find(name);
+  MVT_CHECK(it != reg().values.end());
+  return std::get<int>(it->second);
+}
+
+double GetDouble(const std::string& name) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  auto it = reg().values.find(name);
+  MVT_CHECK(it != reg().values.end());
+  return std::get<double>(it->second);
+}
+
+bool GetBool(const std::string& name) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  auto it = reg().values.find(name);
+  MVT_CHECK(it != reg().values.end());
+  return std::get<bool>(it->second);
+}
+
+std::string GetString(const std::string& name) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  auto it = reg().values.find(name);
+  MVT_CHECK(it != reg().values.end());
+  return std::get<std::string>(it->second);
+}
+
+bool TrySet(const std::string& name, const std::string& raw) {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  auto it = reg().values.find(name);
+  if (it == reg().values.end()) return false;
+  try {
+    if (std::holds_alternative<int>(it->second)) {
+      it->second = std::stoi(raw);
+    } else if (std::holds_alternative<double>(it->second)) {
+      it->second = std::stod(raw);
+    } else if (std::holds_alternative<bool>(it->second)) {
+      std::string lower(raw);
+      std::transform(lower.begin(), lower.end(), lower.begin(), ::tolower);
+      if (lower == "true" || lower == "1" || lower == "on") {
+        it->second = true;
+      } else if (lower == "false" || lower == "0" || lower == "off") {
+        it->second = false;
+      } else {
+        return false;
+      }
+    } else {
+      it->second = raw;
+    }
+  } catch (...) {
+    return false;
+  }
+  return true;
+}
+
+int ParseCMDFlags(int* argc, char* argv[]) {
+  if (argc == nullptr || argv == nullptr) return 0;
+  int out = 0;
+  for (int i = 0; i < *argc; ++i) {
+    const char* arg = argv[i];
+    bool consumed = false;
+    if (arg != nullptr && arg[0] == '-') {
+      const char* body = arg + (arg[1] == '-' ? 2 : 1);
+      const char* eq = std::strchr(body, '=');
+      if (eq != nullptr) {
+        consumed = TrySet(std::string(body, eq - body), std::string(eq + 1));
+      }
+    }
+    if (!consumed) argv[out++] = argv[i];
+  }
+  *argc = out;
+  return out;
+}
+
+void ResetToDefaults() {
+  std::lock_guard<std::mutex> lk(reg().mu);
+  for (auto& [name, value] : reg().defaults) reg().values[name] = value;
+}
+
+}  // namespace config
+}  // namespace mvt

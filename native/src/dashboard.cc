@@ -1,0 +1,27 @@
+#include "mvt/dashboard.h"
+
+#include <sstream>
+
+namespace mvt {
+
+std::mutex Dashboard::mu_;
+std::map<std::string, Monitor> Dashboard::records_;
+
+Monitor& Dashboard::Get(const std::string& name) {
+  std::lock_guard<std::mutex> lk(mu_);
+  return records_[name];
+}
+
+std::string Dashboard::Display() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::ostringstream os;
+  for (const auto& [name, mon] : records_) {
+    double avg = mon.count() ? mon.elapsed_ms() / mon.count() : 0.0;
+    os << "[Monitor] " << name << ": count = " << mon.count()
+       << ", elapse = " << mon.elapsed_ms() << " ms, average = " << avg
+       << " ms\n";
+  }
+  return os.str();
+}
+
+}  // namespace mvt

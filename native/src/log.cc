@@ -1,0 +1,64 @@
+#include "mvt/log.h"
+
+#include <ctime>
+
+namespace mvt {
+
+Logger& Logger::Get() {
+  static Logger logger;
+  return logger;
+}
+
+Logger::~Logger() {
+  if (file_ != nullptr) std::fclose(file_);
+}
+
+void Logger::ResetFile(const std::string& path) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (file_ != nullptr) std::fclose(file_);
+  file_ = path.empty() ? nullptr : std::fopen(path.c_str(), "a");
+}
+
+static const char* level_name(LogLevel level) {
+  switch (level) {
+    case LogLevel::kDebug: return "DEBUG";
+    case LogLevel::kInfo: return "INFO";
+    case LogLevel::kError: return "ERROR";
+    default: return "FATAL";
+  }
+}
+
+void Logger::Write(LogLevel level, const char* fmt, ...) {
+  if (level < level_ && level != LogLevel::kFatal) return;
+  char body[2048];
+  va_list args;
+  va_start(args, fmt);
+  std::vsnprintf(body, sizeof(body), fmt, args);
+  va_end(args);
+  char stamp[32];
+  std::time_t now = std::time(nullptr);
+  std::strftime(stamp, sizeof(stamp), "%F %T", std::localtime(&now));
+  std::lock_guard<std::mutex> lk(mu_);
+  std::FILE* sink = file_ != nullptr ? file_ : stderr;
+  std::fprintf(sink, "[%s] [%s] %s\n", level_name(level), stamp, body);
+  std::fflush(sink);
+}
+
+#define MVT_FORWARD(level)                       \
+  char body[2048];                               \
+  va_list args;                                  \
+  va_start(args, fmt);                           \
+  std::vsnprintf(body, sizeof(body), fmt, args); \
+  va_end(args);                                  \
+  Logger::Get().Write(level, "%s", body)
+
+void LogDebug(const char* fmt, ...) { MVT_FORWARD(LogLevel::kDebug); }
+void LogInfo(const char* fmt, ...) { MVT_FORWARD(LogLevel::kInfo); }
+void LogError(const char* fmt, ...) { MVT_FORWARD(LogLevel::kError); }
+
+void LogFatal(const char* fmt, ...) {
+  MVT_FORWARD(LogLevel::kFatal);
+  std::abort();
+}
+
+}  // namespace mvt

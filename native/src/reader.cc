@@ -1,0 +1,168 @@
+// Fast text parsers exported through the C API.
+// Native equivalent of the reference's reader hot loops
+// (Applications/LogisticRegression/src/reader.cpp line parsing and
+// Applications/WordEmbedding/src/reader.cpp tokenize+lookup): the python
+// data pipelines hand a whole text chunk across ctypes once and get packed
+// arrays back, instead of running per-token python code.
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include "mvt/c_api.h"
+
+namespace {
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+inline const char* next_ws(const char* p, const char* end) {
+  while (p < end && *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r') ++p;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t MV_CountLibsvm(const char* text, int64_t text_len,
+                       int64_t* n_samples, int64_t* n_entries) {
+  const char* p = text;
+  const char* end = text + text_len;
+  int64_t samples = 0, entries = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* q = skip_ws(p, line_end);
+    if (q < line_end) {
+      ++samples;
+      // entries = tokens after the first
+      q = next_ws(q, line_end);  // skip label token
+      while (true) {
+        q = skip_ws(q, line_end);
+        if (q >= line_end) break;
+        ++entries;
+        q = next_ws(q, line_end);
+      }
+    }
+    p = line_end + 1;
+  }
+  *n_samples = samples;
+  *n_entries = entries;
+  return samples;
+}
+
+int64_t MV_ParseLibsvm(const char* text, int64_t text_len, int weighted,
+                       int32_t* labels, float* weights, int64_t* offsets,
+                       int64_t* keys, float* values) {
+  const char* p = text;
+  const char* end = text + text_len;
+  int64_t sample = 0, entry = 0;
+  offsets[0] = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* q = skip_ws(p, line_end);
+    if (q < line_end) {
+      // label (optionally "label:weight"); malformed input returns -1 so
+      // the python caller fails the run instead of training on garbage
+      char* after = nullptr;
+      double lab = strtod(q, &after);
+      if (after == q) return -1;
+      float weight = 1.0f;
+      if (weighted && after < line_end && *after == ':') {
+        char* wend = nullptr;
+        weight = static_cast<float>(strtod(after + 1, &wend));
+        if (wend == after + 1) return -1;
+      }
+      labels[sample] = static_cast<int32_t>(lab);
+      weights[sample] = weight;
+      q = next_ws(q, line_end);
+      while (true) {
+        q = skip_ws(q, line_end);
+        if (q >= line_end) break;
+        char* kend = nullptr;
+        long long key = strtoll(q, &kend, 10);
+        if (kend == q) return -1;
+        float value = 1.0f;
+        if (kend < line_end && *kend == ':') {
+          char* vend = nullptr;
+          value = static_cast<float>(strtod(kend + 1, &vend));
+          if (vend == kend + 1) return -1;
+          kend = vend;
+        }
+        keys[entry] = key;
+        values[entry] = value;
+        ++entry;
+        q = kend;
+        q = next_ws(q, line_end);
+      }
+      ++sample;
+      offsets[sample] = entry;
+    }
+    p = line_end + 1;
+  }
+  return sample;
+}
+
+// -- vocab hash + tokenizer --------------------------------------------------
+
+namespace {
+
+inline uint64_t hash_str(const char* s, size_t n) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+}  // namespace
+
+int64_t MV_BuildVocabHash(const char** words, int32_t n_words,
+                          int64_t* table, int64_t capacity) {
+  for (int64_t i = 0; i < capacity; ++i) table[i] = -1;
+  for (int32_t w = 0; w < n_words; ++w) {
+    uint64_t h = hash_str(words[w], strlen(words[w])) %
+                 static_cast<uint64_t>(capacity);
+    while (table[h] != -1) h = (h + 1) % static_cast<uint64_t>(capacity);
+    table[h] = w;
+  }
+  return n_words;
+}
+
+int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
+                         const char** words, int32_t n_words,
+                         const int64_t* table, int64_t capacity,
+                         int32_t* out_ids, int64_t out_cap) {
+  (void)n_words;
+  const char* p = text;
+  const char* end = text + text_len;
+  int64_t out = 0;
+  while (p < end && out < out_cap) {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+    const char* tok = p;
+    while (p < end && !std::isspace(static_cast<unsigned char>(*p))) ++p;
+    if (p == tok) break;
+    size_t len = static_cast<size_t>(p - tok);
+    uint64_t h = hash_str(tok, len) % static_cast<uint64_t>(capacity);
+    int32_t id = -1;
+    while (table[h] != -1) {
+      int64_t cand = table[h];
+      if (strncmp(words[cand], tok, len) == 0 && words[cand][len] == '\0') {
+        id = static_cast<int32_t>(cand);
+        break;
+      }
+      h = (h + 1) % static_cast<uint64_t>(capacity);
+    }
+    out_ids[out++] = id;  // -1 marks out-of-vocab (caller filters)
+  }
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,271 @@
+#include "mvt/store.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "mvt/log.h"
+
+namespace mvt {
+
+// -- updaters ---------------------------------------------------------------
+
+void UpdaterC::Update(size_t n, float* data, const float* delta,
+                      const AddOptionC&, size_t offset) {
+  for (size_t i = 0; i < n; ++i) data[offset + i] += delta[i];
+}
+
+void SgdUpdaterC::Update(size_t n, float* data, const float* delta,
+                         const AddOptionC&, size_t offset) {
+  for (size_t i = 0; i < n; ++i) data[offset + i] -= delta[i];
+}
+
+void MomentumUpdaterC::Update(size_t n, float* data, const float* delta,
+                              const AddOptionC& opt, size_t offset) {
+  const float m = opt.momentum;
+  for (size_t i = 0; i < n; ++i) {
+    float& s = smooth_[offset + i];
+    s = m * s + (1.0f - m) * delta[i];
+    data[offset + i] -= s;
+  }
+}
+
+void AdaGradUpdaterC::Update(size_t n, float* data, const float* delta,
+                             const AddOptionC& opt, size_t offset) {
+  // evident-intent AdaGrad (see python updaters/base.py deviation note):
+  // hist += (delta/lr)^2 ; data -= rho * (delta/lr) / sqrt(hist + eps)
+  constexpr float kEps = 1e-6f;
+  MVT_CHECK(opt.worker_id >= 0 &&
+            static_cast<size_t>(opt.worker_id) * size_ < hist_.size());
+  float* hist = hist_.data() + static_cast<size_t>(opt.worker_id) * size_;
+  const float inv_lr = 1.0f / opt.learning_rate;
+  for (size_t i = 0; i < n; ++i) {
+    float g = delta[i] * inv_lr;
+    float& h = hist[offset + i];
+    h += g * g;
+    data[offset + i] -= opt.rho * g / std::sqrt(h + kEps);
+  }
+}
+
+std::unique_ptr<UpdaterC> UpdaterC::Create(const std::string& type,
+                                           size_t size, int num_workers) {
+  std::unique_ptr<UpdaterC> updater;
+  if (type == "sgd") {
+    updater = std::make_unique<SgdUpdaterC>();
+  } else if (type == "momentum") {
+    updater = std::make_unique<MomentumUpdaterC>();
+  } else if (type == "adagrad") {
+    updater = std::make_unique<AdaGradUpdaterC>();
+  } else {
+    updater = std::make_unique<UpdaterC>();
+  }
+  updater->InitState(size, num_workers);
+  return updater;
+}
+
+// -- tables -----------------------------------------------------------------
+
+TableC::TableC(size_t num_rows, size_t num_cols,
+               const std::string& updater_type, int num_workers)
+    : rows_(num_rows), cols_(num_cols) {
+  MVT_CHECK(num_rows > 0 && num_cols > 0);
+  data_.assign(rows_ * cols_, 0.0f);
+  updater_ = UpdaterC::Create(updater_type, data_.size(), num_workers);
+}
+
+void TableC::AddAll(const float* delta, size_t n, const AddOptionC& opt) {
+  MVT_CHECK(n == data_.size());
+  updater_->Update(n, data_.data(), delta, opt, 0);
+}
+
+void TableC::AddRows(const int* row_ids, int n_rows, const float* deltas,
+                     const AddOptionC& opt) {
+  for (int r = 0; r < n_rows; ++r) {
+    MVT_CHECK(row_ids[r] >= 0 && static_cast<size_t>(row_ids[r]) < rows_);
+    updater_->Update(cols_, data_.data(), deltas + static_cast<size_t>(r) * cols_,
+                     opt, static_cast<size_t>(row_ids[r]) * cols_);
+  }
+}
+
+void TableC::GetAll(float* out, size_t n) const {
+  MVT_CHECK(n == data_.size());
+  std::memcpy(out, data_.data(), n * sizeof(float));
+}
+
+void TableC::GetRows(const int* row_ids, int n_rows, float* out) const {
+  for (int r = 0; r < n_rows; ++r) {
+    MVT_CHECK(row_ids[r] >= 0 && static_cast<size_t>(row_ids[r]) < rows_);
+    std::memcpy(out + static_cast<size_t>(r) * cols_,
+                data_.data() + static_cast<size_t>(row_ids[r]) * cols_,
+                cols_ * sizeof(float));
+  }
+}
+
+// -- vector clock (reference server.cpp:81-137) -----------------------------
+
+bool VectorClockC::Update(int i) {
+  local_[i] += 1;
+  double min_local = *std::min_element(local_.begin(), local_.end());
+  if (global_ < min_local) {
+    global_ += 1;
+    if (global_ == max_element()) return true;
+  }
+  return false;
+}
+
+bool VectorClockC::FinishTrain(int i) {
+  local_[i] = std::numeric_limits<double>::infinity();
+  double min_local = *std::min_element(local_.begin(), local_.end());
+  if (global_ < min_local) {
+    global_ = min_local;
+    if (global_ == max_element()) return true;
+  }
+  return false;
+}
+
+double VectorClockC::max_element() const {
+  double mx = global_;
+  for (double v : local_) {
+    if (v != std::numeric_limits<double>::infinity() && v > mx) mx = v;
+  }
+  return mx;
+}
+
+// -- server engine ----------------------------------------------------------
+
+ServerC::ServerC(int num_workers, bool sync)
+    : Actor("server"), sync_(sync), num_workers_(num_workers) {
+  if (sync_) {
+    get_clocks_ = std::make_unique<VectorClockC>(num_workers);
+    add_clocks_ = std::make_unique<VectorClockC>(num_workers);
+    num_waited_add_.assign(num_workers, 0);
+  }
+  RegisterHandler(MsgType::kRequestGet,
+                  [this](MessagePtr& m) { HandleGet(m); });
+  RegisterHandler(MsgType::kRequestAdd,
+                  [this](MessagePtr& m) { HandleAdd(m); });
+  RegisterHandler(MsgType::kServerFinishTrain,
+                  [this](MessagePtr& m) { HandleFinish(m); });
+  // barrier ping: a reply after the mailbox drained up to this point —
+  // must NOT touch the BSP clocks (unlike FinishTrain)
+  RegisterHandler(MsgType::kRequestBarrier,
+                  [](MessagePtr& m) { m->Reply(); });
+}
+
+int ServerC::RegisterTable(std::unique_ptr<TableC> table) {
+  store_.push_back(std::move(table));
+  return static_cast<int>(store_.size()) - 1;
+}
+
+// payload layout:
+//   Get : data[0] = row_ids blob (empty => all); result gets one blob
+//   Add : data[0] = row_ids blob (empty => all), data[1] = values,
+//         data[2] = AddOptionC
+void ServerC::DoGet(MessagePtr& msg) {
+  TableC* table = store_[msg->table_id].get();
+  const Blob& ids = msg->data[0];
+  if (ids.size() == 0) {
+    Blob out(table->size() * sizeof(float));
+    table->GetAll(out.As<float>(), table->size());
+    msg->result->push_back(std::move(out));
+  } else {
+    int n = static_cast<int>(ids.Count<int>());
+    Blob out(static_cast<size_t>(n) * table->num_cols() * sizeof(float));
+    table->GetRows(ids.As<int>(), n, out.As<float>());
+    msg->result->push_back(std::move(out));
+  }
+  msg->Reply();
+}
+
+void ServerC::DoAdd(MessagePtr& msg) {
+  TableC* table = store_[msg->table_id].get();
+  const Blob& ids = msg->data[0];
+  const Blob& values = msg->data[1];
+  AddOptionC opt;
+  if (msg->data.size() > 2 && msg->data[2].size() >= sizeof(AddOptionC)) {
+    std::memcpy(&opt, msg->data[2].data(), sizeof(AddOptionC));
+  }
+  if (ids.size() == 0) {
+    table->AddAll(values.As<float>(), values.Count<float>(), opt);
+  } else {
+    table->AddRows(ids.As<int>(), static_cast<int>(ids.Count<int>()),
+                   values.As<float>(), opt);
+  }
+  msg->Reply();
+}
+
+void ServerC::HandleAdd(MessagePtr& msg) {
+  if (!sync_) {
+    DoAdd(msg);
+    return;
+  }
+  int worker = msg->src_worker;
+  // reference server.cpp:139-160
+  if (get_clocks_->local_clock(worker) > get_clocks_->global_clock()) {
+    add_cache_.push_back(msg);
+    ++num_waited_add_[worker];
+    return;
+  }
+  DoAdd(msg);
+  if (add_clocks_->Update(worker)) {
+    MVT_CHECK(add_cache_.empty());
+    while (!get_cache_.empty()) {
+      MessagePtr get_msg = get_cache_.front();
+      get_cache_.pop_front();
+      DoGet(get_msg);
+      MVT_CHECK(!get_clocks_->Update(get_msg->src_worker));
+    }
+  }
+}
+
+void ServerC::HandleGet(MessagePtr& msg) {
+  if (!sync_) {
+    DoGet(msg);
+    return;
+  }
+  int worker = msg->src_worker;
+  // reference server.cpp:162-186
+  if (add_clocks_->local_clock(worker) > add_clocks_->global_clock() ||
+      num_waited_add_[worker] > 0) {
+    get_cache_.push_back(msg);
+    return;
+  }
+  DoGet(msg);
+  if (get_clocks_->Update(worker)) {
+    while (!add_cache_.empty()) {
+      MessagePtr add_msg = add_cache_.front();
+      add_cache_.pop_front();
+      DoAdd(add_msg);
+      MVT_CHECK(!add_clocks_->Update(add_msg->src_worker));
+      --num_waited_add_[add_msg->src_worker];
+    }
+  }
+}
+
+void ServerC::HandleFinish(MessagePtr& msg) {
+  if (sync_) {
+    // reference server.cpp:188-211
+    int worker = msg->src_worker;
+    if (add_clocks_->FinishTrain(worker)) {
+      MVT_CHECK(add_cache_.empty());
+      while (!get_cache_.empty()) {
+        MessagePtr get_msg = get_cache_.front();
+        get_cache_.pop_front();
+        DoGet(get_msg);
+        MVT_CHECK(!get_clocks_->Update(get_msg->src_worker));
+      }
+    }
+    if (get_clocks_->FinishTrain(worker)) {
+      MVT_CHECK(get_cache_.empty());
+      while (!add_cache_.empty()) {
+        MessagePtr add_msg = add_cache_.front();
+        add_cache_.pop_front();
+        DoAdd(add_msg);
+        MVT_CHECK(!add_clocks_->Update(add_msg->src_worker));
+        --num_waited_add_[add_msg->src_worker];
+      }
+    }
+  }
+  msg->Reply();
+}
+
+}  // namespace mvt

@@ -1,0 +1,184 @@
+// Native runtime self-test: exercised by tests/test_native.py.
+// Covers the C API world (reference Test/unittests pattern: a 1-process
+// world where the whole PS path runs through real actors) plus the util
+// layer (queue/waiter/allocator/blob/flags) and the BSP sync protocol.
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "mvt/allocator.h"
+#include "mvt/blob.h"
+#include "mvt/c_api.h"
+#include "mvt/configure.h"
+#include "mvt/mt_queue.h"
+#include "mvt/waiter.h"
+
+static void test_utils() {
+  // flags
+  mvt::config::Define("st_int", 3);
+  mvt::config::Define("st_bool", false);
+  int argc = 3;
+  const char* argv_c[] = {"prog", "-st_int=9", "-st_bool=true"};
+  char* argv[3];
+  for (int i = 0; i < 3; ++i) argv[i] = const_cast<char*>(argv_c[i]);
+  mvt::config::ParseCMDFlags(&argc, argv);
+  assert(argc == 1);
+  assert(mvt::config::GetInt("st_int") == 9);
+  assert(mvt::config::GetBool("st_bool"));
+
+  // queue
+  mvt::MtQueue<int> q;
+  q.Push(1);
+  q.Push(2);
+  int v;
+  assert(q.Pop(&v) && v == 1);
+  assert(q.TryPop(&v) && v == 2);
+  assert(!q.TryPop(&v));
+  q.Exit();
+  assert(!q.Pop(&v));
+
+  // waiter
+  mvt::Waiter w(2);
+  std::thread t([&] { w.Wait(); });
+  w.Notify();
+  w.Notify();
+  t.join();
+
+  // allocator + blob refcounting
+  {
+    mvt::Blob a(128);
+    memset(a.data(), 7, 128);
+    mvt::Blob b = a;  // shallow share
+    assert(b.data() == a.data());
+    mvt::Blob c(a.data(), 128);  // deep copy
+    assert(c.data() != a.data());
+    assert(c.data()[100] == 7);
+  }
+  std::printf("utils OK\n");
+}
+
+static void test_async_tables() {
+  int argc = 1;
+  char prog[] = "prog";
+  char* argv[] = {prog};
+  MV_Init(&argc, argv);
+
+  TableHandler array;
+  MV_NewArrayTable(100, &array);
+  std::vector<float> delta(100);
+  for (int i = 0; i < 100; ++i) delta[i] = static_cast<float>(i);
+  MV_AddArrayTable(array, delta.data(), 100);
+  MV_AddAsyncArrayTable(array, delta.data(), 100);
+  MV_Barrier();
+  std::vector<float> out(100);
+  MV_GetArrayTable(array, out.data(), 100);
+  for (int i = 0; i < 100; ++i) assert(out[i] == 2.0f * i);
+
+  TableHandler matrix;
+  MV_NewMatrixTable(10, 4, &matrix);
+  std::vector<float> rows(2 * 4, 1.0f);
+  int ids[2] = {3, 7};
+  MV_AddMatrixTableByRows(matrix, rows.data(), 8, ids, 2);
+  std::vector<float> got(2 * 4);
+  int ask[2] = {7, 3};
+  MV_GetMatrixTableByRows(matrix, got.data(), 8, ask, 2);
+  for (int i = 0; i < 8; ++i) assert(got[i] == 1.0f);
+  std::vector<float> all(40);
+  MV_GetMatrixTableAll(matrix, all.data(), 40);
+  assert(all[3 * 4] == 1.0f && all[0] == 0.0f);
+
+  MV_ShutDown();
+  std::printf("async tables OK\n");
+}
+
+static void test_sync_bsp() {
+  int argc = 3;
+  const char* argv_c[] = {"prog", "-sync=true", "-num_workers=2"};
+  char* argv[3];
+  for (int i = 0; i < 3; ++i) argv[i] = const_cast<char*>(argv_c[i]);
+  MV_Init(&argc, argv);
+
+  TableHandler table;
+  MV_NewArrayTable(8, &table);
+  const int iters = 4;
+  std::vector<std::vector<float>> gets(2 * iters, std::vector<float>(8));
+
+  auto worker = [&](int wid) {
+    MV_SetThreadWorkerId(wid);
+    std::vector<float> delta(8, static_cast<float>(wid + 1));
+    for (int it = 0; it < iters; ++it) {
+      MV_AddArrayTable(table, delta.data(), 8);
+      MV_GetArrayTable(table, gets[wid * iters + it].data(), 8);
+    }
+  };
+  std::thread t0(worker, 0), t1(worker, 1);
+  t0.join();
+  t1.join();
+  // BSP guarantee: both workers' i-th Get identical = 3*(i+1)
+  for (int it = 0; it < iters; ++it) {
+    for (int j = 0; j < 8; ++j) {
+      float expect = 3.0f * (it + 1);
+      assert(gets[it][j] == expect);
+      assert(gets[iters + it][j] == expect);
+    }
+  }
+  MV_ShutDown();
+  std::printf("sync BSP OK\n");
+}
+
+static void test_updaters() {
+  {
+    int argc = 2;
+    const char* argv_c[] = {"prog", "-updater_type=sgd"};
+    char* argv[2];
+    for (int i = 0; i < 2; ++i) argv[i] = const_cast<char*>(argv_c[i]);
+    MV_Init(&argc, argv);
+    TableHandler t;
+    MV_NewArrayTable(4, &t);
+    std::vector<float> d(4, 0.5f), out(4);
+    MV_AddArrayTable(t, d.data(), 4);
+    MV_GetArrayTable(t, out.data(), 4);
+    for (int i = 0; i < 4; ++i) assert(out[i] == -0.5f);
+    MV_ShutDown();
+  }
+  std::printf("updaters OK\n");
+}
+
+static void test_reader() {
+  const char* text = "1 3:0.5 10:2.0\n0 1:1.5\n";
+  int64_t n_samples = 0, n_entries = 0;
+  MV_CountLibsvm(text, static_cast<int64_t>(strlen(text)), &n_samples,
+                 &n_entries);
+  assert(n_samples == 2 && n_entries == 3);
+  std::vector<int32_t> labels(2);
+  std::vector<float> weights(2), values(3);
+  std::vector<int64_t> offsets(3), keys(3);
+  MV_ParseLibsvm(text, static_cast<int64_t>(strlen(text)), 0, labels.data(),
+                 weights.data(), offsets.data(), keys.data(), values.data());
+  assert(labels[0] == 1 && labels[1] == 0);
+  assert(keys[0] == 3 && values[1] == 2.0f && keys[2] == 1);
+  assert(offsets[1] == 2 && offsets[2] == 3);
+
+  const char* words[] = {"cat", "dog"};
+  std::vector<int64_t> table(16);
+  MV_BuildVocabHash(words, 2, table.data(), 16);
+  const char* sent = "dog cat bird dog";
+  std::vector<int32_t> ids(8);
+  int64_t n = MV_TokenizeToIds(sent, static_cast<int64_t>(strlen(sent)),
+                               words, 2, table.data(), 16, ids.data(), 8);
+  assert(n == 4);
+  assert(ids[0] == 1 && ids[1] == 0 && ids[2] == -1 && ids[3] == 1);
+  std::printf("reader OK\n");
+}
+
+int main() {
+  test_utils();
+  test_async_tables();
+  test_sync_bsp();
+  test_updaters();
+  test_reader();
+  std::printf("ALL NATIVE TESTS OK\n");
+  return 0;
+}

@@ -1,0 +1,133 @@
+"""Native runtime tests: build the C++ library, run its self-test binary,
+and exercise the C API + fast readers from python over ctypes
+(the reference's c_api.cpp / binding path, SURVEY.md §2a/§2g)."""
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def native_build():
+    result = subprocess.run(["make", "-C", NATIVE_DIR, "-j4"],
+                            capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    return NATIVE_DIR
+
+
+class TestSelftestBinary:
+    def test_cpp_selftest(self, native_build):
+        """Runs the full C++ suite: utils, async tables, BSP sync protocol,
+        updaters, readers."""
+        result = subprocess.run([os.path.join(native_build, "mvt_selftest")],
+                                capture_output=True, text=True, timeout=120)
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "ALL NATIVE TESTS OK" in result.stdout
+
+
+class TestCApiFromPython:
+    """The binding path: ctypes over libmultiverso_tpu.so
+    (reference binding/python loads libmultiverso the same way)."""
+
+    @pytest.fixture()
+    def capi(self, native_build):
+        lib = ctypes.CDLL(os.path.join(native_build, "libmultiverso_tpu.so"))
+        argc = ctypes.c_int(1)
+        argv = (ctypes.c_char_p * 1)(b"prog")
+        lib.MV_Init(ctypes.byref(argc), argv)
+        yield lib
+        lib.MV_ShutDown()
+
+    def test_array_roundtrip(self, capi):
+        handle = ctypes.c_void_p()
+        capi.MV_NewArrayTable(10, ctypes.byref(handle))
+        data = np.arange(10, dtype=np.float32)
+        ptr = data.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        capi.MV_AddArrayTable(handle, ptr, 10)
+        out = np.zeros(10, np.float32)
+        capi.MV_GetArrayTable(handle,
+                              out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                              10)
+        np.testing.assert_allclose(out, data)
+
+    def test_matrix_rows(self, capi):
+        handle = ctypes.c_void_p()
+        capi.MV_NewMatrixTable(6, 3, ctypes.byref(handle))
+        deltas = np.ones((2, 3), np.float32)
+        ids = np.array([1, 4], np.int32)
+        capi.MV_AddMatrixTableByRows(
+            handle, deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), 2)
+        out = np.zeros((2, 3), np.float32)
+        capi.MV_GetMatrixTableByRows(
+            handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), 2)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_world_introspection(self, capi):
+        assert capi.MV_NumWorkers() == 1
+        assert capi.MV_WorkerId() == 0
+
+
+class TestNativeReader:
+    def test_parse_libsvm(self, native_build):
+        from multiverso_tpu import native
+        parsed = native.parse_libsvm(b"1 3:0.5 10:2\n0 1:1.5\n")
+        assert parsed is not None
+        labels, weights, offsets, keys, values = parsed
+        assert labels.tolist() == [1, 0]
+        assert keys.tolist() == [3, 10, 1]
+        np.testing.assert_allclose(values, [0.5, 2.0, 1.5])
+        assert offsets.tolist() == [0, 2, 3]
+
+    def test_weighted(self, native_build):
+        from multiverso_tpu import native
+        labels, weights, offsets, keys, values = native.parse_libsvm(
+            b"1:0.25 2:1\n", weighted=True)
+        assert labels[0] == 1
+        assert weights[0] == pytest.approx(0.25)
+
+    def test_logreg_uses_native_reader(self, native_build, tmp_path):
+        """The LR sparse pipeline gives identical samples through both paths."""
+        from multiverso_tpu.models.logreg.configure import Configure
+        from multiverso_tpu.models.logreg import data as lr_data
+        text = "1 3:0.5 7:2.0\n0 1:1.5 9:1.0\n"
+        path = tmp_path / "sp.txt"
+        path.write_text(text)
+        cfg = Configure()
+        cfg.input_size = 10
+        cfg.sparse = True
+        native_samples = list(lr_data.iter_samples(str(path), cfg))
+        # force the python path
+        from multiverso_tpu import native as native_mod
+        orig = native_mod.lib
+        native_mod.lib = lambda: None
+        try:
+            py_samples = list(lr_data.iter_samples(str(path), cfg))
+        finally:
+            native_mod.lib = orig
+        assert len(native_samples) == len(py_samples) == 2
+        for (l1, w1, k1, v1), (l2, w2, k2, v2) in zip(native_samples,
+                                                      py_samples):
+            assert l1 == l2 and w1 == w2
+            np.testing.assert_array_equal(k1, k2)
+            np.testing.assert_allclose(v1, v2)
+
+    def test_malformed_input_raises(self, native_build):
+        """Malformed tokens must fail the run, not parse as zeros
+        (native parser returns -1 -> ValueError)."""
+        from multiverso_tpu import native
+        with pytest.raises(ValueError):
+            native.parse_libsvm(b"1 abc:2\n")
+        with pytest.raises(ValueError):
+            native.parse_libsvm(b"xyz 1:2\n")
