@@ -1,0 +1,13 @@
+"""TPU kernels for the parameter-server hot path.
+
+The reference's hot loops are the server's per-row updater application and
+the serialize/memcpy path (reference src/updater/updater.cpp:21-29 OpenMP
+loops; src/net/mpi_net.h:300-349 serialize memcpys). Here they are device
+kernels: Pallas row gather / scatter on TPU (one DMA per requested row,
+no full-table traffic), with an XLA fallback for CPU test meshes.
+"""
+
+from multiverso_tpu.ops.rows import (gather_rows, scatter_set_rows,
+                                     use_pallas)
+
+__all__ = ["gather_rows", "scatter_set_rows", "use_pallas"]

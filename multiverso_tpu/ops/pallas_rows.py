@@ -1,0 +1,89 @@
+"""Pallas TPU kernels: dynamic row gather / scatter on a table shard.
+
+These are the device half of the PS data plane. A ``Get`` over a row set is
+one row-DMA per requested row out of the shard in HBM; an ``Add`` is the
+mirrored write. The row ids arrive as *scalar-prefetch* operands so the DMA
+addresses are known before each grid step runs
+(``pltpu.PrefetchScalarGridSpec``).
+
+Contract (enforced by the caller, multiverso_tpu/tables/matrix_table.py):
+
+* every id is in ``[0, num_rows)`` of the *local shard* — out-of-shard and
+  padding lanes are pre-mapped to the shard's trash row;
+* duplicate ids only occur on the trash row (the caller pre-combines
+  duplicates), whose content is don't-care — so the scatter's
+  revisit-a-block hazard cannot corrupt live data.
+
+On non-TPU backends the kernels run in interpreter mode (tests); the table
+layer normally uses the XLA fallback there (rows.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gather_kernel(ids_ref, data_ref, out_ref):
+    del ids_ref  # consumed by the index_map
+    out_ref[...] = data_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_gather_rows(data: jax.Array, ids: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """rows[i] = data[ids[i]] — one grid step (one row DMA) per id."""
+    n = ids.shape[0]
+    cols = data.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, cols), lambda i, ids: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, cols), data.dtype),
+        interpret=interpret,
+    )(ids, data)
+
+
+def _scatter_kernel(ids_ref, rows_ref, data_ref, out_ref):
+    del ids_ref, data_ref  # index_map consumes ids; data is the alias donor
+    out_ref[...] = rows_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def pallas_scatter_set_rows(data: jax.Array, ids: jax.Array,
+                            rows: jax.Array,
+                            interpret: bool = False) -> jax.Array:
+    """data[ids[i]] = rows[i], in place (data is donated/aliased).
+
+    Rows the grid never maps keep their HBM content — only touched rows
+    move, which is the whole point of the PS row protocol.
+    """
+    n = ids.shape[0]
+    cols = data.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, cols), lambda i, ids: (i, 0)),        # rows
+            pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),   # data (alias)
+        ],
+        out_specs=pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(data.shape, data.dtype),
+        input_output_aliases={2: 0},  # operand index counts the prefetch arg
+        interpret=interpret,
+    )(ids, rows, data)

@@ -1,0 +1,51 @@
+"""Backend dispatch for the row gather/scatter table ops.
+
+``use_pallas`` is governed by the ``use_pallas`` flag:
+``auto`` (default) — Pallas on TPU, XLA elsewhere; ``on`` — Pallas
+everywhere (interpreter mode off-TPU; used by tests); ``off`` — XLA.
+
+The XLA fallback relies on jit'd gather + ``.at[].set`` — on a CPU test
+mesh that is both correct and fast enough; on TPU the Pallas kernels avoid
+materializing gather/scatter HLO over the whole shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_string
+
+MV_DEFINE_string("use_pallas", "auto",
+                 "row-op kernels: auto (TPU only) / on / off")
+
+
+def use_pallas() -> bool:
+    mode = str(GetFlag("use_pallas")).lower()
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
+    """rows[i] = data[ids[i]]; all ids must be in range (caller maps
+    out-of-shard lanes to the trash row)."""
+    if use_pallas():
+        from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
+        return pallas_gather_rows(data, ids, interpret=_interpret())
+    return jnp.take(data, ids, axis=0)
+
+
+def scatter_set_rows(data: jax.Array, ids: jax.Array,
+                     rows: jax.Array) -> jax.Array:
+    """data[ids[i]] = rows[i]; duplicates only on the trash row."""
+    if use_pallas():
+        from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
+        return pallas_scatter_set_rows(data, ids, rows, interpret=_interpret())
+    return data.at[ids].set(rows)

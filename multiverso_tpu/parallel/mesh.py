@@ -44,12 +44,24 @@ def partition_offsets(size: int, num_servers: int) -> List[Tuple[int, int]]:
 
 
 def row_partition_server(row: int, num_rows: int, num_servers: int) -> int:
-    """Which server owns a row: ``row / (num_row / num_server)`` with the
-    tail clamped to the last server (reference matrix_table.cpp:24-46)."""
+    """Reference-parity row→server math: ``row / (num_row / num_server)``
+    with the tail clamped to the last server (reference
+    matrix_table.cpp:24-46). Kept as the parity-tested pure function; the
+    actual TPU storage ownership is ``storage_partition_server`` (equal-size
+    shards — jax shards must be uniform, so the remainder spreads by ceil
+    blocks instead of piling on the last server). The two agree whenever
+    ``num_servers`` divides ``num_rows``."""
     base = num_rows // num_servers
     if base == 0:
         return 0
     return min(row // base, num_servers - 1)
+
+
+def storage_partition_server(row: int, num_rows: int, num_servers: int) -> int:
+    """Which server shard actually owns a row in the interleaved TPU layout
+    (matrix_table.py): ceil-based equal blocks."""
+    block = -(-num_rows // num_servers)
+    return min(row // block, num_servers - 1)
 
 
 def pad_to_multiple(n: int, m: int) -> int:

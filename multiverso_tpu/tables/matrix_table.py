@@ -2,36 +2,49 @@
 
 Behavioral equivalent of reference include/multiverso/table/matrix_table.h +
 src/table/matrix_table.cpp (and the merged "matrix v2" src/table/matrix.cpp):
-whole-table or row-set ``Get``/``Add``; rows map to servers by
-``row / (num_rows / num_servers)`` with the tail on the last server
-(matrix_table.cpp:24-46); the server applies the updater per row
+whole-table or row-set ``Get``/``Add``; the reference maps rows to servers
+by ``row / (num_rows / num_servers)`` with the tail on the last server
+(matrix_table.cpp:24-46) — here ownership uses ceil-sized equal blocks
+instead (jax shards must be uniform; see parallel/mesh.py
+``storage_partition_server``); the server applies the updater per row
 (matrix_table.cpp:387-418); optional random row initialization
 (matrix_table.cpp:372-384); ``Store/Load`` checkpointing
 (matrix_table.cpp:457-465).
 
-TPU design: storage is ONE jax array of shape (padded_rows, num_cols)
-sharded on the row axis over the mesh ``server`` axis. Row-set ops are jit'd
-gather -> updater -> scatter computations; row-id batches are padded to
-power-of-two buckets so XLA compiles a handful of shapes, with a dedicated
-trash row absorbing the padding (never read back). Per-worker updater state
-(AdaGrad) and shared state (momentum) are gathered/scattered alongside the
-data rows. Duplicate ids inside one Add are pre-combined on the host
-(np.add.at) because scatter-set order is undefined — the reference applies
-rows sequentially so duplicates stack; combining first preserves the
-default/sgd semantics and is the documented contract for the others.
+TPU design: storage is ONE jax array sharded on the row axis over the mesh
+``server`` axis, in an *interleaved* layout — each server shard holds
+``block_rows`` contiguous logical rows plus one **trash row** at its tail.
+Row-set ops run under ``shard_map``: every shard maps the (replicated)
+global id vector to local ids, routes out-of-shard and padding lanes to its
+trash row, and gathers/scatters only the requested rows — the Pallas
+kernels in multiverso_tpu/ops do one row-DMA per id on TPU, and the
+assembled Get result is a ``psum`` of masked shard contributions, so only
+the requested rows ever ride ICI (no full-table all-gather, mirroring the
+reference where only the partitioned row payloads cross the network,
+matrix_table.cpp:235-296). Row-id batches are padded to power-of-two
+buckets (pad lane = -1) so XLA compiles a handful of shapes. Per-worker
+updater state (AdaGrad) is sharded along the same row axis and
+gathered/scattered alongside the data rows. Duplicate ids inside one Add
+are pre-combined on the host (np.add.at) because scatter order is
+undefined — the reference applies rows sequentially so duplicates stack;
+combining first preserves the default/sgd semantics and is the documented
+contract for the others.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
-from multiverso_tpu.parallel.mesh import (next_bucket, pad_to_multiple,
-                                          row_partition_server)
+from multiverso_tpu import ops
+from multiverso_tpu.parallel.mesh import (SERVER_AXIS, next_bucket,
+                                          storage_partition_server)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
 from multiverso_tpu.utils.log import CHECK
@@ -63,17 +76,17 @@ class MatrixServerTable(ServerTable):
         self._zoo = zoo
         ctx = zoo.mesh_ctx
         self.num_servers = ctx.num_servers
-        # +1 guarantees a trash row beyond the logical rows for bucket padding.
-        self.padded_rows = pad_to_multiple(num_rows + 1, self.num_servers)
-        self.trash_row = num_rows
+        # Interleaved storage: each shard = block_rows logical rows + 1 trash.
+        self.block_rows = -(-num_rows // self.num_servers)  # ceil
+        self.shard_rows = self.block_rows + 1
+        self.padded_rows = self.num_servers * self.shard_rows
         self.updater = CreateUpdater(updater_type)
+        self._mesh = ctx.mesh
 
         self._sharding = ctx.sharding_rows()
         if initializer is not None:
-            init = np.zeros((self.padded_rows, num_cols), self.dtype)
-            init[:num_rows] = np.asarray(initializer((num_rows, num_cols)),
-                                         self.dtype)
-            data = jnp.asarray(init)
+            init = np.asarray(initializer((num_rows, num_cols)), self.dtype)
+            data = self._to_storage(init)  # host numpy; place() shards it
         else:
             data = jnp.zeros((self.padded_rows, num_cols), self.dtype)
         aux = self.updater.init_aux((self.padded_rows, num_cols), self.dtype,
@@ -83,42 +96,90 @@ class MatrixServerTable(ServerTable):
             "aux": jax.tree.map(
                 lambda a: ctx.place(a, self._aux_sharding(a, ctx)), aux),
         }
+        self._aux_specs = jax.tree.map(
+            lambda a: P(SERVER_AXIS, None) if a.ndim == 2
+            else P(None, SERVER_AXIS, None), aux)
+
+        block_rows = self.block_rows
+        updater = self.updater
+
+        def _local_lanes(ids):
+            """Map the replicated global id vector to this shard's rows.
+
+            Lanes owned elsewhere (and -1 padding) go to the trash row."""
+            s = lax.axis_index(SERVER_AXIS)
+            shard_of = jnp.where(ids >= 0, ids // block_rows, -1)
+            mine = shard_of == s
+            safe = jnp.where(mine, ids - s * block_rows, block_rows)
+            return mine, safe.astype(jnp.int32)
+
+        def _gather_aux(aux, safe):
+            def g(leaf):
+                if leaf.ndim == 2:           # shared state, shaped like data
+                    return jnp.take(leaf, safe, axis=0)
+                return jnp.take(leaf, safe, axis=1)  # per-worker state
+            return jax.tree.map(g, aux)
+
+        def _scatter_aux(aux, new_aux, safe):
+            def s(leaf, new_leaf):
+                if leaf.ndim == 2:
+                    return leaf.at[safe].set(new_leaf)
+                return leaf.at[:, safe].set(new_leaf)
+            return jax.tree.map(s, aux, new_aux)
 
         def _update_full(state, delta, opt):
-            new_data, new_aux = self.updater.update(state["data"], state["aux"],
-                                                    delta, opt)
+            new_data, new_aux = updater.update(state["data"], state["aux"],
+                                               delta, opt)
             return {"data": new_data, "aux": new_aux}
 
         self._update_full = jax.jit(_update_full, donate_argnums=(0,))
 
-        def _gather_aux(aux, ids):
-            def g(leaf):
-                if leaf.ndim == 2:           # shared state, shaped like data
-                    return leaf[ids]
-                return leaf[:, ids]          # per-worker: (num_workers, ...)
-            return jax.tree.map(g, aux)
-
-        def _scatter_aux(aux, new_aux, ids):
-            def s(leaf, new_leaf):
-                if leaf.ndim == 2:
-                    return leaf.at[ids].set(new_leaf)
-                return leaf.at[:, ids].set(new_leaf)
-            return jax.tree.map(s, aux, new_aux)
+        def _update_rows_local(local_data, local_aux, ids, deltas, opt):
+            _, safe = _local_lanes(ids)
+            rows = ops.gather_rows(local_data, safe)
+            aux_rows = _gather_aux(local_aux, safe)
+            new_rows, new_aux_rows = updater.update(rows, aux_rows, deltas,
+                                                    opt)
+            # Non-mine lanes computed garbage from the trash row — it goes
+            # straight back to the trash row, never to live data.
+            data = ops.scatter_set_rows(local_data, safe, new_rows)
+            aux = _scatter_aux(local_aux, new_aux_rows, safe)
+            return data, aux
 
         def _update_rows(state, ids, deltas, opt):
-            rows = state["data"][ids]
-            aux_rows = _gather_aux(state["aux"], ids)
-            new_rows, new_aux_rows = self.updater.update(rows, aux_rows,
-                                                         deltas, opt)
-            data = state["data"].at[ids].set(new_rows)
-            aux = _scatter_aux(state["aux"], new_aux_rows, ids)
+            data, aux = jax.shard_map(
+                _update_rows_local, mesh=self._mesh,
+                in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
+                          P()),
+                out_specs=(P(SERVER_AXIS, None), self._aux_specs),
+                check_vma=False,  # pallas_call outputs carry no vma info
+            )(state["data"], state["aux"], ids, deltas, opt)
             return {"data": data, "aux": aux}
 
         self._update_rows = jax.jit(_update_rows, donate_argnums=(0,))
 
-        def _gather_rows(state, ids, opt):
-            data = self.updater.access(state["data"], state["aux"], opt)
-            return data[ids]
+        # Apply the access hook on the row path only when an updater
+        # overrides it (identity for every reference updater,
+        # updater.cpp:32) — the common case skips the aux gather.
+        from multiverso_tpu.updaters.base import Updater as _UpdaterBase
+        has_access = type(updater).access is not _UpdaterBase.access
+
+        def _gather_rows_local(local_data, local_aux, ids):
+            mine, safe = _local_lanes(ids)
+            rows = ops.gather_rows(local_data, safe)
+            if has_access:
+                rows = updater.access(rows, _gather_aux(local_aux, safe),
+                                      None)
+            rows = jnp.where(mine[:, None], rows, 0)
+            return lax.psum(rows, SERVER_AXIS)
+
+        def _gather_rows(data, aux, ids):
+            return jax.shard_map(
+                _gather_rows_local, mesh=self._mesh,
+                in_specs=(P(SERVER_AXIS, None), self._aux_specs, P()),
+                out_specs=P(),
+                check_vma=False,  # pallas_call outputs carry no vma info
+            )(data, aux, ids)
 
         self._gather_rows = jax.jit(_gather_rows)
 
@@ -127,11 +188,31 @@ class MatrixServerTable(ServerTable):
             return ctx.sharding_rows()
         return ctx.sharding_worker_rows()
 
+    # -- storage layout (interleaved shard blocks + trash rows) -------------
+
+    def _to_storage(self, full: np.ndarray) -> np.ndarray:
+        """(num_rows, cols) logical -> (padded_rows, cols) storage."""
+        out = np.zeros((self.num_servers, self.shard_rows, self.num_cols),
+                       full.dtype)
+        padded = np.zeros((self.num_servers * self.block_rows, self.num_cols),
+                          full.dtype)
+        padded[: self.num_rows] = full
+        out[:, : self.block_rows] = padded.reshape(self.num_servers,
+                                                   self.block_rows,
+                                                   self.num_cols)
+        return out.reshape(self.padded_rows, self.num_cols)
+
+    def _from_storage(self, storage: np.ndarray) -> np.ndarray:
+        """(padded_rows, cols) storage -> (num_rows, cols) logical."""
+        blocks = storage.reshape(self.num_servers, self.shard_rows,
+                                 self.num_cols)[:, : self.block_rows]
+        return blocks.reshape(-1, self.num_cols)[: self.num_rows]
+
     # -- helpers ------------------------------------------------------------
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
         bucket = next_bucket(len(ids))
-        out = np.full(bucket, self.trash_row, np.int32)
+        out = np.full(bucket, -1, np.int32)
         out[: len(ids)] = ids
         return out
 
@@ -156,10 +237,8 @@ class MatrixServerTable(ServerTable):
         if row_ids is None:
             values = np.asarray(values, self.dtype).reshape(self.num_rows,
                                                             self.num_cols)
-            if self.padded_rows != self.num_rows:
-                values = np.pad(values,
-                                ((0, self.padded_rows - self.num_rows), (0, 0)))
-            delta = self._zoo.mesh_ctx.place(values, self._sharding)
+            delta = self._zoo.mesh_ctx.place(self._to_storage(values),
+                                             self._sharding)
             self.state = self._update_full(self.state, delta, option.as_jnp())
             return
         ids = np.asarray(row_ids, np.int32).ravel()
@@ -178,34 +257,35 @@ class MatrixServerTable(ServerTable):
         if row_ids is None:
             data = self.updater.access(self.state["data"], self.state["aux"],
                                        None)
-            return np.asarray(data)[: self.num_rows]
+            return self._from_storage(np.asarray(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
         padded_ids = self._pad_ids(ids)
-        rows = self._gather_rows(self.state, jnp.asarray(padded_ids), None)
+        rows = self._gather_rows(self.state["data"], self.state["aux"],
+                                 jnp.asarray(padded_ids))
         return np.asarray(rows)[: len(ids)]
 
-    def raw(self) -> jax.Array:
-        return self.state["data"]
+    def raw(self) -> np.ndarray:
+        """Logical-view snapshot (host numpy)."""
+        return self._from_storage(np.asarray(self.state["data"]))
 
     # -- checkpoint (reference matrix_table.cpp:457-465) --------------------
 
     def Store(self, stream) -> None:
         stream.WriteInt(self.num_rows)
         stream.WriteInt(self.num_cols)
-        data = np.asarray(self.state["data"])[: self.num_rows]
-        stream.Write(data.tobytes())
+        stream.Write(self.raw().tobytes())
 
     def Load(self, stream) -> None:
         rows, cols = stream.ReadInt(), stream.ReadInt()
         CHECK(rows == self.num_rows and cols == self.num_cols,
               "checkpoint shape mismatch")
         raw = stream.Read(rows * cols * self.dtype.itemsize)
-        values = np.frombuffer(raw, self.dtype).reshape(rows, cols).copy()
-        values = np.pad(values, ((0, self.padded_rows - rows), (0, 0)))
+        values = np.frombuffer(raw, self.dtype).reshape(rows, cols)
         ctx = self._zoo.mesh_ctx
         self.state = dict(self.state)
-        self.state["data"] = ctx.place(jnp.asarray(values), self._sharding)
+        self.state["data"] = ctx.place(self._to_storage(values),
+                                       self._sharding)
 
 
 class MatrixWorkerTable(WorkerTable):
@@ -260,11 +340,15 @@ class MatrixWorkerTable(WorkerTable):
     # -- pure partition math (reference matrix_table.cpp:235-296) -----------
 
     def Partition(self, row_ids, num_servers: Optional[int] = None) -> Dict[int, list]:
-        """Bucket row ids by owning server — unit-testable pure function."""
+        """Bucket row ids by owning server — unit-testable pure function.
+
+        Uses the storage ownership actually in effect (ceil blocks, see
+        parallel/mesh.py); matches the reference floor math whenever
+        num_servers divides num_rows."""
         if num_servers is None:
             num_servers = self._zoo.num_servers
         out: Dict[int, list] = {}
         for r in np.asarray(row_ids).ravel():
-            s = row_partition_server(int(r), self.num_rows, num_servers)
+            s = storage_partition_server(int(r), self.num_rows, num_servers)
             out.setdefault(s, []).append(int(r))
         return out
