@@ -1,24 +1,33 @@
 #!/usr/bin/env python
-"""Framework benchmark: MatrixTable dense row Get/Add throughput.
+"""Framework benchmark. Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 
-TPU-native equivalent of the reference perf harness
-(reference Test/test_matrix_perf.cpp:33-127: a 1,000,000 x 50 float matrix
-table, rounds of "Get rows / Add p% of rows" with wall-clock per op and
-correctness checks). The workload is the parameter-server hot path: the
-worker pushes row deltas (host -> HBM + jit'd scatter-update on the sharded
-store) and pulls row sets (jit'd gather + device -> host).
+Headline metric — LogisticRegression dense training throughput
+(samples/sec), the reference's own benchmark app (reference
+Applications/LogisticRegression; its README headline is wall-clock to train
+click-prediction LR, README.md:6). RCV1-shaped problem (47,236 features,
+binary sigmoid objective) through the framework's actual jit'd train
+computation (multiverso_tpu/models/logreg/objective.make_dense_grad_fn),
+scanned on device so an epoch is ONE XLA program — weights never leave HBM.
+Baseline = identical math in numpy on the host CPU (the reference's compute
+substrate; its per-sample loops were C++ — BLAS-backed numpy is a generous
+stand-in). Loss parity is asserted between the two before reporting.
 
-Baseline = the same operation sequence through a numpy CPU store — the
-reference server's memcpy/axpy path (reference updater.cpp:21-29 runs the
-adds as CPU loops; OpenMP there, BLAS-backed numpy here is a *generous*
-stand-in). ``vs_baseline`` > 1 means the TPU path beats it.
+Secondary fields — the MatrixTable row Get/Add hot path (reference
+Test/test_matrix_perf.cpp:33-127: 1M x 50 f32 table, rounds of "Add 1% of
+rows / Get them back"):
+  * device-plane: rounds traced into one scanned program via the table's
+    device_update_rows/device_gather_rows (how a TPU-resident worker uses
+    the store — SURVEY.md §5 'distributed communication backend'),
+  * host-plane: the blocking numpy Get/Add protocol verbs (worker on
+    another host; pays host<->device transfer per op).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Timing note: on the axon TPU tunnel ``block_until_ready`` does not reliably
+block, so every timed region ends with a forced scalar fetch.
 
-Safety: the axon TPU tunnel is single-client and can wedge; if backend
-init doesn't complete within --init-timeout seconds the bench re-execs
-itself on CPU so the driver never hangs (recorded in the JSON as
-"platform": "cpu-fallback").
+Safety: the axon TPU tunnel is single-client and can wedge; if backend init
+doesn't complete within --init-timeout seconds the bench re-execs itself on
+CPU so the driver never hangs (recorded in the JSON as "cpu-fallback").
 """
 
 from __future__ import annotations
@@ -30,10 +39,21 @@ import sys
 import threading
 import time
 
+# LR headline config (RCV1 shape: 47236 features; binary labels)
+LR_FEATURES = 47_236
+LR_BATCH = 1024
+LR_STAGED_BATCHES = 8
+LR_STEPS = 400
+LR_BASE_STEPS = 40          # numpy baseline steps (extrapolated)
+LR_LR = 0.1
+
+# Matrix-table secondary config (reference test_matrix_perf.cpp)
 N_ROWS = 1_000_000
 N_COLS = 50
-ROW_FRACTION = 0.01     # rows touched per op (reference add_percent idiom)
-ROUNDS = 20
+ROW_FRACTION = 0.01
+ROUNDS = 100
+HOST_ROUNDS = 3
+
 INIT_TIMEOUT_S = 120
 
 
@@ -69,70 +89,195 @@ def _init_jax_guarded():
     sys.exit(out.returncode)
 
 
-def bench_table(np, rng):
-    """Row Get/Add rounds through the framework table; returns (elems, secs)."""
+def _fail(metric, err, unit="samples/s"):
+    print(json.dumps({"metric": metric, "value": 0, "unit": unit,
+                      "vs_baseline": 0, "error": err}))
+    sys.exit(1)
+
+
+def bench_logreg(np, rng):
+    """-> (tpu_samples_per_s, cpu_samples_per_s)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from multiverso_tpu.models.logreg.configure import Configure
+    from multiverso_tpu.models.logreg import objective as obj
+
+    cfg = Configure(input_size=LR_FEATURES, output_size=1,
+                    objective_type="sigmoid", regular_type="none",
+                    minibatch_size=LR_BATCH, learning_rate=LR_LR)
+    grad_fn = obj.make_dense_grad_fn(cfg)
+
+    X = rng.standard_normal(
+        (LR_STAGED_BATCHES, LR_BATCH, LR_FEATURES)).astype(np.float32) * 0.05
+    true_w = rng.standard_normal((LR_FEATURES, 1)).astype(np.float32)
+    logits = np.einsum("sbf,fo->sbo", X, true_w)
+    labels = (logits[..., 0] > 0).astype(np.int32)  # separable: loss falls
+    weights = np.ones((LR_STAGED_BATCHES, LR_BATCH), np.float32)
+
+    @jax.jit
+    def epoch(W, X, labels, wts):
+        def step(W, x):
+            Xb, lb, wb = x
+            grad, loss = grad_fn(W, Xb, lb, wb)
+            return W - LR_LR * grad, loss
+        reps = LR_STEPS // LR_STAGED_BATCHES
+        def rep(W, _):
+            return lax.scan(step, W, (X, labels, wts))
+        W, losses = lax.scan(rep, W, None, length=reps)
+        return W, losses
+
+    W0 = jnp.zeros((LR_FEATURES, 1), jnp.float32)
+    Xd = jax.device_put(X)
+    ld = jax.device_put(labels)
+    wd = jax.device_put(weights)
+    W, losses = epoch(W0, Xd, ld, wd)
+    first_loss = float(losses[0, 0])
+    t0 = time.perf_counter()
+    W, losses = epoch(W0, Xd, ld, wd)
+    final_loss = float(losses[-1, -1])   # forced fetch = sync
+    tpu_secs = time.perf_counter() - t0
+    if not (final_loss < first_loss):
+        _fail("logreg_train_throughput",
+              f"loss did not decrease: {first_loss} -> {final_loss}")
+
+    # numpy baseline: identical math, LR_BASE_STEPS steps, extrapolated
+    Wn = np.zeros((LR_FEATURES, 1), np.float32)
+    def np_step(Wn, s):
+        Xb, lb, wb = X[s], labels[s], weights[s]
+        act = 1.0 / (1.0 + np.exp(-(Xb @ Wn)))
+        onehot = (lb == 1).astype(np.float32)[:, None]
+        loss = np.sum(np.sum((act - onehot) ** 2, axis=-1) * (wb > 0))
+        diff = (act - onehot) * wb[:, None]
+        grad = (Xb.T @ diff) / max(np.sum(wb > 0), 1)
+        return Wn - LR_LR * grad, loss
+    Wn, _ = np_step(Wn, 0)  # warm
+    Wn = np.zeros((LR_FEATURES, 1), np.float32)
+    t0 = time.perf_counter()
+    np_losses = []
+    for s in range(LR_BASE_STEPS):
+        Wn, loss = np_step(Wn, s % LR_STAGED_BATCHES)
+        np_losses.append(loss)
+    cpu_secs = (time.perf_counter() - t0) * (LR_STEPS / LR_BASE_STEPS)
+
+    # loss parity at the comparable step (same data order, same updates)
+    jax_loss_at = float(losses.ravel()[LR_BASE_STEPS - 1])
+    if not np.isclose(jax_loss_at, np_losses[-1], rtol=2e-2, atol=1.0):
+        _fail("logreg_train_throughput",
+              f"loss mismatch at step {LR_BASE_STEPS}: "
+              f"jax {jax_loss_at} vs numpy {np_losses[-1]}")
+
+    total = LR_STEPS * LR_BATCH
+    return total / tpu_secs, total / cpu_secs
+
+
+def bench_matrix_table(np, rng):
+    """-> (device_Melem_s, host_Melem_s, numpy_Melem_s)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
     import multiverso_tpu as mv
     from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.updaters.base import AddOption
 
     mv.MV_Init([])
     table = mv.MV_CreateTable(MatrixTableOption(num_rows=N_ROWS,
                                                 num_cols=N_COLS))
+    server = table.server()
     k = int(N_ROWS * ROW_FRACTION)
-    ids = np.sort(rng.choice(N_ROWS, size=k, replace=False)).astype(np.int32)
-    deltas = rng.standard_normal((k, N_COLS)).astype(np.float32)
-    # warmup: compile the gather/scatter programs for this bucket size
+    ids_all = np.stack([
+        rng.choice(N_ROWS, size=k, replace=False).astype(np.int32)
+        for _ in range(ROUNDS)])
+    padded = np.stack([server.pad_ids(row) for row in ids_all])
+    deltas_all = rng.standard_normal(
+        (ROUNDS, padded.shape[1], N_COLS)).astype(np.float32)
+    deltas_all[:, k:] = 0.0
+    opt = AddOption().as_jnp()
+
+    @jax.jit
+    def run_rounds(state, padded_ids, deltas):
+        def body(state, x):
+            ids, d = x
+            state = server.device_update_rows(state, ids, d, opt)
+            rows = server.device_gather_rows(state["data"], state["aux"], ids)
+            return state, rows[0, 0]
+        return lax.scan(body, state, (padded_ids, deltas))
+
+    padded_d = jax.device_put(padded)
+    deltas_d = jax.device_put(deltas_all)
+    s0 = jax.tree.map(jnp.copy, server.state)
+    out = run_rounds(s0, padded_d, deltas_d)
+    float(out[1][-1])  # warm + sync
+    state = jax.tree.map(jnp.copy, server.state)
+    t0 = time.perf_counter()
+    state, ys = run_rounds(state, padded_d, deltas_d)
+    float(ys[-1])      # forced fetch = sync
+    device_secs = time.perf_counter() - t0
+    server.state = state
+
+    # correctness (reference CHECKs every element, test_matrix_perf.cpp:84-110)
+    # — accumulate only the contributions landing on the verified row set
+    check_ids = ids_all[-1]
+    pos = {int(r): i for i, r in enumerate(check_ids)}
+    expected = np.zeros((k, N_COLS), np.float32)
+    for r in range(ROUNDS):
+        hit = np.isin(ids_all[r], check_ids)
+        local = np.fromiter((pos[int(x)] for x in ids_all[r][hit]),
+                            np.int64, count=int(hit.sum()))
+        np.add.at(expected, local, deltas_all[r, :k][hit])
+    got = table.GetRows(check_ids)
+    if not np.allclose(got, expected, rtol=1e-4, atol=1e-4):
+        _fail("matrix_row_get_add", "correctness check failed", "Melem/s")
+
+    # host-plane: blocking protocol verbs (transfer-bound; few rounds)
+    ids = ids_all[0]
+    deltas = deltas_all[0, :k]
     table.AddRows(ids, deltas)
     table.GetRows(ids)
-    start = time.perf_counter()
-    for r in range(ROUNDS):
+    t0 = time.perf_counter()
+    for _ in range(HOST_ROUNDS):
         table.AddRows(ids, deltas)
-        rows = table.GetRows(ids)
-    elapsed = time.perf_counter() - start
-    # correctness check (reference CHECKs every element, :84-110)
-    expected = deltas * (ROUNDS + 1)
-    if not np.allclose(rows, expected, rtol=1e-4, atol=1e-4):
-        print(json.dumps({"metric": "matrix_row_get_add", "value": 0,
-                          "unit": "Melem/s", "vs_baseline": 0,
-                          "error": "correctness check failed"}))
-        sys.exit(1)
+        table.GetRows(ids)
+    host_secs = (time.perf_counter() - t0) * (ROUNDS / HOST_ROUNDS)
     mv.MV_ShutDown()
-    elems = 2 * ROUNDS * k * N_COLS  # one add + one get per round
-    return elems, elapsed
 
-
-def bench_numpy_baseline(np, rng):
-    """Reference-style CPU store: scatter-add + gather on a numpy matrix."""
+    # numpy CPU store baseline (the reference server's memcpy/axpy substrate)
     store = np.zeros((N_ROWS, N_COLS), np.float32)
-    k = int(N_ROWS * ROW_FRACTION)
-    ids = np.sort(rng.choice(N_ROWS, size=k, replace=False)).astype(np.int64)
-    deltas = rng.standard_normal((k, N_COLS)).astype(np.float32)
-    store[ids] += deltas  # warmup / page-in
-    start = time.perf_counter()
-    for _ in range(ROUNDS):
-        store[ids] += deltas   # ids unique -> same as np.add.at, faster
-        rows = store[ids].copy()
-    elapsed = time.perf_counter() - start
+    store[ids] += deltas
+    t0 = time.perf_counter()
+    for r in range(HOST_ROUNDS * 2):
+        i = ids_all[r % ROUNDS][:k]
+        store[i] += deltas
+        _ = store[i].copy()
+    numpy_secs = (time.perf_counter() - t0) * (ROUNDS / (HOST_ROUNDS * 2))
+
     elems = 2 * ROUNDS * k * N_COLS
-    return elems, elapsed
+    return (elems / device_secs / 1e6, elems / host_secs / 1e6,
+            elems / numpy_secs / 1e6)
 
 
 def main() -> int:
     jax, platform = _init_jax_guarded()
     import numpy as np
     rng = np.random.default_rng(0)
-    elems, secs = bench_table(np, rng)
-    base_elems, base_secs = bench_numpy_baseline(np, rng)
-    ours = elems / secs / 1e6
-    base = base_elems / base_secs / 1e6
+    tpu_sps, cpu_sps = bench_logreg(np, rng)
+    dev_me, host_me, base_me = bench_matrix_table(np, rng)
     print(json.dumps({
-        "metric": "matrix_table_row_get_add_throughput",
-        "value": round(ours, 2),
-        "unit": "Melem/s",
-        "vs_baseline": round(ours / base, 3),
+        "metric": "logreg_train_samples_per_sec",
+        "value": round(tpu_sps),
+        "unit": "samples/s",
+        "vs_baseline": round(tpu_sps / cpu_sps, 2),
         "platform": platform,
-        "baseline_Melem_s": round(base, 2),
-        "config": f"{N_ROWS}x{N_COLS} f32, {ROW_FRACTION:.0%} rows/op, "
-                  f"{ROUNDS} rounds",
+        "baseline_samples_per_sec": round(cpu_sps),
+        "config": f"dense sigmoid LR, {LR_FEATURES} features, "
+                  f"batch {LR_BATCH}, {LR_STEPS} steps, f32",
+        "matrix_table_device_Melem_s": round(dev_me, 1),
+        "matrix_table_host_Melem_s": round(host_me, 1),
+        "matrix_table_numpy_baseline_Melem_s": round(base_me, 1),
+        "matrix_config": f"{N_ROWS}x{N_COLS} f32, {ROW_FRACTION:.0%} "
+                         f"rows/op, {ROUNDS} rounds",
     }))
     return 0
 

@@ -123,3 +123,27 @@ class TorchParamManager(MVModelParamManager):
         with torch.no_grad():
             for p, arr in zip(self._params, _unflatten(vec, self._shapes)):
                 p.copy_(torch.from_numpy(np.ascontiguousarray(arr)))
+
+
+class SyncCallback:
+    """Train-loop hook syncing every ``freq`` batches
+    (reference binding/python/multiverso/theano_ext/keras_ext/callbacks.py:8-39:
+    ``MVCallback.on_batch_end`` calls ``param_manager.sync_all_param`` when
+    the batch counter hits the frequency).
+
+    Framework-agnostic: call ``on_batch_end()`` from any training loop (or
+    wire it as a keras/flax callback); ``on_train_end()`` does a final sync.
+    """
+
+    def __init__(self, param_manager: MVModelParamManager, freq: int = 1):
+        self.param_manager = param_manager
+        self.freq = max(int(freq), 1)
+        self._batch = 0
+
+    def on_batch_end(self, *_args, **_kw) -> None:
+        self._batch += 1
+        if self._batch % self.freq == 0:
+            self.param_manager.sync_all_param()
+
+    def on_train_end(self, *_args, **_kw) -> None:
+        self.param_manager.sync_all_param()

@@ -2,17 +2,25 @@
 
 These are the device half of the PS data plane. A ``Get`` over a row set is
 one row-DMA per requested row out of the shard in HBM; an ``Add`` is the
-mirrored write. The row ids arrive as *scalar-prefetch* operands so the DMA
-addresses are known before each grid step runs
-(``pltpu.PrefetchScalarGridSpec``).
+mirrored write. Row ids arrive as *scalar-prefetch* operands (SMEM) so DMA
+source/target addresses are computed in-kernel.
+
+Lowering constraints shape the design: a VMEM block must have its
+second-to-last dim divisible by 8 (or equal to the array dim), so single
+rows can't be blocks. Instead the grid runs over chunks of ``CHUNK=8`` ids;
+the table shard itself stays in HBM (``memory_space=ANY``) and the kernel
+issues one async row-copy per id — 8 outstanding DMAs per grid step, waited
+together, while Mosaic pipelines the chunk blocks across steps.
 
 Contract (enforced by the caller, multiverso_tpu/tables/matrix_table.py):
 
+* ``ids`` length is a multiple of 8 (the table layer pads row-id batches to
+  power-of-two buckets >= 8);
 * every id is in ``[0, num_rows)`` of the *local shard* — out-of-shard and
   padding lanes are pre-mapped to the shard's trash row;
 * duplicate ids only occur on the trash row (the caller pre-combines
-  duplicates), whose content is don't-care — so the scatter's
-  revisit-a-block hazard cannot corrupt live data.
+  duplicates), whose content is don't-care — so concurrent DMA writes to
+  the same row can only land on the trash row, never on live data.
 
 On non-TPU backends the kernels run in interpreter mode (tests); the table
 layer normally uses the XLA fallback there (rows.py).
@@ -27,37 +35,67 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+CHUNK = 8
 
-def _gather_kernel(ids_ref, data_ref, out_ref):
-    del ids_ref  # consumed by the index_map
-    out_ref[...] = data_ref[...]
+
+def _gather_kernel(ids_ref, data_ref, out_ref, sem):
+    i = pl.program_id(0)
+    copies = []
+    for j in range(CHUNK):
+        row = ids_ref[i * CHUNK + j]
+        copies.append(pltpu.make_async_copy(
+            data_ref.at[pl.ds(row, 1), :],
+            out_ref.at[pl.ds(j, 1), :],
+            sem.at[j]))
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_gather_rows(data: jax.Array, ids: jax.Array,
                        interpret: bool = False) -> jax.Array:
-    """rows[i] = data[ids[i]] — one grid step (one row DMA) per id."""
+    """rows[i] = data[ids[i]] — one row DMA per id, 8 per grid step."""
+    orig_n = ids.shape[0]
+    if orig_n % CHUNK:
+        # tail pad with id 0: a read-only over-fetch, sliced off below
+        pad = CHUNK - orig_n % CHUNK
+        ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
     n = ids.shape[0]
     cols = data.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n,),
+        grid=(n // CHUNK,),
         in_specs=[
-            pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
         ],
-        out_specs=pl.BlockSpec((1, cols), lambda i, ids: (i, 0)),
+        out_specs=pl.BlockSpec((CHUNK, cols), lambda i, ids: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((CHUNK,))],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _gather_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, cols), data.dtype),
         interpret=interpret,
     )(ids, data)
+    return out[:orig_n]
 
 
-def _scatter_kernel(ids_ref, rows_ref, data_ref, out_ref):
-    del ids_ref, data_ref  # index_map consumes ids; data is the alias donor
-    out_ref[...] = rows_ref[...]
+def _scatter_kernel(ids_ref, rows_ref, data_ref, out_ref, sem):
+    del data_ref  # alias donor; out_ref IS the table buffer
+    i = pl.program_id(0)
+    copies = []
+    for j in range(CHUNK):
+        row = ids_ref[i * CHUNK + j]
+        copies.append(pltpu.make_async_copy(
+            rows_ref.at[pl.ds(j, 1), :],
+            out_ref.at[pl.ds(row, 1), :],
+            sem.at[j]))
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
@@ -66,19 +104,26 @@ def pallas_scatter_set_rows(data: jax.Array, ids: jax.Array,
                             interpret: bool = False) -> jax.Array:
     """data[ids[i]] = rows[i], in place (data is donated/aliased).
 
-    Rows the grid never maps keep their HBM content — only touched rows
+    Rows the ids never name keep their HBM content — only touched rows
     move, which is the whole point of the PS row protocol.
     """
+    if ids.shape[0] % CHUNK:
+        # tail pad by replicating the last (id, row) pair: the extra DMAs
+        # rewrite the same bytes to the same row — a no-op on memory content
+        pad = CHUNK - ids.shape[0] % CHUNK
+        ids = jnp.concatenate([ids] + [ids[-1:]] * pad)
+        rows = jnp.concatenate([rows] + [rows[-1:]] * pad)
     n = ids.shape[0]
     cols = data.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n,),
+        grid=(n // CHUNK,),
         in_specs=[
-            pl.BlockSpec((1, cols), lambda i, ids: (i, 0)),        # rows
-            pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),   # data (alias)
+            pl.BlockSpec((CHUNK, cols), lambda i, ids: (i, 0)),   # rows: VMEM
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
         ],
-        out_specs=pl.BlockSpec((1, cols), lambda i, ids: (ids[i], 0)),
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((CHUNK,))],
     )
     return pl.pallas_call(
         _scatter_kernel,

@@ -20,13 +20,24 @@ MV_DEFINE_string("use_pallas", "auto",
                  "row-op kernels: auto (TPU only) / on / off")
 
 
-def use_pallas() -> bool:
+def _pallas_eligible(data) -> bool:
+    """Row DMAs slice HBM along the lane dim, so rows must be tile-aligned:
+    128 lanes for 4-byte dtypes (Mosaic: 'slice shape along dimension 1 must
+    be aligned to tiling (128)')."""
+    return data.dtype.itemsize == 4 and data.shape[-1] % 128 == 0
+
+
+def use_pallas(data=None) -> bool:
     mode = str(GetFlag("use_pallas")).lower()
     if mode == "on":
-        return True
+        # forced on: always in interpreter mode (tests); on a real TPU still
+        # respect the lowering constraint — an ineligible shape would be a
+        # Mosaic compile error, not a kernel choice
+        return _interpret() or data is None or _pallas_eligible(data)
     if mode == "off":
         return False
-    return jax.default_backend() == "tpu"
+    return (jax.default_backend() == "tpu"
+            and (data is None or _pallas_eligible(data)))
 
 
 def _interpret() -> bool:
@@ -36,7 +47,7 @@ def _interpret() -> bool:
 def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
     """rows[i] = data[ids[i]]; all ids must be in range (caller maps
     out-of-shard lanes to the trash row)."""
-    if use_pallas():
+    if use_pallas(data):
         from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
         return pallas_gather_rows(data, ids, interpret=_interpret())
     return jnp.take(data, ids, axis=0)
@@ -45,7 +56,7 @@ def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
 def scatter_set_rows(data: jax.Array, ids: jax.Array,
                      rows: jax.Array) -> jax.Array:
     """data[ids[i]] = rows[i]; duplicates only on the trash row."""
-    if use_pallas():
+    if use_pallas(data):
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         return pallas_scatter_set_rows(data, ids, rows, interpret=_interpret())
     return data.at[ids].set(rows)

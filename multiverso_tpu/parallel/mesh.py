@@ -57,10 +57,17 @@ def row_partition_server(row: int, num_rows: int, num_servers: int) -> int:
     return min(row // base, num_servers - 1)
 
 
+def ceil_block_rows(num_rows: int, num_servers: int) -> int:
+    """Rows per server shard in the interleaved TPU layout — the ONE place
+    the ceil-block ownership law lives (matrix_table.py storage and its
+    shard-local id math both derive from this)."""
+    return -(-num_rows // num_servers)
+
+
 def storage_partition_server(row: int, num_rows: int, num_servers: int) -> int:
     """Which server shard actually owns a row in the interleaved TPU layout
     (matrix_table.py): ceil-based equal blocks."""
-    block = -(-num_rows // num_servers)
+    block = ceil_block_rows(num_rows, num_servers)
     return min(row // block, num_servers - 1)
 
 

@@ -43,7 +43,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from multiverso_tpu import ops
-from multiverso_tpu.parallel.mesh import (SERVER_AXIS, next_bucket,
+from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
+                                          next_bucket,
                                           storage_partition_server)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
@@ -77,7 +78,7 @@ class MatrixServerTable(ServerTable):
         ctx = zoo.mesh_ctx
         self.num_servers = ctx.num_servers
         # Interleaved storage: each shard = block_rows logical rows + 1 trash.
-        self.block_rows = -(-num_rows // self.num_servers)  # ceil
+        self.block_rows = ceil_block_rows(num_rows, self.num_servers)
         self.shard_rows = self.block_rows + 1
         self.padded_rows = self.num_servers * self.shard_rows
         self.updater = CreateUpdater(updater_type)
@@ -157,6 +158,13 @@ class MatrixServerTable(ServerTable):
             return {"data": data, "aux": aux}
 
         self._update_rows = jax.jit(_update_rows, donate_argnums=(0,))
+        # Device plane: the same row-update program, un-jitted, for callers
+        # that trace it into a larger computation (a training step or a
+        # lax.scan over PS rounds) — on TPU this is how workers that live on
+        # the same mesh as the store use the table without ever leaving HBM.
+        # Signature: (state, padded_ids i32[bucket], deltas [bucket, cols],
+        # opt = AddOption.as_jnp()) -> state.
+        self.device_update_rows = _update_rows
 
         # Apply the access hook on the row path only when an updater
         # overrides it (identity for every reference updater,
@@ -182,6 +190,9 @@ class MatrixServerTable(ServerTable):
             )(data, aux, ids)
 
         self._gather_rows = jax.jit(_gather_rows)
+        # Device plane, get side: (data, aux, padded_ids) -> rows (replicated;
+        # trash/foreign lanes return 0 and are summed across shards).
+        self.device_gather_rows = _gather_rows
 
     def _aux_sharding(self, leaf, ctx):
         if leaf.ndim == 2:
@@ -215,6 +226,9 @@ class MatrixServerTable(ServerTable):
         out = np.full(bucket, -1, np.int32)
         out[: len(ids)] = ids
         return out
+
+    # public for device-plane callers (pad lane = -1 -> trash row)
+    pad_ids = _pad_ids
 
     def _check_ids(self, ids: np.ndarray) -> None:
         CHECK(ids.size > 0, "empty row id set")
@@ -336,6 +350,11 @@ class MatrixWorkerTable(WorkerTable):
         self.AddAsync(
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)},
             option, track=False)
+
+    def server(self) -> MatrixServerTable:
+        """The co-located server half — device-plane access (TPU workers
+        share the mesh with the store, so the 'network' is ICI)."""
+        return self._zoo.server_tables[self.table_id]
 
     # -- pure partition math (reference matrix_table.cpp:235-296) -----------
 

@@ -103,3 +103,20 @@ class TestParamManager:
             np.testing.assert_allclose(t.get(), 3.0)
         finally:
             mv.shutdown()
+
+    def test_sync_callback_freq(self, binding):
+        """SyncCallback syncs every ``freq`` batches + once at train end
+        (reference keras_ext/callbacks.py:36-39)."""
+        from multiverso_tpu.binding.param_manager import (JaxParamManager,
+                                                          SyncCallback)
+        params = {"w": np.zeros(4, np.float32)}
+        mgr = JaxParamManager(params)
+        cb = SyncCallback(mgr, freq=2)
+        syncs = []
+        orig = mgr.sync_all_param
+        mgr.sync_all_param = lambda: (syncs.append(1), orig())[1]
+        for _ in range(5):
+            cb.on_batch_end()
+        assert len(syncs) == 2          # batches 2 and 4
+        cb.on_train_end()
+        assert len(syncs) == 3
