@@ -134,10 +134,15 @@ def bench_logreg(np, rng):
     wd = jax.device_put(weights)
     W, losses = epoch(W0, Xd, ld, wd)
     first_loss = float(losses[0, 0])
-    t0 = time.perf_counter()
-    W, losses = epoch(W0, Xd, ld, wd)
-    final_loss = float(losses[-1, -1])   # forced fetch = sync
-    tpu_secs = time.perf_counter() - t0
+    # min-of-3: the first post-compile executions can absorb large one-off
+    # tunnel/housekeeping costs (observed 40x outliers on axon); the steady
+    # state is what the hardware does
+    tpu_secs = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        W, losses = epoch(W0, Xd, ld, wd)
+        final_loss = float(losses[-1, -1])   # forced fetch = sync
+        tpu_secs = min(tpu_secs, time.perf_counter() - t0)
     if not (final_loss < first_loss):
         _fail("logreg_train_throughput",
               f"loss did not decrease: {first_loss} -> {final_loss}")
@@ -210,11 +215,13 @@ def bench_matrix_table(np, rng):
     s0 = jax.tree.map(jnp.copy, server.state)
     out = run_rounds(s0, padded_d, deltas_d)
     float(out[1][-1])  # warm + sync
-    state = jax.tree.map(jnp.copy, server.state)
-    t0 = time.perf_counter()
-    state, ys = run_rounds(state, padded_d, deltas_d)
-    float(ys[-1])      # forced fetch = sync
-    device_secs = time.perf_counter() - t0
+    device_secs = float("inf")
+    for _ in range(3):   # min-of-3 (see logreg comment)
+        state = jax.tree.map(jnp.copy, server.state)
+        t0 = time.perf_counter()
+        state, ys = run_rounds(state, padded_d, deltas_d)
+        float(ys[-1])      # forced fetch = sync
+        device_secs = min(device_secs, time.perf_counter() - t0)
     server.state = state
 
     # correctness (reference CHECKs every element, test_matrix_perf.cpp:84-110)

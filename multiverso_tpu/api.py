@@ -103,3 +103,17 @@ def MV_NetConnect(ranks, endpoints) -> None:  # pragma: no cover - parity stub
     raise NotImplementedError(
         "MV_NetConnect is a ZMQ-deployment hook (reference multiverso.h:54-63); "
         "TPU meshes are wired by hardware/jax.distributed, nothing to connect")
+
+
+def MV_SaveCheckpoint(uri: str) -> int:
+    """Store every registered server table (+ updater aux state) to ``uri``
+    (framework-level driver over the per-table Serializable contract,
+    reference table_interface.h:61-70 — see checkpoint.py)."""
+    from multiverso_tpu.checkpoint import save_checkpoint
+    return save_checkpoint(uri)
+
+
+def MV_LoadCheckpoint(uri: str) -> int:
+    """Restore every registered server table from ``uri``."""
+    from multiverso_tpu.checkpoint import load_checkpoint
+    return load_checkpoint(uri)

@@ -1,0 +1,162 @@
+"""Framework-level checkpoint/resume of all server tables.
+
+The reference has no checkpoint *driver* — ``Serializable::Store/Load``
+exists on each server table (table_interface.h:61-70) but only apps call it,
+one table at a time, data only (SURVEY.md §5 "checkpoint/resume"). This
+module adds the TPU-native equivalent SURVEY.md §5 prescribes: one call
+saves every registered server table *plus its updater aux state* (the
+reference loses AdaGrad accumulators and momentum smoothing on restart —
+a training run resumed from a reference checkpoint silently restarts its
+second-moment estimates; here resume is exact).
+
+Format (all through the URI-dispatched Stream layer, utils/io.py, so
+anything the IO layer can address — local file now, other schemes when
+registered — can hold a checkpoint):
+
+    magic "MVTCKPT1", num_tables
+    per table: table_id, type name, length-framed Store() payload,
+               num aux leaves, per leaf: keypath, dtype, shape, bytes
+
+Sharded device arrays — data AND aux — are serialized in *logical* layout
+(tables expose ``aux_to_logical``/``aux_from_logical`` to strip their
+padding/interleaving) and re-placed with each table's live sharding on
+load, so the checkpoint is layout-independent: a job may resume on a
+different mesh size (the reference's per-server shard files cannot).
+Frames are verified on load: table type, full payload consumption (catches
+dtype/config drift), aux leaf shapes and dtypes.
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Optional
+
+import jax
+import numpy as np
+
+from multiverso_tpu.utils.io import Stream, StreamFactory
+from multiverso_tpu.utils.log import CHECK, Log
+
+_MAGIC = "MVTCKPT1"
+
+
+def _aux_leaves(table):
+    state = getattr(table, "state", None)
+    if not isinstance(state, dict) or "aux" not in state:
+        return []
+    leaves = jax.tree.leaves_with_path(state["aux"])
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+def _to_logical(table, leaf) -> np.ndarray:
+    """Aux leaf in mesh-independent (logical) layout when the table knows
+    how; raw host layout otherwise."""
+    if hasattr(table, "aux_to_logical"):
+        return table.aux_to_logical(leaf)
+    return np.asarray(leaf)
+
+
+def _from_logical(table, arr: np.ndarray) -> np.ndarray:
+    if hasattr(table, "aux_from_logical"):
+        return table.aux_from_logical(arr)
+    return arr
+
+
+def _write_table(stream: Stream, table_id: int, table) -> None:
+    stream.WriteInt(table_id)
+    stream.WriteStr(type(table).__name__)
+    buf = _io.BytesIO()
+    table.Store(Stream(buf, f"<table {table_id}>"))
+    payload = buf.getvalue()
+    stream.WriteInt(len(payload))
+    stream.Write(payload)
+    leaves = _aux_leaves(table)
+    stream.WriteInt(len(leaves))
+    for keypath, leaf in leaves:
+        host = _to_logical(table, leaf)
+        stream.WriteStr(keypath)
+        stream.WriteStr(str(host.dtype))
+        stream.WriteInt(host.ndim)
+        for d in host.shape:
+            stream.WriteInt(d)
+        stream.Write(np.ascontiguousarray(host).tobytes())
+
+
+def _read_table(stream: Stream, table) -> None:
+    type_name = stream.ReadStr()
+    CHECK(type_name == type(table).__name__,
+          f"checkpoint table type mismatch: {type_name} vs "
+          f"{type(table).__name__}")
+    payload_len = stream.ReadInt()
+    payload = stream.Read(payload_len)
+    payload_stream = Stream(_io.BytesIO(payload), "<table payload>")
+    table.Load(payload_stream)
+    CHECK(payload_stream._f.tell() == payload_len,
+          f"table {type_name} consumed {payload_stream._f.tell()} of "
+          f"{payload_len} checkpoint bytes — dtype/config drift")
+    n_leaves = stream.ReadInt()
+    if n_leaves == 0:
+        return
+    live = dict(_aux_leaves(table))
+    restored = {}
+    for _ in range(n_leaves):
+        keypath = stream.ReadStr()
+        dtype = np.dtype(stream.ReadStr())
+        ndim = stream.ReadInt()
+        shape = tuple(stream.ReadInt() for _ in range(ndim))
+        raw = stream.Read(int(np.prod(shape)) * dtype.itemsize if shape
+                          else dtype.itemsize)
+        arr = np.frombuffer(raw, dtype).reshape(shape)
+        CHECK(keypath in live, f"unknown aux leaf {keypath} in checkpoint")
+        live_logical = _to_logical(table, live[keypath])
+        CHECK(live_logical.shape == arr.shape,
+              f"aux leaf {keypath} shape mismatch: checkpoint {arr.shape} "
+              f"vs live {live_logical.shape}")
+        CHECK(live_logical.dtype == arr.dtype,
+              f"aux leaf {keypath} dtype mismatch: checkpoint {arr.dtype} "
+              f"vs live {live_logical.dtype}")
+        restored[keypath] = _from_logical(table, arr)
+    # re-place every restored leaf with the table's live sharding
+    def replace(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if key in restored:
+            return jax.device_put(restored[key], leaf.sharding)
+        return leaf
+    table.state = dict(table.state)
+    table.state["aux"] = jax.tree_util.tree_map_with_path(
+        replace, table.state["aux"])
+
+
+def save_checkpoint(uri: str, zoo=None) -> int:
+    """Store every registered server table (+ updater aux) to ``uri``.
+    Returns the number of tables written."""
+    from multiverso_tpu.zoo import Zoo
+    zoo = zoo or Zoo.Get()
+    tables = zoo.server_tables
+    with StreamFactory.GetStream(uri, "w") as stream:
+        stream.WriteStr(_MAGIC)
+        stream.WriteInt(len(tables))
+        for table_id, table in enumerate(tables):
+            _write_table(stream, table_id, table)
+    Log.Info("checkpoint: saved %d tables to %s", len(tables), uri)
+    return len(tables)
+
+
+def load_checkpoint(uri: str, zoo=None) -> int:
+    """Restore every registered server table from ``uri``. The same tables
+    (count, order, shapes) must already be registered — mesh size may
+    differ (re-placement uses the live shardings)."""
+    from multiverso_tpu.zoo import Zoo
+    zoo = zoo or Zoo.Get()
+    tables = zoo.server_tables
+    with StreamFactory.GetStream(uri, "r") as stream:
+        CHECK(stream.ReadStr() == _MAGIC, "not a multiverso_tpu checkpoint")
+        n = stream.ReadInt()
+        CHECK(n == len(tables),
+              f"checkpoint has {n} tables, registry has {len(tables)}")
+        for _ in range(n):
+            table_id = stream.ReadInt()
+            CHECK(0 <= table_id < len(tables), "bad table id in checkpoint")
+            _read_table(stream, tables[table_id])
+    Log.Info("checkpoint: restored %d tables from %s", n, uri)
+    return n

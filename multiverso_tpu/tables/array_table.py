@@ -125,6 +125,19 @@ class ArrayServer(ServerTable):
         self.state = dict(self.state)
         self.state["data"] = ctx.place(jnp.asarray(values), self._sharding)
 
+    # -- aux (updater state) <-> logical layout, for the checkpoint driver --
+
+    def aux_to_logical(self, leaf) -> np.ndarray:
+        """Strip padding: last axis padded -> logical size."""
+        return np.asarray(leaf)[..., : self.size]
+
+    def aux_from_logical(self, arr: np.ndarray) -> np.ndarray:
+        pad = self.padded - self.size
+        if pad:
+            widths = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+            arr = np.pad(arr, widths)
+        return arr
+
 
 class ArrayWorker(WorkerTable):
     """Worker half (reference array_table.h:13-39)."""

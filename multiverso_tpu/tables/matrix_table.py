@@ -283,6 +283,21 @@ class MatrixServerTable(ServerTable):
         """Logical-view snapshot (host numpy)."""
         return self._from_storage(np.asarray(self.state["data"]))
 
+    # -- aux (updater state) <-> logical layout, for the checkpoint driver --
+
+    def aux_to_logical(self, leaf) -> np.ndarray:
+        """(padded_rows, cols) or (workers, padded_rows, cols) storage ->
+        logical row layout (interleaving + trash rows stripped)."""
+        host = np.asarray(leaf)
+        if host.ndim == 2:
+            return self._from_storage(host)
+        return np.stack([self._from_storage(h) for h in host])
+
+    def aux_from_logical(self, arr: np.ndarray) -> np.ndarray:
+        if arr.ndim == 2:
+            return self._to_storage(arr)
+        return np.stack([self._to_storage(a) for a in arr])
+
     # -- checkpoint (reference matrix_table.cpp:457-465) --------------------
 
     def Store(self, stream) -> None:

@@ -1,0 +1,121 @@
+"""Framework-level checkpoint/resume (multiverso_tpu/checkpoint.py).
+
+The reference only has per-table, app-initiated, data-only Store/Load
+(table_interface.h:61-70); these tests cover the driver that the TPU build
+adds per SURVEY.md §5: all tables in one call, updater aux state included,
+resume exactness across a simulated restart.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def ckpt_path(tmp_path):
+    return str(tmp_path / "state.mvt")
+
+
+class TestCheckpointDriver:
+    def test_save_load_roundtrip_all_tables(self, mv_env, ckpt_path):
+        from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                           MatrixTableOption)
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=40))
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=16, num_cols=8))
+        kv = mv_env.MV_CreateTable(KVTableOption())
+        arr.Add(np.arange(40, dtype=np.float32))
+        mat.AddRows(np.array([1, 5], np.int32), np.ones((2, 8), np.float32))
+        kv.Add(np.array([7, 9], np.int64), np.array([1.5, 2.5], np.float32))
+
+        assert mv_env.MV_SaveCheckpoint(ckpt_path) == 3
+
+        # mutate everything, then restore
+        arr.Add(np.full(40, 100.0, np.float32))
+        mat.AddRows(np.array([1], np.int32), np.full((1, 8), 7.0, np.float32))
+        kv.Add(np.array([7], np.int64), np.array([50.0], np.float32))
+
+        assert mv_env.MV_LoadCheckpoint(ckpt_path) == 3
+        np.testing.assert_allclose(arr.Get(), np.arange(40, dtype=np.float32))
+        got = mat.GetRows(np.array([1, 5], np.int32))
+        np.testing.assert_allclose(got, 1.0)
+        np.testing.assert_allclose(kv.Get(np.array([7, 9], np.int64)),
+                                   [1.5, 2.5])
+
+    def test_adagrad_aux_survives_resume(self, mv_env, ckpt_path):
+        """Resume is exact: the per-worker AdaGrad history is restored, so a
+        post-resume Add produces the same result as an uninterrupted run
+        (the reference loses this state — SURVEY.md §5)."""
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.updaters import AddOption
+
+        def run(interrupt):
+            t = mv_env.MV_CreateTable(MatrixTableOption(
+                num_rows=8, num_cols=4, updater_type="adagrad"))
+            opt = AddOption(worker_id=0, learning_rate=0.1, rho=0.5)
+            ids = np.array([2, 3], np.int32)
+            t.AddRows(ids, np.ones((2, 4), np.float32), option=opt)
+            if interrupt:
+                mv_env.MV_SaveCheckpoint(ckpt_path)
+                # clobber both data and aux, then restore
+                t.AddRows(ids, np.full((2, 4), 9.0, np.float32), option=opt)
+                mv_env.MV_LoadCheckpoint(ckpt_path)
+            t.AddRows(ids, np.ones((2, 4), np.float32), option=opt)
+            return t.GetRows(ids)
+
+        uninterrupted = run(interrupt=False)
+        # fresh world for the resumed run
+        mv_env.MV_ShutDown()
+        mv_env.MV_Init([])
+        resumed = run(interrupt=True)
+        np.testing.assert_allclose(resumed, uninterrupted, rtol=1e-6)
+
+    def test_type_mismatch_rejected(self, mv_env, ckpt_path, tmp_path):
+        from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+        from multiverso_tpu.utils.log import FatalError
+        mv_env.MV_CreateTable(ArrayTableOption(size=8))
+        mv_env.MV_SaveCheckpoint(ckpt_path)
+        mv_env.MV_ShutDown()
+        mv_env.MV_Init([])
+        mv_env.MV_CreateTable(MatrixTableOption(num_rows=2, num_cols=4))
+        with pytest.raises(FatalError):
+            mv_env.MV_LoadCheckpoint(ckpt_path)
+
+    def test_table_count_mismatch_rejected(self, mv_env, ckpt_path):
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.utils.log import FatalError
+        mv_env.MV_CreateTable(ArrayTableOption(size=8))
+        mv_env.MV_SaveCheckpoint(ckpt_path)
+        mv_env.MV_CreateTable(ArrayTableOption(size=8))
+        with pytest.raises(FatalError):
+            mv_env.MV_LoadCheckpoint(ckpt_path)
+
+    def test_resume_on_different_mesh_size(self, ckpt_path):
+        """Layout independence: save on a 4-device mesh, resume on 8 —
+        data AND AdaGrad aux must survive exactly (checkpoint.py serializes
+        logical layout; the reference's per-server shard files cannot do
+        this)."""
+        import jax
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.updaters import AddOption
+
+        opt = AddOption(worker_id=0, learning_rate=0.1, rho=0.5)
+        ids = np.array([0, 5, 11], np.int32)
+
+        mv.MV_Init([], devices=jax.devices()[:4])
+        t = mv.MV_CreateTable(MatrixTableOption(num_rows=12, num_cols=4,
+                                                updater_type="adagrad"))
+        t.AddRows(ids, np.ones((3, 4), np.float32), option=opt)
+        mv.MV_SaveCheckpoint(ckpt_path)
+        expected_next = None
+        t.AddRows(ids, np.ones((3, 4), np.float32), option=opt)
+        expected_next = t.GetRows(ids).copy()
+        mv.MV_ShutDown()
+
+        mv.MV_Init([], devices=jax.devices()[:8])
+        t = mv.MV_CreateTable(MatrixTableOption(num_rows=12, num_cols=4,
+                                                updater_type="adagrad"))
+        mv.MV_LoadCheckpoint(ckpt_path)
+        t.AddRows(ids, np.ones((3, 4), np.float32), option=opt)
+        resumed_next = t.GetRows(ids)
+        np.testing.assert_allclose(resumed_next, expected_next, rtol=1e-6)
+        mv.MV_ShutDown()
