@@ -49,17 +49,25 @@ class RendezvousAllreduce:
     Each participant thread calls ``allreduce(arr)``; all block until every
     contribution arrived, then all receive the sum. Reusable across rounds
     (generation counter), mirroring repeated ``MV_Aggregate`` calls.
+
+    ``cross_reduce`` (optional) extends the sum beyond this process: the
+    last-arriving thread applies it to the thread-summed buffer exactly once
+    per round — the multihost leg of MV_Aggregate (every process's last
+    thread issues the same collective; reference MPI_Allreduce,
+    mpi_net.h:148-152).
     """
 
-    def __init__(self, num_participants: int):
+    def __init__(self, num_participants: int, cross_reduce=None):
         if num_participants <= 0:
             raise ValueError("num_participants must be positive")
         self.n = num_participants
+        self._cross = cross_reduce
         self._lock = threading.Condition()
         self._accum: Optional[np.ndarray] = None
         self._arrived = 0
         self._generation = 0
         self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
 
     def allreduce(self, arr: np.ndarray) -> np.ndarray:
         arr = np.asarray(arr)
@@ -71,13 +79,27 @@ class RendezvousAllreduce:
                 self._accum += arr
             self._arrived += 1
             if self._arrived == self.n:
-                self._result = self._accum
+                # the round ENDS no matter what cross_reduce does — a raise
+                # here must not strand the n-1 waiters or wedge future
+                # rounds, so state reset + notify happen unconditionally
+                result = self._accum
+                error = None
+                if self._cross is not None:
+                    try:
+                        result = np.asarray(self._cross(result))
+                    except BaseException as exc:
+                        error = exc
+                self._result = result
+                self._error = error
                 self._accum = None
                 self._arrived = 0
                 self._generation += 1
                 self._lock.notify_all()
             else:
                 self._lock.wait_for(lambda: self._generation > gen)
+            if self._error is not None:
+                raise RuntimeError(
+                    "cross-host allreduce failed") from self._error
             return self._result.astype(arr.dtype)
 
 

@@ -1,0 +1,159 @@
+"""Multi-host (multi-process) runtime wiring over ``jax.distributed``.
+
+The reference scales across machines with MPI/ZMQ point-to-point messaging
+(SURVEY.md §2c): every process runs worker+server actors and Get/Add
+requests cross the network per table shard. The TPU-native equivalent is a
+**multi-controller SPMD job**: one process per host, all processes
+participating in a single global device mesh, parameter shards laid across
+every host's HBM, and the "network" being XLA collectives over ICI (intra
+slice) / DCN (across slices) — the scaling-book model.
+
+The SPMD constraint this imposes (and the honest behavioral mapping):
+
+* computations on globally-sharded arrays are **collective** — every
+  process must issue the same program in the same order. Table verbs in
+  multihost mode therefore follow the *collective contract*: every process
+  calls the same Get/Add sequence (normal SPMD training loops — and the
+  device plane — do this naturally).
+* the reference's *asynchrony* (workers never wait for each other) lives
+  **within** each host among its worker threads, exactly as in the 1-host
+  world; cross-host progress is synchronous at collective boundaries. This
+  is the documented reinterpretation SURVEY.md §7 anticipates ("bounded
+  async via microbatched rounds") — on TPU fabric, lockstep collectives are
+  the fast path, not a compromise.
+
+What this module provides:
+
+* ``maybe_initialize`` — bring up ``jax.distributed`` from flags
+  (``-dist_coordinator/-dist_rank/-dist_size``) or automatic TPU-pod
+  detection (``-multihost=auto`` uses it only when the env indicates a
+  multi-process job; ``on`` forces; ``off`` never).
+* ``process_index/process_count`` — identity (Zoo rank/size).
+* ``host_barrier`` — cross-host barrier (device-level sync over the global
+  mesh), the Controller-barrier equivalent (reference controller.cpp:12-36).
+* ``host_allreduce_sum`` — cross-host elementwise sum of a host numpy
+  array, used by ``MV_Aggregate`` to extend the in-process rendezvous
+  allreduce across hosts (reference MV_Aggregate → MPI_Allreduce,
+  src/multiverso.cpp:53-56).
+* ``broadcast_from_master`` — host-0 value to all hosts (the binding's
+  master-initializes convention, reference tables.py:49-58).
+
+All of them degrade to no-ops / identity in a single-process job, so the
+1-host world (tests, the reference's unittest fixture pattern) runs the
+same code paths.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_int,
+                                            MV_DEFINE_string)
+from multiverso_tpu.utils.log import CHECK, Log
+
+MV_DEFINE_string("multihost", "auto", "multi-process init: auto / on / off")
+MV_DEFINE_string("dist_coordinator", "",
+                 "coordinator address host:port (jax.distributed)")
+MV_DEFINE_int("dist_rank", -1, "this process index (jax.distributed)")
+MV_DEFINE_int("dist_size", -1, "total process count (jax.distributed)")
+
+_initialized = False
+
+
+def _env_says_multiprocess() -> bool:
+    """TPU-pod/cluster env autodetection (mirrors what
+    jax.distributed.initialize() itself can infer)."""
+    if (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")
+            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")):
+        return True
+    # Cloud TPU multi-host slices advertise their worker set directly
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def maybe_initialize() -> bool:
+    """Initialize jax.distributed per flags/env. Returns True when a
+    multi-process runtime is (already or newly) up. Idempotent.
+
+    Must run before anything initializes the XLA backend —
+    ``jax.distributed.initialize()`` refuses once backends exist, so this
+    function deliberately avoids jax calls (process_count etc.) on the
+    decide-to-init path."""
+    global _initialized
+    mode = str(GetFlag("multihost")).lower()
+    if mode == "off":
+        return False
+    coordinator = str(GetFlag("dist_coordinator"))
+    rank = int(GetFlag("dist_rank"))
+    size = int(GetFlag("dist_size"))
+    explicit = bool(coordinator) and rank >= 0 and size > 0
+    if not explicit and mode != "on" and not _env_says_multiprocess():
+        return False
+    if _initialized:
+        return True
+    import jax
+    try:
+        if explicit:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=size, process_id=rank)
+        else:
+            jax.distributed.initialize()
+        _initialized = True
+        Log.Info("multihost: jax.distributed up — process %d of %d",
+                 jax.process_index(), jax.process_count())
+        return True
+    except Exception as exc:  # pragma: no cover - env-specific
+        # "already initialized" / "must be called before any JAX
+        # computations": a runtime may already be up (user or launcher
+        # initialized first) — honor it when it is actually multi-process
+        text = str(exc).lower()
+        if "already" in text or "before" in text:
+            if jax.process_count() > 1:
+                _initialized = True
+                return True
+        CHECK(mode != "on" and not explicit,
+              f"multihost requested but jax.distributed failed: {exc}")
+        Log.Debug("multihost: auto-init skipped (%s)", exc)
+        return False
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def host_barrier(name: str = "mv_barrier") -> None:
+    """Block until every process reaches this point (no-op single-process).
+    Collective: every process must call it (reference controller barrier,
+    controller.cpp:12-36)."""
+    if process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
+
+
+def host_allreduce_sum(data: np.ndarray) -> np.ndarray:
+    """Elementwise sum of ``data`` across processes (identity
+    single-process). Collective."""
+    if process_count() <= 1:
+        return data
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(data)  # (procs, *shape)
+    return np.asarray(gathered).sum(axis=0).astype(data.dtype)
+
+
+def broadcast_from_master(data: np.ndarray) -> np.ndarray:
+    """Host 0's value to everyone (identity single-process). Collective."""
+    if process_count() <= 1:
+        return data
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(data))

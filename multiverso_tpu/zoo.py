@@ -36,6 +36,7 @@ from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # ParseCMDFlags runs, or a first-call "-sync=true" would be silently dropped.
 import multiverso_tpu.sync.server  # noqa: F401
 import multiverso_tpu.updaters.base  # noqa: F401
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
 from multiverso_tpu.parallel.mesh import MeshContext
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
@@ -66,6 +67,7 @@ class Zoo:
         self._barrier: Optional[threading.Barrier] = None
         self._allreduce: Optional[RendezvousAllreduce] = None
         self._ma_mode = False
+        self._multihost = False
 
     # -- singleton ----------------------------------------------------------
 
@@ -85,12 +87,21 @@ class Zoo:
         self._ma_mode = bool(GetFlag("ma"))
         role = ROLE_NAMES.get(str(GetFlag("ps_role")).lower(), Role.ALL)
         self.num_workers = max(1, int(GetFlag("num_workers")))
+        # multi-process bring-up BEFORE mesh construction: a multi-controller
+        # job's mesh must span the global device set (SURVEY.md §2c — the
+        # MPI/ZMQ transport's TPU equivalent is the cross-host mesh itself)
+        self._multihost = multihost.maybe_initialize()
         self.mesh_ctx = MeshContext.create(devices)
-        self.node = Node(rank=0, role=role,
+        rank = multihost.process_index() if self._multihost else 0
+        self.node = Node(rank=rank, role=role,
                          worker_id=0 if role & Role.WORKER else -1,
                          server_id=0 if role & Role.SERVER else -1)
         self._barrier = threading.Barrier(self.num_workers)
-        self._allreduce = RendezvousAllreduce(self.num_workers)
+        # cross-host leg of MV_Aggregate: the rendezvous winner reduces the
+        # thread-summed buffer across processes (reference MPI_Allreduce)
+        cross = (multihost.host_allreduce_sum if self._multihost else None)
+        self._allreduce = RendezvousAllreduce(self.num_workers,
+                                              cross_reduce=cross)
         if not self._ma_mode:
             from multiverso_tpu.sync.server import Server
             self.server_engine = Server.GetServer(self.num_workers)
@@ -137,7 +148,7 @@ class Zoo:
 
     @property
     def size(self) -> int:
-        return 1  # single host process; multihost via jax.distributed TBD
+        return multihost.process_count() if self._multihost else 1
 
     @property
     def num_servers(self) -> int:
@@ -195,9 +206,23 @@ class Zoo:
     # -- collectives --------------------------------------------------------
 
     def Barrier(self) -> None:
-        """Worker barrier (reference zoo.cpp:164-177 controller roundtrip)."""
+        """Worker barrier (reference zoo.cpp:164-177 controller roundtrip):
+        all in-process worker threads, then — multihost — all processes
+        (one host_barrier per rendezvous, issued by every process
+        collectively)."""
         CHECK(self._barrier is not None, "Zoo not started")
-        self._barrier.wait()
+        idx = self._barrier.wait()
+        if self._multihost:
+            if idx == 0:
+                try:
+                    multihost.host_barrier()
+                except BaseException:
+                    # release the peers loudly (BrokenBarrierError) instead
+                    # of stranding them; a failed cross-host barrier means a
+                    # peer process is gone — the job cannot proceed
+                    self._barrier.abort()
+                    raise
+            self._barrier.wait()  # hold threads until the cross-host leg ends
 
     def Aggregate(self, data: np.ndarray) -> np.ndarray:
         """In-place elementwise-sum allreduce across workers

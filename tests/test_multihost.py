@@ -1,0 +1,107 @@
+"""Multi-host layer (parallel/multihost.py) — single-process behavior.
+
+True multi-process runs need a pod (or multiple local processes with a
+coordinator); these tests pin down the 1-process degradations (identity /
+no-op), the flag gating, and the cross_reduce hook the Zoo wires into
+MV_Aggregate's rendezvous.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+
+class TestSingleProcessDegradation:
+    def test_identity_ops(self):
+        from multiverso_tpu.parallel import multihost as mh
+        assert mh.process_count() == 1
+        assert mh.process_index() == 0
+        mh.host_barrier()  # no-op, must not raise
+        x = np.arange(6, dtype=np.float32)
+        assert mh.host_allreduce_sum(x) is x
+        assert mh.broadcast_from_master(x) is x
+
+    def test_auto_mode_stays_off_without_env(self, monkeypatch):
+        from multiverso_tpu.parallel import multihost as mh
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                    "MEGASCALE_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
+        SetCMDFlag("multihost", "auto")
+        assert mh.maybe_initialize() is False
+
+    def test_off_mode_never_initializes(self, monkeypatch):
+        from multiverso_tpu.parallel import multihost as mh
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:1234")
+        SetCMDFlag("multihost", "off")
+        try:
+            assert mh.maybe_initialize() is False
+        finally:
+            SetCMDFlag("multihost", "auto")
+
+    def test_zoo_single_process_identity(self, mv_env):
+        from multiverso_tpu.zoo import Zoo
+        assert Zoo.Get().size == 1
+        assert Zoo.Get().rank == 0
+
+
+class TestCrossReduceHook:
+    def test_applied_once_per_round_by_last_thread(self):
+        from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
+        calls = []
+
+        def cross(buf):
+            calls.append(buf.copy())
+            return buf * 10  # simulates the cross-host sum
+
+        ar = RendezvousAllreduce(3, cross_reduce=cross)
+        outs = {}
+
+        def run(i):
+            outs[i] = ar.allreduce(np.full(4, float(i + 1), np.float32))
+
+        for round_idx in range(2):
+            ts = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            # thread sum = 1+2+3 = 6, cross multiplies by 10
+            for i in range(3):
+                np.testing.assert_allclose(outs[i], 60.0)
+        assert len(calls) == 2  # exactly once per round
+        np.testing.assert_allclose(calls[0], 6.0)
+
+    def test_cross_reduce_failure_releases_waiters_and_recovers(self):
+        """A raising cross_reduce must not strand waiters or wedge later
+        rounds: every participant of the failed round raises, the next
+        round works."""
+        from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
+        boom = {"on": True}
+
+        def cross(buf):
+            if boom["on"]:
+                raise ConnectionError("peer died")
+            return buf
+
+        ar = RendezvousAllreduce(2, cross_reduce=cross)
+        errors = []
+        outs = {}
+
+        def run(i):
+            try:
+                outs[i] = ar.allreduce(np.full(2, float(i + 1), np.float32))
+            except RuntimeError as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        assert not any(t.is_alive() for t in ts), "waiters stranded"
+        assert len(errors) == 2
+        boom["on"] = False
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        np.testing.assert_allclose(outs[0], 3.0)
+        np.testing.assert_allclose(outs[1], 3.0)
