@@ -1,0 +1,145 @@
+// C# binding over the native C API (native/include/mvt/c_api.h,
+// libmultiverso_tpu.so).
+//
+// Behavioural counterpart of the reference's C++/CLI wrapper
+// (binding/C#/MultiversoCLR/MultiversoCLR.h:11-47): a static
+// MultiversoWrapper with Init/Shutdown/Barrier/Rank/Size, table creation
+// by table id, and Get/Add over whole tables or single rows. Where the
+// reference linked the C++ library directly and exposed generic element
+// types, this wrapper rides the float-only C ABI via P/Invoke — the same
+// surface every other foreign binding (python ctypes, Lua FFI) uses — so
+// it builds with any modern .NET, no C++/CLI toolchain needed.
+//
+// NetBind/NetConnect are parity stubs: TPU meshes are wired by hardware,
+// not sockets (see multiverso_tpu/api.py MV_NetBind docstring).
+
+using System;
+using System.Collections.Generic;
+using System.Runtime.InteropServices;
+
+namespace MultiversoTPU
+{
+    internal static class Native
+    {
+        private const string Lib = "multiverso_tpu";  // libmultiverso_tpu.so
+
+        [DllImport(Lib)] internal static extern void MV_Init(ref int argc, string[] argv);
+        [DllImport(Lib)] internal static extern void MV_ShutDown();
+        [DllImport(Lib)] internal static extern void MV_Barrier();
+        [DllImport(Lib)] internal static extern int MV_NumWorkers();
+        [DllImport(Lib)] internal static extern int MV_WorkerId();
+        [DllImport(Lib)] internal static extern int MV_ServerId();
+        [DllImport(Lib)] internal static extern void MV_SetThreadWorkerId(int workerId);
+
+        [DllImport(Lib)] internal static extern void MV_NewArrayTable(int size, out IntPtr handler);
+        [DllImport(Lib)] internal static extern void MV_GetArrayTable(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddArrayTable(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncArrayTable(IntPtr handler, float[] data, int size);
+
+        [DllImport(Lib)] internal static extern void MV_NewMatrixTable(int numRow, int numCol, out IntPtr handler);
+        [DllImport(Lib)] internal static extern void MV_GetMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncMatrixTableAll(IntPtr handler, float[] data, int size);
+        [DllImport(Lib)] internal static extern void MV_GetMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+        [DllImport(Lib)] internal static extern void MV_AddMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+        [DllImport(Lib)] internal static extern void MV_AddAsyncMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+    }
+
+    /// <summary>Static facade mirroring MultiversoCLR.MultiversoWrapper.</summary>
+    public static class MultiversoWrapper
+    {
+        private sealed class Table
+        {
+            public IntPtr Handle;
+            public int Rows;
+            public int Cols;
+        }
+
+        private static readonly Dictionary<int, Table> Tables = new Dictionary<int, Table>();
+
+        public static bool NetBind(int rank, string endpoint)
+            => throw new NotSupportedException(
+                "TPU meshes are wired by hardware; socket endpoints do not apply.");
+
+        public static bool NetConnect(int[] ranks, string[] endpoints)
+            => throw new NotSupportedException(
+                "TPU meshes are wired by hardware; socket endpoints do not apply.");
+
+        public static void NetFinalize() { /* nothing to tear down */ }
+
+        public static void Init(int numTables, bool sync)
+        {
+            var args = sync ? new[] { "multiverso-cs", "-sync=true" }
+                            : new[] { "multiverso-cs" };
+            int argc = args.Length;
+            Native.MV_Init(ref argc, args);
+        }
+
+        public static void Shutdown()
+        {
+            Tables.Clear();
+            Native.MV_ShutDown();
+        }
+
+        public static int Rank() => Native.MV_WorkerId();
+        public static int Size() => Native.MV_NumWorkers();
+        public static void Barrier() => Native.MV_Barrier();
+
+        /// <summary>Create several tables at once (reference CreateTables).
+        /// eleTypes must be "float" — the C ABI is float-only.</summary>
+        public static void CreateTables(int[] rows, int[] cols, string[] eleTypes)
+        {
+            for (int i = 0; i < rows.Length; ++i)
+                CreateTable(i, rows[i], cols[i], eleTypes[i]);
+        }
+
+        public static void CreateTable(int tableId, int rows, int cols, string eleType)
+        {
+            if (!string.Equals(eleType, "float", StringComparison.OrdinalIgnoreCase))
+                throw new NotSupportedException(
+                    $"element type '{eleType}': the C ABI is float-only");
+            IntPtr h;
+            if (rows <= 1)
+                Native.MV_NewArrayTable(cols, out h);
+            else
+                Native.MV_NewMatrixTable(rows, cols, out h);
+            Tables[tableId] = new Table { Handle = h, Rows = rows, Cols = cols };
+        }
+
+        /// <summary>Whole-table get into a caller-sized buffer.</summary>
+        public static void Get(int tableId, float[] value)
+        {
+            var t = Tables[tableId];
+            if (t.Rows <= 1)
+                Native.MV_GetArrayTable(t.Handle, value, value.Length);
+            else
+                Native.MV_GetMatrixTableAll(t.Handle, value, value.Length);
+        }
+
+        /// <summary>Single-row get.</summary>
+        public static void Get(int tableId, int rowId, float[] value)
+        {
+            var t = Tables[tableId];
+            Native.MV_GetMatrixTableByRows(t.Handle, value, value.Length,
+                                           new[] { rowId }, 1);
+        }
+
+        /// <summary>Whole-table add (synchronous, like the reference's).</summary>
+        public static void Add(int tableId, float[] update)
+        {
+            var t = Tables[tableId];
+            if (t.Rows <= 1)
+                Native.MV_AddArrayTable(t.Handle, update, update.Length);
+            else
+                Native.MV_AddMatrixTableAll(t.Handle, update, update.Length);
+        }
+
+        /// <summary>Single-row add.</summary>
+        public static void Add(int tableId, int rowId, float[] update)
+        {
+            var t = Tables[tableId];
+            Native.MV_AddMatrixTableByRows(t.Handle, update, update.Length,
+                                           new[] { rowId }, 1);
+        }
+    }
+}

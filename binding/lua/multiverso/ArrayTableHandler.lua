@@ -1,0 +1,54 @@
+--- 1-D float32 table handler (counterpart of reference
+-- binding/lua/ArrayTableHandler.lua).
+--
+-- Keeps the reference's master-initializes convention: when `init_value`
+-- is given, EVERY worker issues a synchronous add at construction — worker
+-- 0 contributes the value, the rest contribute zeros — so BSP vector
+-- clocks stay aligned across workers (reference ArrayTableHandler.lua
+-- comment; same trick as the python binding, tables.py:49-58).
+
+local ffi = require('ffi')
+local util = require('multiverso.util')
+
+local ArrayTableHandler = {}
+ArrayTableHandler.__index = ArrayTableHandler
+
+function ArrayTableHandler:new(size, init_value)
+    local mv = require('multiverso.init')
+    local self_ = setmetatable({}, ArrayTableHandler)
+    self_._size = assert(tonumber(size), 'size required')
+    local out = ffi.new('TableHandler[1]')
+    mv.C.MV_NewArrayTable(self_._size, out)
+    self_._h = out[0]
+    if init_value ~= nil then
+        if mv.worker_id() == 0 then
+            self_:add(init_value, true)
+        else
+            self_:add(util.zeros_like(init_value), true)
+        end
+    end
+    return self_
+end
+
+function ArrayTableHandler:get()
+    local mv = require('multiverso.init')
+    local buf = ffi.new('float[?]', self._size)
+    mv.C.MV_GetArrayTable(self._h, buf, self._size)
+    return util.from_float_ptr(buf, self._size)
+end
+
+--- add(data[, sync]) — async by default, like the reference.
+function ArrayTableHandler:add(data, sync)
+    local mv = require('multiverso.init')
+    local ptr, anchor, n = util.to_float_ptr(data)
+    assert(n == self._size,
+           ('add: got %d elements, table holds %d'):format(n, self._size))
+    if sync then
+        mv.C.MV_AddArrayTable(self._h, ptr, self._size)
+    else
+        mv.C.MV_AddAsyncArrayTable(self._h, ptr, self._size)
+    end
+    if anchor then end  -- keep alive through the call
+end
+
+return ArrayTableHandler

@@ -1,0 +1,81 @@
+--- Row-sharded 2-D float32 table handler (counterpart of reference
+-- binding/lua/MatrixTableHandler.lua): whole-table get/add plus row-set
+-- get/add, async adds by default, master-initializes convention as in
+-- ArrayTableHandler.
+
+local ffi = require('ffi')
+local util = require('multiverso.util')
+
+local MatrixTableHandler = {}
+MatrixTableHandler.__index = MatrixTableHandler
+
+function MatrixTableHandler:new(num_row, num_col, init_value)
+    local mv = require('multiverso.init')
+    local self_ = setmetatable({}, MatrixTableHandler)
+    self_._rows = assert(tonumber(num_row), 'num_row required')
+    self_._cols = assert(tonumber(num_col), 'num_col required')
+    self_._size = self_._rows * self_._cols
+    local out = ffi.new('TableHandler[1]')
+    mv.C.MV_NewMatrixTable(self_._rows, self_._cols, out)
+    self_._h = out[0]
+    if init_value ~= nil then
+        if mv.worker_id() == 0 then
+            self_:add(init_value, nil, true)
+        else
+            self_:add(util.zeros_like(init_value), nil, true)
+        end
+    end
+    return self_
+end
+
+--- get([row_ids]) — whole table when row_ids is nil, else just those rows.
+-- Returns a (#rows x cols) FloatTensor (or nested-free flat table without
+-- torch).
+function MatrixTableHandler:get(row_ids)
+    local mv = require('multiverso.init')
+    if row_ids == nil then
+        local buf = ffi.new('float[?]', self._size)
+        mv.C.MV_GetMatrixTableAll(self._h, buf, self._size)
+        local flat = util.from_float_ptr(buf, self._size)
+        if flat.resize then return flat:resize(self._rows, self._cols) end
+        return flat
+    end
+    local ids, ianchor, n = util.to_int_ptr(row_ids)
+    local buf = ffi.new('float[?]', n * self._cols)
+    mv.C.MV_GetMatrixTableByRows(self._h, buf, n * self._cols, ids, n)
+    if ianchor then end
+    local flat = util.from_float_ptr(buf, n * self._cols)
+    if flat.resize then return flat:resize(n, self._cols) end
+    return flat
+end
+
+--- add(data[, row_ids[, sync]]) — async by default.
+function MatrixTableHandler:add(data, row_ids, sync)
+    local mv = require('multiverso.init')
+    local ptr, anchor, nf = util.to_float_ptr(data)
+    if row_ids == nil then
+        assert(nf == self._size,
+               ('add: got %d elements, table holds %d'):format(nf,
+                                                               self._size))
+        if sync then
+            mv.C.MV_AddMatrixTableAll(self._h, ptr, self._size)
+        else
+            mv.C.MV_AddAsyncMatrixTableAll(self._h, ptr, self._size)
+        end
+    else
+        local ids, ianchor, n = util.to_int_ptr(row_ids)
+        assert(nf == n * self._cols,
+               ('add: got %d elements for %d rows x %d cols'):format(
+                   nf, n, self._cols))
+        if sync then
+            mv.C.MV_AddMatrixTableByRows(self._h, ptr, n * self._cols, ids, n)
+        else
+            mv.C.MV_AddAsyncMatrixTableByRows(self._h, ptr, n * self._cols,
+                                              ids, n)
+        end
+        if ianchor then end
+    end
+    if anchor then end
+end
+
+return MatrixTableHandler

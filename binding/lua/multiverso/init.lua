@@ -1,0 +1,105 @@
+--- multiverso_tpu Lua/Torch binding.
+--
+-- LuaJIT FFI surface over the native C API (native/include/mvt/c_api.h),
+-- behaviourally equivalent to the reference binding/lua/init.lua: the same
+-- module functions (init/shutdown/barrier/num_workers/worker_id/server_id)
+-- and the same handler classes (ArrayTableHandler, MatrixTableHandler) so
+-- reference Lua training scripts run unchanged against the TPU runtime.
+--
+-- The whole C API is declared once here; handler modules reuse it.
+--
+-- NOTE: LuaJIT is not part of this build image, so this file ships as a
+-- source-level binding validated against the C ABI only (see
+-- binding/lua/README.md for how it was checked).
+
+-- Both `require 'multiverso'` and `require 'multiverso.init'` resolve to
+-- this file but under different module keys; guard so the cdef block (which
+-- LuaJIT refuses to re-run) executes exactly once per process.
+local _prior = package.loaded['multiverso'] or package.loaded['multiverso.init']
+if _prior then return _prior end
+
+local ffi = require('ffi')
+
+ffi.cdef([[
+typedef void* TableHandler;
+
+void MV_Init(int* argc, char* argv[]);
+void MV_ShutDown();
+void MV_Barrier();
+int  MV_NumWorkers();
+int  MV_WorkerId();
+int  MV_ServerId();
+void MV_SetThreadWorkerId(int worker_id);
+
+void MV_NewArrayTable(int size, TableHandler* out);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n);
+]])
+
+-- Library discovery order: MVT_LIB env var, then the in-repo build output,
+-- then the usual system search path.
+local candidates = {
+    os.getenv('MVT_LIB'),
+    (os.getenv('MVT_ROOT') or '.') .. '/native/libmultiverso_tpu.so',
+    'libmultiverso_tpu.so',
+}
+local lib, err
+for _, path in ipairs(candidates) do
+    if path then
+        local ok, loaded = pcall(ffi.load, path, true)
+        if ok then lib = loaded break end
+        err = loaded
+    end
+end
+if lib == nil then
+    error('multiverso: cannot load libmultiverso_tpu.so (set MVT_LIB or '
+          .. 'MVT_ROOT, or `make -C native`): ' .. tostring(err))
+end
+
+local mv = { C = lib }
+
+--- Bring up the runtime. `sync` selects the BSP server (-sync=true flag),
+-- matching reference init.lua's argv construction.
+function mv.init(sync)
+    local argv_strings = { 'multiverso-lua' }
+    if sync then argv_strings[#argv_strings + 1] = '-sync=true' end
+    local argc = ffi.new('int[1]', #argv_strings)
+    local argv = ffi.new('char*[?]', #argv_strings)
+    local keep = {}  -- anchor cdata so it outlives the call
+    for i, s in ipairs(argv_strings) do
+        local buf = ffi.new('char[?]', #s + 1)
+        ffi.copy(buf, s)
+        argv[i - 1] = buf
+        keep[i] = buf
+    end
+    lib.MV_Init(argc, argv)
+end
+
+function mv.shutdown()   lib.MV_ShutDown() end
+function mv.barrier()    lib.MV_Barrier() end
+function mv.num_workers() return tonumber(lib.MV_NumWorkers()) end
+function mv.worker_id()  return tonumber(lib.MV_WorkerId()) end
+function mv.server_id()  return tonumber(lib.MV_ServerId()) end
+
+-- Publish under both keys BEFORE loading the handler modules (they
+-- require 'multiverso.init' back) so the mutual requires are satisfied
+-- from the cache instead of re-executing this file.
+package.loaded['multiverso'] = mv
+package.loaded['multiverso.init'] = mv
+
+mv.ArrayTableHandler = require('multiverso.ArrayTableHandler')
+mv.MatrixTableHandler = require('multiverso.MatrixTableHandler')
+
+return mv

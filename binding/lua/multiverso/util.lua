@@ -1,0 +1,70 @@
+--- Conversion helpers between torch tensors / plain Lua tables and the
+-- float32 C buffers the C API speaks (counterpart of reference
+-- binding/lua/util.lua, float-only because the C API is float-only).
+--
+-- Accepted input types are torch tensors and Lua number tables; every
+-- converter also returns the element count so callers can validate buffer
+-- sizes before handing pointers to native code.
+
+local ffi = require('ffi')
+
+local util = {}
+
+local has_torch, torch = pcall(require, 'torch')
+
+--- torch tensor or Lua number table -> (float* cdata, anchor, count).
+-- `anchor` must stay alive for the duration of the C call.
+function util.to_float_ptr(data)
+    if has_torch and torch.isTensor(data) then
+        local t = data:float():contiguous()
+        return t:data(), t, t:nElement()
+    end
+    if type(data) == 'table' then
+        local buf = ffi.new('float[?]', #data)
+        for i = 1, #data do buf[i - 1] = data[i] end
+        return buf, buf, #data
+    end
+    error('multiverso: expected torch tensor or Lua table, got '
+          .. type(data))
+end
+
+--- torch tensor or Lua number table of row ids -> (int* cdata, anchor,
+-- count).
+function util.to_int_ptr(ids)
+    if has_torch and torch.isTensor(ids) then
+        local t = ids:int():contiguous()
+        return t:data(), t, t:nElement()
+    end
+    if type(ids) == 'table' then
+        local buf = ffi.new('int[?]', #ids)
+        for i = 1, #ids do buf[i - 1] = ids[i] end
+        return buf, buf, #ids
+    end
+    error('multiverso: expected torch tensor or Lua table of row ids, got '
+          .. type(ids))
+end
+
+--- float* cdata -> torch.FloatTensor when torch is present, else a Lua
+-- array table (so the binding is usable from plain LuaJIT).
+function util.from_float_ptr(cdata, n)
+    if has_torch then
+        local t = torch.FloatTensor(n)
+        ffi.copy(t:data(), cdata, n * ffi.sizeof('float'))
+        return t
+    end
+    local out = {}
+    for i = 1, n do out[i] = cdata[i - 1] end
+    return out
+end
+
+--- Zero tensor/table shaped like `data` (for the non-master init add).
+function util.zeros_like(data)
+    if has_torch and torch.isTensor(data) then
+        return data:clone():zero()
+    end
+    local out = {}
+    for i = 1, #data do out[i] = 0 end
+    return out
+end
+
+return util
