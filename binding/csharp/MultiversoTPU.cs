@@ -67,6 +67,9 @@ namespace MultiversoTPU
 
         public static void NetFinalize() { /* nothing to tear down */ }
 
+        // numTables is signature parity with the reference CLR wrapper; the
+        // native runtime registers tables on creation, so a declared count
+        // has nothing to pre-allocate here (same stance as NetBind/NetConnect).
         public static void Init(int numTables, bool sync)
         {
             var args = sync ? new[] { "multiverso-cs", "-sync=true" }
@@ -106,10 +109,23 @@ namespace MultiversoTPU
             Tables[tableId] = new Table { Handle = h, Rows = rows, Cols = cols };
         }
 
+        // Size mismatches must surface as catchable exceptions HERE — the
+        // native layer treats them as protocol violations and aborts the
+        // process (MVT_CHECK -> std::abort).
+        private static void RequireLength(Table t, int got, int want,
+                                          string what)
+        {
+            if (got != want)
+                throw new ArgumentException(
+                    $"{what}: buffer holds {got} floats, expected {want}");
+        }
+
         /// <summary>Whole-table get into a caller-sized buffer.</summary>
         public static void Get(int tableId, float[] value)
         {
             var t = Tables[tableId];
+            RequireLength(t, value.Length, Math.Max(t.Rows, 1) * t.Cols,
+                          "Get");
             if (t.Rows <= 1)
                 Native.MV_GetArrayTable(t.Handle, value, value.Length);
             else
@@ -120,6 +136,9 @@ namespace MultiversoTPU
         public static void Get(int tableId, int rowId, float[] value)
         {
             var t = Tables[tableId];
+            RequireLength(t, value.Length, t.Cols, "Get(row)");
+            if (rowId < 0 || rowId >= Math.Max(t.Rows, 1))
+                throw new ArgumentOutOfRangeException(nameof(rowId));
             Native.MV_GetMatrixTableByRows(t.Handle, value, value.Length,
                                            new[] { rowId }, 1);
         }
@@ -128,6 +147,8 @@ namespace MultiversoTPU
         public static void Add(int tableId, float[] update)
         {
             var t = Tables[tableId];
+            RequireLength(t, update.Length, Math.Max(t.Rows, 1) * t.Cols,
+                          "Add");
             if (t.Rows <= 1)
                 Native.MV_AddArrayTable(t.Handle, update, update.Length);
             else
@@ -138,6 +159,9 @@ namespace MultiversoTPU
         public static void Add(int tableId, int rowId, float[] update)
         {
             var t = Tables[tableId];
+            RequireLength(t, update.Length, t.Cols, "Add(row)");
+            if (rowId < 0 || rowId >= Math.Max(t.Rows, 1))
+                throw new ArgumentOutOfRangeException(nameof(rowId));
             Native.MV_AddMatrixTableByRows(t.Handle, update, update.Length,
                                            new[] { rowId }, 1);
         }

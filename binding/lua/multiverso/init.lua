@@ -49,19 +49,21 @@ void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
 ]])
 
 -- Library discovery order: MVT_LIB env var, then the in-repo build output,
--- then the usual system search path.
-local candidates = {
-    os.getenv('MVT_LIB'),
-    (os.getenv('MVT_ROOT') or '.') .. '/native/libmultiverso_tpu.so',
-    'libmultiverso_tpu.so',
-}
+-- then the usual system search path. (Built with table.insert so an unset
+-- MVT_LIB doesn't leave a nil hole that stops ipairs.)
+local candidates = {}
+if os.getenv('MVT_LIB') then
+    table.insert(candidates, os.getenv('MVT_LIB'))
+end
+table.insert(candidates,
+             (os.getenv('MVT_ROOT') or '.') .. '/native/libmultiverso_tpu.so')
+table.insert(candidates, 'libmultiverso_tpu.so')
+
 local lib, err
 for _, path in ipairs(candidates) do
-    if path then
-        local ok, loaded = pcall(ffi.load, path, true)
-        if ok then lib = loaded break end
-        err = loaded
-    end
+    local ok, loaded = pcall(ffi.load, path, true)
+    if ok then lib = loaded break end
+    err = loaded
 end
 if lib == nil then
     error('multiverso: cannot load libmultiverso_tpu.so (set MVT_LIB or '

@@ -1,0 +1,112 @@
+"""Per-variable PS-backed shared values — the ``mv_shared`` surface.
+
+Behavioral counterpart of the reference's Theano extension
+(binding/python/multiverso/theano_ext/sharedvar.py:12-99): a wrapper that
+pairs one mutable array ("shared variable") with one ArrayTable and syncs
+via the delta trick —
+
+    add(current_value - last_synced_value); value = get()
+
+so concurrent workers' updates merge additively on the server
+(sharedvar.py:37-49). The model-level ``MVModelParamManager`` in
+``param_manager.py`` applies the same algorithm to whole models; this
+module is the fine-grained per-variable version, including the
+master-initializes convention (only worker 0's init value lands,
+sharedvar.py:20-27).
+
+Theano is long gone; the 2026 equivalent of a "shared variable" is any
+box with ``get_value()/set_value()``. ``SharedArray`` provides that box
+for plain numpy/JAX values, and ``MVSharedVariable`` duck-types, so an
+object exposing the Theano ``SharedVariable`` protocol works unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SharedArray:
+    """Minimal get_value/set_value box over a numpy array (the stand-in
+    for ``theano.shared``)."""
+
+    def __init__(self, value):
+        self._value = np.array(value, np.float32)
+
+    def get_value(self, borrow: bool = False) -> np.ndarray:
+        return self._value if borrow else self._value.copy()
+
+    def set_value(self, value, borrow: bool = False) -> None:
+        arr = np.asarray(value, np.float32)
+        self._value = arr if borrow else arr.copy()
+
+
+class MVSharedVariable:
+    """Pairs a shared-variable box with an ArrayTable (reference
+    sharedvar.py:12-49). All other attribute access forwards to the
+    wrapped object, as the reference's ``__getattr__`` forwarding did."""
+
+    def __init__(self, svobj):
+        from multiverso_tpu import binding as mv
+        self._svobj = svobj
+        init = np.asarray(svobj.get_value(), np.float32)
+        self._shape = init.shape
+        self._mv_array = mv.ArrayTableHandler(init.size,
+                                              init_value=init.reshape(-1))
+        # The reference barriers here so every process's init add lands
+        # before the first get (sharedvar.py:29). In-process the sync Add
+        # above already blocked until applied, and worker threads may not
+        # even exist yet (vars are built during setup), so a thread
+        # rendezvous would deadlock — only the cross-process leg is needed.
+        from multiverso_tpu.parallel import multihost
+        multihost.host_barrier("mv_sharedvar_init")
+        synced = self._mv_array.get().reshape(self._shape)
+        self._svobj.set_value(synced, borrow=False)
+        self._last_mv_data = synced.copy()
+
+    def mv_sync(self) -> None:
+        """Push (current − last synced) and pull the merged value
+        (reference sharedvar.py:37-49)."""
+        current = np.asarray(self._svobj.get_value(), np.float32)
+        self._mv_array.add((current - self._last_mv_data).reshape(-1))
+        merged = self._mv_array.get().reshape(self._shape)
+        self._svobj.set_value(merged, borrow=False)
+        self._last_mv_data = merged.copy()
+
+    def __getattr__(self, name):
+        # everything not defined here behaves like the wrapped variable
+        try:
+            svobj = self.__dict__["_svobj"]
+        except KeyError:
+            raise AttributeError(name) from None
+        return getattr(svobj, name)
+
+
+def mv_shared(value, name=None, borrow=False, **kwargs):
+    """``theano.shared``-shaped factory (reference sharedvar.py:76-87):
+    builds the box, wraps it, and registers the wrapper for
+    ``sync_all_mv_shared_vars``. ``name`` is kept on the box; ``borrow``
+    is accepted for signature parity (SharedArray always copies on init —
+    the PS round-trip rewrites the value anyway); other theano kwargs are
+    rejected rather than silently dropped. Deviation: the reference
+    returned the bare theano variable and kept the wrapper internal; we
+    return the wrapper (it forwards every attribute, and callers need
+    ``mv_sync``)."""
+    if kwargs:
+        raise TypeError(f"mv_shared: unsupported keyword arguments "
+                        f"{sorted(kwargs)} (theano-era options have no "
+                        f"equivalent here)")
+    box = SharedArray(value)
+    box.name = name
+    var = MVSharedVariable(box)
+    mv_shared.shared_vars.append(var)
+    return var
+
+
+mv_shared.shared_vars = []  # registry, reference sharedvar.py:87
+
+
+def sync_all_mv_shared_vars() -> None:
+    """Sync every variable created through ``mv_shared`` (reference
+    sharedvar.py:90-99)."""
+    for var in mv_shared.shared_vars:
+        var.mv_sync()

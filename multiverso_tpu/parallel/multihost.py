@@ -151,6 +151,28 @@ def host_allreduce_sum(data: np.ndarray) -> np.ndarray:
     return np.asarray(gathered).sum(axis=0).astype(data.dtype)
 
 
+def host_allgather_bytes(data: bytes) -> list:
+    """Every process's byte blob, ordered by process index (collective;
+    single-process: ``[data]``). Blobs may differ in length — lengths are
+    exchanged first, then payloads ride one fixed-shape allgather padded
+    to the global max."""
+    if process_count() <= 1:
+        return [data]
+    from jax.experimental import multihost_utils
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.array([len(data)], np.int64))).reshape(-1)
+    cap = int(lens.max())
+    if cap == 0:
+        return [b""] * process_count()
+    buf = np.zeros(cap, np.uint8)
+    if data:
+        buf[:len(data)] = np.frombuffer(data, np.uint8)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(buf)).reshape(process_count(), cap)
+    return [gathered[i, :int(lens[i])].tobytes()
+            for i in range(process_count())]
+
+
 def broadcast_from_master(data: np.ndarray) -> np.ndarray:
     """Host 0's value to everyone (identity single-process). Collective."""
     if process_count() <= 1:

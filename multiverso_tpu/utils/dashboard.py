@@ -21,6 +21,15 @@ import time
 from typing import Dict, Optional
 
 
+def format_monitor_line(name: str, count: int, elapse_ms: float,
+                        suffix: str = "") -> str:
+    """The one place the dashboard line format lives (local Display and
+    cross-host DisplayAll share it)."""
+    avg = elapse_ms / count if count else 0.0
+    return (f"[Monitor] {name}: count = {count}, "
+            f"elapse = {elapse_ms:.3f} ms, average = {avg:.3f} ms{suffix}")
+
+
 class Monitor:
     def __init__(self, name: str, register: bool = True):
         self.name = name
@@ -61,9 +70,7 @@ class Monitor:
         return self.elapse_ms / self._count if self._count else 0.0
 
     def info_string(self) -> str:
-        return (f"[Monitor] {self.name}: count = {self._count}, "
-                f"elapse = {self.elapse_ms:.3f} ms, "
-                f"average = {self.average_ms:.3f} ms")
+        return format_monitor_line(self.name, self._count, self.elapse_ms)
 
 
 class Dashboard:
@@ -97,6 +104,55 @@ class Dashboard:
     def Display(cls) -> str:
         with cls._lock:
             lines = [m.info_string() for m in cls._records.values()]
+        out = "\n".join(lines)
+        if out:
+            print(out, flush=True)
+        return out
+
+    @classmethod
+    def AggregateAcrossHosts(cls) -> Dict[str, Dict[str, float]]:
+        """Job-wide monitor totals: per name, (count, elapsed_ms) summed
+        over every host (SURVEY.md §5: "the same named-region dashboard
+        aggregated across hosts"). Collective in multihost jobs — every
+        process must call it, but their monitor name sets may differ
+        (role-specific regions, hosts with no monitors): names are
+        exchanged first and the sum runs over the union, so the
+        collectives always agree on shape. Single-process jobs get the
+        local totals unchanged.
+        """
+        import numpy as np
+
+        from multiverso_tpu.parallel import multihost
+
+        with cls._lock:
+            local_map = {n: (float(m.count), m.elapse_ms)
+                         for n, m in cls._records.items()}
+        names = sorted(local_map)
+        if multihost.process_count() > 1:
+            blobs = multihost.host_allgather_bytes(
+                "\x00".join(names).encode())
+            union = set()
+            for blob in blobs:
+                if blob:
+                    union.update(blob.decode().split("\x00"))
+            names = sorted(union)
+            if not names:
+                return {}
+            local = np.array([local_map.get(n, (0.0, 0.0)) for n in names],
+                             np.float64)
+            local = multihost.host_allreduce_sum(local)
+        else:
+            local = np.array([local_map[n] for n in names],
+                             np.float64).reshape(len(names), 2)
+        return {n: {"count": int(local[i, 0]), "elapse_ms": float(local[i, 1])}
+                for i, n in enumerate(names)}
+
+    @classmethod
+    def DisplayAll(cls) -> str:
+        """Print the cross-host aggregate (Display's job-wide sibling)."""
+        lines = [format_monitor_line(name, rec["count"], rec["elapse_ms"],
+                                     " (all hosts)")
+                 for name, rec in cls.AggregateAcrossHosts().items()]
         out = "\n".join(lines)
         if out:
             print(out, flush=True)

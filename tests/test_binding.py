@@ -187,3 +187,45 @@ class TestForeignBindings:
         self._check_against_abi(
             self._declared(cs, r"extern\s+\w+\s+(MV_\w+)\s*\("),
             c_api_names, native_lib)
+
+
+class TestSharedVar:
+    """Per-variable mv_shared surface (reference theano_ext/sharedvar.py)."""
+
+    def test_mv_sync_delta_trick(self, binding):
+        from multiverso_tpu.binding import sharedvar as sv
+        var = sv.mv_shared(np.zeros((2, 3), np.float32))
+        assert var.get_value().shape == (2, 3)
+        # local training step: value drifts by +1 everywhere
+        var.set_value(var.get_value() + 1.0)
+        var.mv_sync()
+        np.testing.assert_allclose(var.get_value(), np.ones((2, 3)))
+        # second drift merges additively on the server
+        var.set_value(var.get_value() + 2.0)
+        var.mv_sync()
+        np.testing.assert_allclose(var.get_value(), 3 * np.ones((2, 3)))
+
+    def test_sync_all_registry(self, binding):
+        from multiverso_tpu.binding import sharedvar as sv
+        sv.mv_shared.shared_vars.clear()
+        a = sv.mv_shared(np.zeros(4, np.float32))
+        b = sv.mv_shared(np.full(4, 5.0, np.float32))
+        a.set_value(a.get_value() + 1.0)
+        sv.sync_all_mv_shared_vars()
+        np.testing.assert_allclose(a.get_value(), 1.0)
+        np.testing.assert_allclose(b.get_value(), 5.0)
+
+    def test_master_initializes(self, binding):
+        """Init value lands exactly once even though every worker adds
+        (worker 0 contributes the value, the rest zeros)."""
+        from multiverso_tpu.binding import sharedvar as sv
+        init = np.arange(6, dtype=np.float32).reshape(2, 3)
+        var = sv.mv_shared(init)
+        np.testing.assert_allclose(var.get_value(), init)
+
+    def test_attribute_forwarding(self, binding):
+        from multiverso_tpu.binding import sharedvar as sv
+        box = sv.SharedArray(np.zeros(2, np.float32))
+        box.custom_tag = "hello"
+        var = sv.MVSharedVariable(box)
+        assert var.custom_tag == "hello"

@@ -138,6 +138,47 @@ class TestDashboard:
         out = Dashboard.Display()
         assert "test.display" in out
 
+    def test_aggregate_across_hosts_single_process(self):
+        """In a 1-process job the aggregate equals the local totals."""
+        Dashboard._reset_for_tests()
+        Monitor("test.agg").Add(0.002, count=3)
+        agg = Dashboard.AggregateAcrossHosts()
+        assert agg["test.agg"]["count"] == 3
+        assert agg["test.agg"]["elapse_ms"] == pytest.approx(2.0)
+        assert "(all hosts)" in Dashboard.DisplayAll()
+
+    def test_aggregate_across_hosts_union_alignment(self, monkeypatch):
+        """Hosts with DIFFERENT monitor name sets still sum correctly:
+        names are exchanged and the reduce runs over the union (simulated
+        two-host world — this host has {shared, mine}, the peer reports
+        {shared, theirs})."""
+        import numpy as np
+        from multiverso_tpu.parallel import multihost
+
+        Dashboard._reset_for_tests()
+        Monitor("shared").Add(0.001, count=1)
+        Monitor("mine").Add(0.002, count=2)
+        peer_names = "\x00".join(sorted(["shared", "theirs"])).encode()
+        peer_vals = {"shared": (4.0, 5.0), "theirs": (6.0, 7.0)}
+
+        monkeypatch.setattr(multihost, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost, "host_allgather_bytes",
+            lambda blob: [blob, peer_names])
+
+        def fake_allreduce(local):
+            union = sorted({"shared", "mine", "theirs"})
+            peer = np.array([peer_vals.get(n, (0.0, 0.0)) for n in union])
+            assert local.shape == peer.shape  # the alignment guarantee
+            return local + peer
+
+        monkeypatch.setattr(multihost, "host_allreduce_sum", fake_allreduce)
+        agg = Dashboard.AggregateAcrossHosts()
+        assert set(agg) == {"shared", "mine", "theirs"}
+        assert agg["shared"]["count"] == 5      # 1 + 4
+        assert agg["mine"]["count"] == 2        # local only
+        assert agg["theirs"]["count"] == 6      # peer only
+
 
 class TestIO:
     def test_uri_parse(self):
