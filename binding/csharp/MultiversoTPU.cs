@@ -112,8 +112,7 @@ namespace MultiversoTPU
         // Size mismatches must surface as catchable exceptions HERE — the
         // native layer treats them as protocol violations and aborts the
         // process (MVT_CHECK -> std::abort).
-        private static void RequireLength(Table t, int got, int want,
-                                          string what)
+        private static void RequireLength(int got, int want, string what)
         {
             if (got != want)
                 throw new ArgumentException(
@@ -124,7 +123,7 @@ namespace MultiversoTPU
         public static void Get(int tableId, float[] value)
         {
             var t = Tables[tableId];
-            RequireLength(t, value.Length, Math.Max(t.Rows, 1) * t.Cols,
+            RequireLength(value.Length, Math.Max(t.Rows, 1) * t.Cols,
                           "Get");
             if (t.Rows <= 1)
                 Native.MV_GetArrayTable(t.Handle, value, value.Length);
@@ -136,7 +135,7 @@ namespace MultiversoTPU
         public static void Get(int tableId, int rowId, float[] value)
         {
             var t = Tables[tableId];
-            RequireLength(t, value.Length, t.Cols, "Get(row)");
+            RequireLength(value.Length, t.Cols, "Get(row)");
             if (rowId < 0 || rowId >= Math.Max(t.Rows, 1))
                 throw new ArgumentOutOfRangeException(nameof(rowId));
             Native.MV_GetMatrixTableByRows(t.Handle, value, value.Length,
@@ -147,7 +146,7 @@ namespace MultiversoTPU
         public static void Add(int tableId, float[] update)
         {
             var t = Tables[tableId];
-            RequireLength(t, update.Length, Math.Max(t.Rows, 1) * t.Cols,
+            RequireLength(update.Length, Math.Max(t.Rows, 1) * t.Cols,
                           "Add");
             if (t.Rows <= 1)
                 Native.MV_AddArrayTable(t.Handle, update, update.Length);
@@ -159,7 +158,7 @@ namespace MultiversoTPU
         public static void Add(int tableId, int rowId, float[] update)
         {
             var t = Tables[tableId];
-            RequireLength(t, update.Length, t.Cols, "Add(row)");
+            RequireLength(update.Length, t.Cols, "Add(row)");
             if (rowId < 0 || rowId >= Math.Max(t.Rows, 1))
                 throw new ArgumentOutOfRangeException(nameof(rowId));
             Native.MV_AddMatrixTableByRows(t.Handle, update, update.Length,

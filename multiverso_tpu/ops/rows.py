@@ -1,4 +1,4 @@
-"""Backend dispatch for the row gather/scatter table ops.
+"""Backend dispatch for the row gather/scatter/update table ops.
 
 ``use_pallas`` is governed by the ``use_pallas`` flag:
 ``auto`` (default) — Pallas on TPU, XLA elsewhere; ``on`` — Pallas
@@ -7,6 +7,13 @@ everywhere (interpreter mode off-TPU; used by tests); ``off`` — XLA.
 The XLA fallback relies on jit'd gather + ``.at[].set`` — on a CPU test
 mesh that is both correct and fast enough; on TPU the Pallas kernels avoid
 materializing gather/scatter HLO over the whole shard.
+
+Row DMAs slice HBM along the lane dim, so Pallas needs the row byte-width
+tile-aligned (128 lanes for 4-byte dtypes). The table layer pads its
+storage column dim to ``padded_cols`` so the hot path stays eligible —
+measured ~5.6x on the reference 1Mx50 row-op benchmark even for plain XLA
+(aligned rows vs 200-byte ragged rows), with the fused Pallas update
+another ~1.6x on top.
 """
 
 from __future__ import annotations
@@ -18,13 +25,17 @@ from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_string
 
 MV_DEFINE_string("use_pallas", "auto",
                  "row-op kernels: auto (TPU only) / on / off")
+MV_DEFINE_string("matrix_pad_cols", "auto",
+                 "pad matrix storage cols to the 128-lane tile: auto/on/off")
+
+LANE = 128
 
 
 def _pallas_eligible(data) -> bool:
     """Row DMAs slice HBM along the lane dim, so rows must be tile-aligned:
     128 lanes for 4-byte dtypes (Mosaic: 'slice shape along dimension 1 must
     be aligned to tiling (128)')."""
-    return data.dtype.itemsize == 4 and data.shape[-1] % 128 == 0
+    return data.dtype.itemsize == 4 and data.shape[-1] % LANE == 0
 
 
 def use_pallas(data=None) -> bool:
@@ -38,6 +49,20 @@ def use_pallas(data=None) -> bool:
         return False
     return (jax.default_backend() == "tpu"
             and (data is None or _pallas_eligible(data)))
+
+
+def padded_cols(num_cols: int, itemsize: int = 4) -> int:
+    """Storage column count for a logical ``num_cols``, governed by the
+    ``matrix_pad_cols`` flag: ``auto``/``on`` — pad 4-byte dtypes up to the
+    128-lane tile; ``off`` — never. Aligned rows are what make the row hot
+    path fast (ragged 200-byte rows measured ~5.6x slower even on the plain
+    XLA path) and what the Pallas row-DMA kernels require. The pad trades
+    HBM capacity for alignment; padded columns hold zeros and every updater
+    is identity on a zero delta, so they stay zero."""
+    mode = str(GetFlag("matrix_pad_cols")).lower()
+    if mode == "off" or itemsize != 4:
+        return num_cols
+    return -(-num_cols // LANE) * LANE
 
 
 def _interpret() -> bool:
@@ -60,3 +85,22 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         return pallas_scatter_set_rows(data, ids, rows, interpret=_interpret())
     return data.at[ids].set(rows)
+
+
+def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
+                combine) -> jax.Array:
+    """data[ids[i]] = combine(data[ids[i]], deltas[i]) — the fused
+    read-modify-write Add for aux-free elementwise updaters. ``combine``
+    must satisfy combine(rows, 0) == rows (see pallas_rows contract) and be
+    identity-stable (one object per table) so the jit cache holds.
+
+    On the XLA path this is gather + combine + scatter (XLA fuses the
+    elementwise into the scatter operand); on TPU it is one Pallas kernel
+    doing row-DMA in / vector op / row-DMA out.
+    """
+    if use_pallas(data):
+        from multiverso_tpu.ops.pallas_rows import pallas_update_rows
+        return pallas_update_rows(data, ids, deltas, combine,
+                                  interpret=_interpret())
+    rows = jnp.take(data, ids, axis=0)
+    return data.at[ids].set(combine(rows, deltas))

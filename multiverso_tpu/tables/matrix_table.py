@@ -81,6 +81,10 @@ class MatrixServerTable(ServerTable):
         self.block_rows = ceil_block_rows(num_rows, self.num_servers)
         self.shard_rows = self.block_rows + 1
         self.padded_rows = self.num_servers * self.shard_rows
+        # Columns padded to the 128-lane tile (ops.padded_cols): aligned row
+        # slices are what the hot path needs; padded cols hold zeros forever
+        # (every updater is identity on a zero delta).
+        self.store_cols = ops.padded_cols(num_cols, self.dtype.itemsize)
         self.updater = CreateUpdater(updater_type)
         self._mesh = ctx.mesh
 
@@ -89,9 +93,9 @@ class MatrixServerTable(ServerTable):
             init = np.asarray(initializer((num_rows, num_cols)), self.dtype)
             data = self._to_storage(init)  # host numpy; place() shards it
         else:
-            data = jnp.zeros((self.padded_rows, num_cols), self.dtype)
-        aux = self.updater.init_aux((self.padded_rows, num_cols), self.dtype,
-                                    zoo.num_workers)
+            data = jnp.zeros((self.padded_rows, self.store_cols), self.dtype)
+        aux = self.updater.init_aux((self.padded_rows, self.store_cols),
+                                    self.dtype, zoo.num_workers)
         self.state = {
             "data": ctx.place(data, self._sharding),
             "aux": jax.tree.map(
@@ -135,8 +139,21 @@ class MatrixServerTable(ServerTable):
 
         self._update_full = jax.jit(_update_full, donate_argnums=(0,))
 
+        # Fused path: aux-free elementwise updaters (default add, sgd) run
+        # the whole server-side Add as ONE read-modify-write kernel over the
+        # touched rows (ops.update_rows) — no separate gather/scatter.
+        # Foreign lanes carry their real deltas into this shard's trash row,
+        # which therefore accumulates garbage; that's fine solely because
+        # the trash row is don't-care (never read back: Get masks non-mine
+        # lanes to 0, _from_storage strips it).
+        fuse = updater.fusable and not jax.tree.leaves(aux)
+        combine = updater.combine  # captured once: identity-stable jit key
+
         def _update_rows_local(local_data, local_aux, ids, deltas, opt):
             _, safe = _local_lanes(ids)
+            if fuse:
+                return ops.update_rows(local_data, safe, deltas,
+                                       combine), local_aux
             rows = ops.gather_rows(local_data, safe)
             aux_rows = _gather_aux(local_aux, safe)
             new_rows, new_aux_rows = updater.update(rows, aux_rows, deltas,
@@ -147,7 +164,12 @@ class MatrixServerTable(ServerTable):
             aux = _scatter_aux(local_aux, new_aux_rows, safe)
             return data, aux
 
+        store_cols = self.store_cols
+
         def _update_rows(state, ids, deltas, opt):
+            if deltas.shape[-1] != store_cols:   # logical cols in, pad zeros
+                deltas = jnp.pad(
+                    deltas, ((0, 0), (0, store_cols - deltas.shape[-1])))
             data, aux = jax.shard_map(
                 _update_rows_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
@@ -172,13 +194,17 @@ class MatrixServerTable(ServerTable):
         from multiverso_tpu.updaters.base import Updater as _UpdaterBase
         has_access = type(updater).access is not _UpdaterBase.access
 
+        num_cols_ = num_cols
+
         def _gather_rows_local(local_data, local_aux, ids):
             mine, safe = _local_lanes(ids)
             rows = ops.gather_rows(local_data, safe)
             if has_access:
                 rows = updater.access(rows, _gather_aux(local_aux, safe),
                                       None)
-            rows = jnp.where(mine[:, None], rows, 0)
+            # slice the storage pad off BEFORE the psum: only logical
+            # columns ride ICI
+            rows = jnp.where(mine[:, None], rows[:, :num_cols_], 0)
             return lax.psum(rows, SERVER_AXIS)
 
         def _gather_rows(data, aux, ids):
@@ -202,21 +228,23 @@ class MatrixServerTable(ServerTable):
     # -- storage layout (interleaved shard blocks + trash rows) -------------
 
     def _to_storage(self, full: np.ndarray) -> np.ndarray:
-        """(num_rows, cols) logical -> (padded_rows, cols) storage."""
-        out = np.zeros((self.num_servers, self.shard_rows, self.num_cols),
+        """(num_rows, num_cols) logical -> (padded_rows, store_cols)
+        storage (rows interleaved into shard blocks, cols zero-padded)."""
+        out = np.zeros((self.num_servers, self.shard_rows, self.store_cols),
                        full.dtype)
         padded = np.zeros((self.num_servers * self.block_rows, self.num_cols),
                           full.dtype)
         padded[: self.num_rows] = full
-        out[:, : self.block_rows] = padded.reshape(self.num_servers,
-                                                   self.block_rows,
-                                                   self.num_cols)
-        return out.reshape(self.padded_rows, self.num_cols)
+        out[:, : self.block_rows, : self.num_cols] = padded.reshape(
+            self.num_servers, self.block_rows, self.num_cols)
+        return out.reshape(self.padded_rows, self.store_cols)
 
     def _from_storage(self, storage: np.ndarray) -> np.ndarray:
-        """(padded_rows, cols) storage -> (num_rows, cols) logical."""
+        """(padded_rows, store_cols) storage -> (num_rows, num_cols)
+        logical."""
         blocks = storage.reshape(self.num_servers, self.shard_rows,
-                                 self.num_cols)[:, : self.block_rows]
+                                 self.store_cols)[:, : self.block_rows,
+                                                  : self.num_cols]
         return blocks.reshape(-1, self.num_cols)[: self.num_rows]
 
     # -- helpers ------------------------------------------------------------

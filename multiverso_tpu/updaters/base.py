@@ -77,11 +77,19 @@ class Updater:
     (reference src/updater/updater.cpp:21-29; OpenMP there, XLA here)."""
 
     name = "default"
+    #: True when the rule is a pure elementwise fn of (data, delta) — no aux,
+    #: no opt, identity on zero delta — so the row path may use the fused
+    #: read-modify-write kernel (ops.update_rows) via ``combine``.
+    fusable = True
 
     def init_aux(self, shape, dtype, num_workers: int) -> Dict[str, Any]:
         """Aux state pytree. Leaves shaped like data are shared state;
         leaves shaped (num_workers,)+shape are per-worker state."""
         return {}
+
+    def combine(self, rows: jax.Array, deltas: jax.Array) -> jax.Array:
+        """The fusable elementwise rule (only called when ``fusable``)."""
+        return rows + deltas
 
     def update(self, data: jax.Array, aux: Dict[str, Any], delta: jax.Array,
                opt: Dict[str, jax.Array]):
@@ -104,6 +112,9 @@ class SGDUpdater(Updater):
 
     name = "sgd"
 
+    def combine(self, rows, deltas):
+        return rows - deltas
+
     def update(self, data, aux, delta, opt):
         return data - delta, aux
 
@@ -114,6 +125,7 @@ class MomentumUpdater(Updater):
     One shared smooth buffer (not per worker) like the reference."""
 
     name = "momentum"
+    fusable = False
 
     def init_aux(self, shape, dtype, num_workers):
         return {"smooth": jnp.zeros(shape, dtype)}
@@ -130,6 +142,7 @@ class AdaGradUpdater(Updater):
     worker; the per-Add worker_id selects which history to advance."""
 
     name = "adagrad"
+    fusable = False
     eps = 1e-6
 
     def init_aux(self, shape, dtype, num_workers):

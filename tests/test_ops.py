@@ -34,6 +34,40 @@ class TestPallasKernels:
         expect[ids] = rows
         np.testing.assert_array_equal(np.asarray(out), expect)
 
+    def test_update_rows_fused(self):
+        from multiverso_tpu.ops.pallas_rows import pallas_update_rows
+        rng = np.random.default_rng(2)
+        data = rng.standard_normal((24, 6)).astype(np.float32)
+        # kernel contract (caller = matrix_table): live ids unique;
+        # duplicates only on the trash row (here: 23), content don't-care
+        ids = np.array([1, 23, 8, 23, 0], np.int32)
+        deltas = rng.standard_normal((5, 6)).astype(np.float32)
+        out = pallas_update_rows(jnp.asarray(data), jnp.asarray(ids),
+                                 jnp.asarray(deltas),
+                                 combine=lambda r, d: r + d, interpret=True)
+        live = [1, 8, 0]
+        expect = data.copy()
+        expect[live] += deltas[[0, 2, 4]]
+        got = np.asarray(out)
+        np.testing.assert_allclose(got[live], expect[live], rtol=1e-6)
+        # untouched live rows intact (trash row 23 excluded: don't-care)
+        untouched = [r for r in range(24) if r not in (0, 1, 8, 23)]
+        np.testing.assert_array_equal(got[untouched], data[untouched])
+
+    def test_update_rows_sgd_combine(self):
+        from multiverso_tpu.ops.pallas_rows import pallas_update_rows
+        data = np.ones((10, 4), np.float32)
+        ids = np.array([2, 7], np.int32)
+        deltas = np.full((2, 4), 0.25, np.float32)
+        out = pallas_update_rows(jnp.asarray(data), jnp.asarray(ids),
+                                 jnp.asarray(deltas),
+                                 combine=lambda r, d: r - d, interpret=True)
+        expect = data.copy()
+        expect[ids] -= deltas
+        np.testing.assert_allclose(np.asarray(out), expect)
+        # untouched rows intact
+        np.testing.assert_array_equal(np.asarray(out)[[0, 1, 3]], 1.0)
+
     def test_scatter_preserves_untouched(self):
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         data = np.arange(40, dtype=np.float32).reshape(8, 5)
@@ -106,7 +140,10 @@ class TestShardedLayout:
         assert server.num_servers == len(jax.devices())
         full = np.arange(13 * 3, dtype=np.float32).reshape(13, 3)
         st = server._to_storage(full)
-        assert st.shape == (server.padded_rows, 3)
+        assert st.shape == (server.padded_rows, server.store_cols)
+        assert server.store_cols >= 3
+        # pad columns are zero and stay zero (updaters are identity on them)
+        np.testing.assert_array_equal(st[:, 3:], 0.0)
         np.testing.assert_array_equal(server._from_storage(st), full)
 
     def test_tiny_table_fewer_rows_than_servers(self, mv_env):
