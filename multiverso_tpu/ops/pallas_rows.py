@@ -47,45 +47,74 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 CHUNK = 64
+# Conservative slice of the ~16MB/core VMEM for a kernel's blocks.
+# _chunk_for shrinks the chunk for wide rows so the blocks always fit; rows
+# so wide that even MIN_CHUNK overflows make it return 0, which
+# rows._pallas_eligible uses to route those tables to the XLA path.
+VMEM_BUDGET = 4 * 1024 * 1024
+MIN_CHUNK = 8
+#: the fused RMW kernel's VMEM block count (deltas block double-buffered by
+#: Mosaic's pipeline + scratch) — the worst case of the three kernels, and
+#: therefore what eligibility is judged against
+FUSED_BLOCKS = 3
 
 
-def _gather_kernel(ids_ref, data_ref, out_ref, sem):
-    i = pl.program_id(0)
-    copies = []
-    for j in range(CHUNK):
-        row = ids_ref[i * CHUNK + j]
-        copies.append(pltpu.make_async_copy(
-            data_ref.at[pl.ds(row, 1), :],
-            out_ref.at[pl.ds(j, 1), :],
-            sem.at[j]))
-    for c in copies:
-        c.start()
-    for c in copies:
-        c.wait()
+def _chunk_for(cols: int, itemsize: int, blocks: int = FUSED_BLOCKS) -> int:
+    """Largest chunk (<= CHUNK, >= MIN_CHUNK, power of two) for which
+    ``blocks`` VMEM blocks of (chunk, cols) fit the budget, or 0 when even
+    MIN_CHUNK does not. ``blocks`` is per kernel: the fused update holds
+    FUSED_BLOCKS, gather/scatter hold 2 (one block, double-buffered).
+    Callers derive chunk from static shapes, so it is a compile-time
+    constant."""
+    c = CHUNK
+    while c > MIN_CHUNK and blocks * c * cols * itemsize > VMEM_BUDGET:
+        c //= 2
+    if blocks * c * cols * itemsize > VMEM_BUDGET:
+        return 0
+    return c
+
+
+def _make_gather_kernel(chunk):
+    def _gather_kernel(ids_ref, data_ref, out_ref, sem):
+        i = pl.program_id(0)
+        copies = []
+        for j in range(chunk):
+            row = ids_ref[i * chunk + j]
+            copies.append(pltpu.make_async_copy(
+                data_ref.at[pl.ds(row, 1), :],
+                out_ref.at[pl.ds(j, 1), :],
+                sem.at[j]))
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+    return _gather_kernel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_gather_rows(data: jax.Array, ids: jax.Array,
                        interpret: bool = False) -> jax.Array:
-    """rows[i] = data[ids[i]] — one row DMA per id, CHUNK per grid step."""
+    """rows[i] = data[ids[i]] — one row DMA per id, chunk per grid step."""
+    chunk = _chunk_for(data.shape[1], data.dtype.itemsize, blocks=2)
+    assert chunk, "caller must gate on rows._pallas_eligible"
     orig_n = ids.shape[0]
-    if orig_n % CHUNK:
+    if orig_n % chunk:
         # tail pad with id 0: a read-only over-fetch, sliced off below
-        pad = CHUNK - orig_n % CHUNK
+        pad = chunk - orig_n % chunk
         ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
     n = ids.shape[0]
     cols = data.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // CHUNK,),
+        grid=(n // chunk,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
         ],
-        out_specs=pl.BlockSpec((CHUNK, cols), lambda i, ids: (i, 0)),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((CHUNK,))],
+        out_specs=pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((chunk,))],
     )
     out = pl.pallas_call(
-        _gather_kernel,
+        _make_gather_kernel(chunk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, cols), data.dtype),
         interpret=interpret,
@@ -93,20 +122,22 @@ def pallas_gather_rows(data: jax.Array, ids: jax.Array,
     return out[:orig_n]
 
 
-def _scatter_kernel(ids_ref, rows_ref, data_ref, out_ref, sem):
-    del data_ref  # alias donor; out_ref IS the table buffer
-    i = pl.program_id(0)
-    copies = []
-    for j in range(CHUNK):
-        row = ids_ref[i * CHUNK + j]
-        copies.append(pltpu.make_async_copy(
-            rows_ref.at[pl.ds(j, 1), :],
-            out_ref.at[pl.ds(row, 1), :],
-            sem.at[j]))
-    for c in copies:
-        c.start()
-    for c in copies:
-        c.wait()
+def _make_scatter_kernel(chunk):
+    def _scatter_kernel(ids_ref, rows_ref, data_ref, out_ref, sem):
+        del data_ref  # alias donor; out_ref IS the table buffer
+        i = pl.program_id(0)
+        copies = []
+        for j in range(chunk):
+            row = ids_ref[i * chunk + j]
+            copies.append(pltpu.make_async_copy(
+                rows_ref.at[pl.ds(j, 1), :],
+                out_ref.at[pl.ds(row, 1), :],
+                sem.at[j]))
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+    return _scatter_kernel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
@@ -118,26 +149,28 @@ def pallas_scatter_set_rows(data: jax.Array, ids: jax.Array,
     Rows the ids never name keep their HBM content — only touched rows
     move, which is the whole point of the PS row protocol.
     """
-    if ids.shape[0] % CHUNK:
+    chunk = _chunk_for(data.shape[1], data.dtype.itemsize, blocks=2)
+    assert chunk, "caller must gate on rows._pallas_eligible"
+    if ids.shape[0] % chunk:
         # tail pad by replicating the last (id, row) pair: the extra DMAs
         # rewrite the same bytes to the same row — a no-op on memory content
-        pad = CHUNK - ids.shape[0] % CHUNK
+        pad = chunk - ids.shape[0] % chunk
         ids = jnp.concatenate([ids] + [ids[-1:]] * pad)
         rows = jnp.concatenate([rows] + [rows[-1:]] * pad)
     n = ids.shape[0]
     cols = data.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // CHUNK,),
+        grid=(n // chunk,),
         in_specs=[
-            pl.BlockSpec((CHUNK, cols), lambda i, ids: (i, 0)),   # rows: VMEM
+            pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),   # rows: VMEM
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((CHUNK,))],
+        scratch_shapes=[pltpu.SemaphoreType.DMA((chunk,))],
     )
     return pl.pallas_call(
-        _scatter_kernel,
+        _make_scatter_kernel(chunk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(data.shape, data.dtype),
         input_output_aliases={2: 0},  # operand index counts the prefetch arg
@@ -145,12 +178,12 @@ def pallas_scatter_set_rows(data: jax.Array, ids: jax.Array,
     )(ids, rows, data)
 
 
-def _make_update_kernel(combine, orig_n):
-    """RMW kernel. ``orig_n`` is the true id count: when it isn't a CHUNK
+def _make_update_kernel(combine, orig_n, chunk):
+    """RMW kernel. ``orig_n`` is the true id count: when it isn't a chunk
     multiple, tail lanes are skipped via pl.when (a duplicated pad id would
     RACE — the dup lane would write the row's pre-update bytes back over
     the real lane's update). Full-chunk batches compile with no guards."""
-    ragged = orig_n % CHUNK != 0
+    ragged = orig_n % chunk != 0
 
     def _update_kernel(ids_ref, deltas_ref, data_ref, out_ref, scratch,
                        rsem, wsem):
@@ -159,51 +192,28 @@ def _make_update_kernel(combine, orig_n):
 
         def lane(j, fn):
             if ragged:
-                pl.when(i * CHUNK + j < orig_n)(fn)
+                pl.when(i * chunk + j < orig_n)(fn)
             else:
                 fn()
 
-        def rd(j):
-            def go():
-                row = ids_ref[i * CHUNK + j]
-                pltpu.make_async_copy(out_ref.at[pl.ds(row, 1), :],
-                                      scratch.at[pl.ds(j, 1), :],
-                                      rsem.at[j]).start()
-            return go
+        def cp(j, write):
+            """The lane-j row DMA descriptor: table row <-> scratch row."""
+            row = ids_ref[i * chunk + j]
+            tbl = out_ref.at[pl.ds(row, 1), :]
+            buf = scratch.at[pl.ds(j, 1), :]
+            if write:
+                return pltpu.make_async_copy(buf, tbl, wsem.at[j])
+            return pltpu.make_async_copy(tbl, buf, rsem.at[j])
 
-        def rd_wait(j):
-            def go():
-                row = ids_ref[i * CHUNK + j]
-                pltpu.make_async_copy(out_ref.at[pl.ds(row, 1), :],
-                                      scratch.at[pl.ds(j, 1), :],
-                                      rsem.at[j]).wait()
-            return go
-
-        def wr(j):
-            def go():
-                row = ids_ref[i * CHUNK + j]
-                pltpu.make_async_copy(scratch.at[pl.ds(j, 1), :],
-                                      out_ref.at[pl.ds(row, 1), :],
-                                      wsem.at[j]).start()
-            return go
-
-        def wr_wait(j):
-            def go():
-                row = ids_ref[i * CHUNK + j]
-                pltpu.make_async_copy(scratch.at[pl.ds(j, 1), :],
-                                      out_ref.at[pl.ds(row, 1), :],
-                                      wsem.at[j]).wait()
-            return go
-
-        for j in range(CHUNK):
-            lane(j, rd(j))
-        for j in range(CHUNK):
-            lane(j, rd_wait(j))
+        for j in range(chunk):
+            lane(j, lambda j=j: cp(j, False).start())
+        for j in range(chunk):
+            lane(j, lambda j=j: cp(j, False).wait())
         scratch[...] = combine(scratch[...], deltas_ref[...])
-        for j in range(CHUNK):
-            lane(j, wr(j))
-        for j in range(CHUNK):
-            lane(j, wr_wait(j))
+        for j in range(chunk):
+            lane(j, lambda j=j: cp(j, True).start())
+        for j in range(chunk):
+            lane(j, lambda j=j: cp(j, True).wait())
     return _update_kernel
 
 
@@ -220,11 +230,13 @@ def pallas_update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     arg: one compile per (shape, combine) pair — combines are per-table
     updater singletons, so this never retraces in steady state.
     """
+    chunk = _chunk_for(data.shape[1], data.dtype.itemsize)
+    assert chunk, "caller must gate on rows._pallas_eligible"
     orig_n = ids.shape[0]
-    if orig_n % CHUNK:
-        # tail pad to a CHUNK multiple; the padded lanes are skipped inside
+    if orig_n % chunk:
+        # tail pad to a chunk multiple; the padded lanes are skipped inside
         # the kernel (see _make_update_kernel — pad *values* are never read)
-        pad = CHUNK - orig_n % CHUNK
+        pad = chunk - orig_n % chunk
         ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
         deltas = jnp.concatenate(
             [deltas, jnp.zeros((pad, deltas.shape[1]), deltas.dtype)])
@@ -232,18 +244,18 @@ def pallas_update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     cols = data.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // CHUNK,),
+        grid=(n // chunk,),
         in_specs=[
-            pl.BlockSpec((CHUNK, cols), lambda i, ids: (i, 0)),  # deltas
+            pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),  # deltas
             pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),    # data: HBM
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-        scratch_shapes=[pltpu.VMEM((CHUNK, cols), data.dtype),
-                        pltpu.SemaphoreType.DMA((CHUNK,)),
-                        pltpu.SemaphoreType.DMA((CHUNK,))],
+        scratch_shapes=[pltpu.VMEM((chunk, cols), data.dtype),
+                        pltpu.SemaphoreType.DMA((chunk,)),
+                        pltpu.SemaphoreType.DMA((chunk,))],
     )
     return pl.pallas_call(
-        _make_update_kernel(combine, orig_n),
+        _make_update_kernel(combine, orig_n, chunk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(data.shape, data.dtype),
         input_output_aliases={2: 0},  # operand index counts the prefetch arg

@@ -34,8 +34,13 @@ LANE = 128
 def _pallas_eligible(data) -> bool:
     """Row DMAs slice HBM along the lane dim, so rows must be tile-aligned:
     128 lanes for 4-byte dtypes (Mosaic: 'slice shape along dimension 1 must
-    be aligned to tiling (128)')."""
-    return data.dtype.itemsize == 4 and data.shape[-1] % LANE == 0
+    be aligned to tiling (128)'). Rows so wide that even the minimum chunk's
+    VMEM blocks overflow the kernel budget take the XLA path instead —
+    pallas_rows._chunk_for owns that budget law and returns 0 when there is
+    nothing left to shrink."""
+    from multiverso_tpu.ops.pallas_rows import _chunk_for
+    return (data.dtype.itemsize == 4 and data.shape[-1] % LANE == 0
+            and _chunk_for(data.shape[-1], data.dtype.itemsize) > 0)
 
 
 def use_pallas(data=None) -> bool:

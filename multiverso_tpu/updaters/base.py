@@ -79,8 +79,11 @@ class Updater:
     name = "default"
     #: True when the rule is a pure elementwise fn of (data, delta) — no aux,
     #: no opt, identity on zero delta — so the row path may use the fused
-    #: read-modify-write kernel (ops.update_rows) via ``combine``.
-    fusable = True
+    #: read-modify-write kernel (ops.update_rows) via ``combine``. Defaults
+    #: to False so a subclass overriding ``update()`` is never silently
+    #: replaced by the inherited '+=' combine on the row path; opt in by
+    #: setting True AND overriding ``combine`` to match ``update``.
+    fusable = False
 
     def init_aux(self, shape, dtype, num_workers: int) -> Dict[str, Any]:
         """Aux state pytree. Leaves shaped like data are shared state;
@@ -104,6 +107,7 @@ class Updater:
 
 class AddUpdater(Updater):
     name = "default"
+    fusable = True  # combine (inherited '+=') IS update
 
 
 class SGDUpdater(Updater):
@@ -111,6 +115,7 @@ class SGDUpdater(Updater):
     (reference sgd_updater.h:15-19)."""
 
     name = "sgd"
+    fusable = True
 
     def combine(self, rows, deltas):
         return rows - deltas
@@ -125,7 +130,6 @@ class MomentumUpdater(Updater):
     One shared smooth buffer (not per worker) like the reference."""
 
     name = "momentum"
-    fusable = False
 
     def init_aux(self, shape, dtype, num_workers):
         return {"smooth": jnp.zeros(shape, dtype)}
@@ -142,7 +146,6 @@ class AdaGradUpdater(Updater):
     worker; the per-Add worker_id selects which history to advance."""
 
     name = "adagrad"
-    fusable = False
     eps = 1e-6
 
     def init_aux(self, shape, dtype, num_workers):
@@ -160,12 +163,53 @@ class AdaGradUpdater(Updater):
         return data, {"hist": hist}
 
 
+class DCASGDUpdater(Updater):
+    """Delay-compensated ASGD (reference hook: src/updater/updater.cpp:2-12
+    selects a DCASGD updater behind ``ENABLE_DCASGD``, but the headers are
+    absent from the snapshot — ``include/multiverso/updater/dcasgd/`` is
+    empty, SURVEY.md §2b — so this implements the published algorithm the
+    hook names: Zheng et al., "Asynchronous SGD with Delay Compensation").
+
+    The server keeps one parameter *backup* per worker — the model that
+    worker last saw. An Add from worker m carries ``delta = lr * g`` (SGD
+    client convention, sgd_updater.h:15-19) and applies
+
+        w -= delta + (lambda / lr) * delta^2 * (w - backup[m])
+           = lr * (g + lambda * g*g*(w - backup[m]))
+
+    i.e. a first-order correction of the stale gradient toward the current
+    parameters, then refreshes ``backup[m] = w``. The backup starts at zero
+    (aux init has no access to initial data); the compensation term is a
+    correction, so the first push per worker is plain SGD-magnitude off and
+    self-corrects immediately after. Selected by ``-updater_type=dcasgd``
+    (the reference gates the same choice at compile time)."""
+
+    name = "dcasgd"
+
+    def init_aux(self, shape, dtype, num_workers):
+        return {"backup": jnp.zeros((num_workers,) + tuple(shape), dtype)}
+
+    def update(self, data, aux, delta, opt):
+        wid = opt["worker_id"]
+        lr = opt["learning_rate"].astype(data.dtype)
+        lam = opt["lambda_"].astype(data.dtype)
+        bak = aux["backup"][wid]
+        # lr rides in traced (no retrace on change), so a zero can't raise
+        # here — degrade the compensation to plain SGD instead of poisoning
+        # the table with inf/NaN (the native mirror CHECKs, store.cc)
+        lam_over_lr = jnp.where(lr > 0, lam / jnp.maximum(lr, 1e-30), 0.0)
+        new = data - (delta + lam_over_lr * delta * delta * (data - bak))
+        backup = aux["backup"].at[wid].set(new)
+        return new, {"backup": backup}
+
+
 _REGISTRY = {
     "default": AddUpdater,
     "": AddUpdater,
     "sgd": SGDUpdater,
     "momentum": MomentumUpdater,
     "adagrad": AdaGradUpdater,
+    "dcasgd": DCASGDUpdater,
 }
 
 

@@ -73,6 +73,24 @@ class AdaGradUpdaterC : public UpdaterC {
   size_t size_ = 0;
 };
 
+// Delay-compensated ASGD (the reference hooks this behind ENABLE_DCASGD,
+// src/updater/updater.cpp:2-12, but ships no headers — implemented from the
+// published algorithm; mirror of the python DCASGDUpdater,
+// multiverso_tpu/updaters/base.py).
+class DcasgdUpdaterC : public UpdaterC {
+ public:
+  void InitState(size_t size, int num_workers) override {
+    backup_.assign(static_cast<size_t>(num_workers) * size, 0.f);
+    size_ = size;
+  }
+  void Update(size_t n, float* data, const float* delta,
+              const AddOptionC& opt, size_t offset) override;
+
+ private:
+  std::vector<float> backup_;
+  size_t size_ = 0;
+};
+
 // -- tables -----------------------------------------------------------------
 
 class TableC {

@@ -46,6 +46,24 @@ void AdaGradUpdaterC::Update(size_t n, float* data, const float* delta,
   }
 }
 
+void DcasgdUpdaterC::Update(size_t n, float* data, const float* delta,
+                            const AddOptionC& opt, size_t offset) {
+  // w -= delta + (lambda/lr) * delta^2 * (w - backup[m]); backup[m] = w
+  // (delta = lr * g, the SGD client convention — see python DCASGDUpdater)
+  MVT_CHECK(opt.worker_id >= 0 &&
+            (static_cast<size_t>(opt.worker_id) + 1) * size_ <=
+                backup_.size());
+  MVT_CHECK(opt.learning_rate > 0.0f);  // lam/lr below
+  float* bak = backup_.data() + static_cast<size_t>(opt.worker_id) * size_;
+  const float lam_over_lr = opt.lambda / opt.learning_rate;
+  for (size_t i = 0; i < n; ++i) {
+    const float d = delta[i];
+    float& w = data[offset + i];
+    w -= d + lam_over_lr * d * d * (w - bak[offset + i]);
+    bak[offset + i] = w;
+  }
+}
+
 std::unique_ptr<UpdaterC> UpdaterC::Create(const std::string& type,
                                            size_t size, int num_workers) {
   std::unique_ptr<UpdaterC> updater;
@@ -55,6 +73,8 @@ std::unique_ptr<UpdaterC> UpdaterC::Create(const std::string& type,
     updater = std::make_unique<MomentumUpdaterC>();
   } else if (type == "adagrad") {
     updater = std::make_unique<AdaGradUpdaterC>();
+  } else if (type == "dcasgd") {
+    updater = std::make_unique<DcasgdUpdaterC>();
   } else {
     updater = std::make_unique<UpdaterC>();
   }

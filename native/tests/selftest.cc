@@ -3,6 +3,7 @@
 // world where the whole PS path runs through real actors) plus the util
 // layer (queue/waiter/allocator/blob/flags) and the BSP sync protocol.
 #include <cassert>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 #include <thread>
@@ -13,6 +14,7 @@
 #include "mvt/c_api.h"
 #include "mvt/configure.h"
 #include "mvt/mt_queue.h"
+#include "mvt/store.h"
 #include "mvt/waiter.h"
 
 static void test_utils() {
@@ -142,6 +144,25 @@ static void test_updaters() {
     MV_GetArrayTable(t, out.data(), 4);
     for (int i = 0; i < 4; ++i) assert(out[i] == -0.5f);
     MV_ShutDown();
+  }
+  {
+    // dcasgd: per-worker backup + delay compensation (mirror of the python
+    // DCASGDUpdater test, tests/test_tables.py)
+    mvt::TableC t(1, 4, "dcasgd", 2);
+    mvt::AddOptionC o0;
+    o0.worker_id = 0;
+    o0.learning_rate = 0.1f;
+    o0.lambda = 0.5f;
+    mvt::AddOptionC o1 = o0;
+    o1.worker_id = 1;
+    std::vector<float> d(4, 0.2f), out(4);
+    t.AddAll(d.data(), 4, o0);  // backup[0]=0 -> plain -0.2
+    t.GetAll(out.data(), 4);
+    for (float v : out) assert(std::fabs(v + 0.2f) < 1e-5f);
+    // worker 1's backup is stale (0): w2 = w1 - (0.2 + 5*0.04*(w1-0))
+    t.AddAll(d.data(), 4, o1);
+    t.GetAll(out.data(), 4);
+    for (float v : out) assert(std::fabs(v + 0.36f) < 1e-5f);
   }
   std::printf("updaters OK\n");
 }

@@ -91,6 +91,42 @@ class TestDispatch:
         SetCMDFlag("use_pallas", "auto")
         assert ops.use_pallas() == (jax.default_backend() == "tpu")
 
+    def test_chunk_shrinks_for_wide_rows(self):
+        from multiverso_tpu.ops.pallas_rows import (CHUNK, FUSED_BLOCKS,
+                                                    MIN_CHUNK, VMEM_BUDGET,
+                                                    _chunk_for)
+        assert _chunk_for(128, 4) == CHUNK
+        # chunk halves until the kernel's VMEM blocks fit the budget
+        wide = _chunk_for(8 * 1024, 4)
+        assert MIN_CHUNK <= wide < CHUNK
+        assert FUSED_BLOCKS * wide * 8 * 1024 * 4 <= VMEM_BUDGET
+        # gather/scatter hold fewer blocks -> deeper chunk for the same cols
+        assert _chunk_for(8 * 1024, 4, blocks=2) >= wide
+        assert _chunk_for(10 ** 9, 4) == 0  # infeasible even at MIN_CHUNK
+
+    def test_too_wide_rows_fall_back_to_xla(self):
+        from multiverso_tpu.ops.rows import _pallas_eligible
+        ok = jnp.zeros((4, 1024), jnp.float32)
+        assert _pallas_eligible(ok)
+        # wider than even MIN_CHUNK's blocks can fit -> XLA path
+        too_wide = jax.ShapeDtypeStruct((4, 1024 * 1024), jnp.float32)
+        assert not _pallas_eligible(too_wide)
+
+    def test_wide_rows_kernel_still_correct(self):
+        # cols wide enough to force a shrunken chunk (interpreter mode)
+        from multiverso_tpu.ops.pallas_rows import (_chunk_for,
+                                                    pallas_update_rows)
+        cols = 8 * 1024
+        assert 0 < _chunk_for(cols, 4) < 64
+        data = jnp.zeros((8, cols), jnp.float32)
+        ids = np.array([3, 6], np.int32)
+        deltas = jnp.ones((2, cols), jnp.float32)
+        out = pallas_update_rows(data, jnp.asarray(ids), deltas,
+                                 combine=lambda r, d: r + d, interpret=True)
+        host = np.asarray(out)
+        assert host[3].sum() == cols and host[6].sum() == cols
+        assert host[0].sum() == 0
+
 
 class TestMatrixTableWithPallas:
     """Full PS path through the Pallas kernels (interpret mode on CPU)."""

@@ -109,6 +109,44 @@ class TestArrayTable:
         expected = -rho / np.sqrt(1 + 1e-6)
         np.testing.assert_allclose(table.Get(), expected, rtol=1e-5)
 
+    def test_dcasgd_updater_delay_compensation(self):
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = mv.MV_CreateTable(
+                ArrayTableOption(size=4, updater_type="dcasgd"))
+            lr, lam = 0.1, 0.5
+            delta = np.full(4, 0.2, np.float32)  # lr-scaled gradient
+            opt0 = AddOption(worker_id=0, learning_rate=lr, lambda_=lam)
+            # push 1 (worker 0): w=0, backup[0]=0 -> plain -delta
+            table.Add(delta, opt0)
+            w1 = -0.2
+            np.testing.assert_allclose(table.Get(), w1, rtol=1e-5)
+            # push 2 (worker 1, stale backup=0): compensation term kicks in
+            opt1 = AddOption(worker_id=1, learning_rate=lr, lambda_=lam)
+            table.Add(delta, opt1)
+            w2 = w1 - (0.2 + (lam / lr) * 0.2 * 0.2 * (w1 - 0.0))
+            np.testing.assert_allclose(table.Get(), w2, rtol=1e-5)
+            # push 3 (worker 0 again): its backup is w1, not 0
+            table.Add(delta, opt0)
+            w3 = w2 - (0.2 + (lam / lr) * 0.2 * 0.2 * (w2 - w1))
+            np.testing.assert_allclose(table.Get(), w3, rtol=1e-5)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_dcasgd_matrix_rows(self, mv_env):
+        table = mv_env.MV_CreateTable(
+            MatrixTableOption(num_rows=16, num_cols=4,
+                              updater_type="dcasgd"))
+        opt = AddOption(worker_id=0, learning_rate=0.1, lambda_=0.5)
+        ids = np.array([2, 9, 14], np.int32)
+        deltas = np.full((3, 4), 0.2, np.float32)
+        table.AddRows(ids, deltas, opt)
+        got = table.GetRows(ids)
+        np.testing.assert_allclose(got, -0.2, rtol=1e-5)
+        untouched = table.GetRows(np.array([0, 5], np.int32))
+        np.testing.assert_allclose(untouched, 0.0)
+
     def test_store_load(self, mv_env, tmp_path):
         from multiverso_tpu.utils.io import StreamFactory
         from multiverso_tpu.zoo import Zoo
