@@ -54,6 +54,15 @@ ROW_FRACTION = 0.01
 ROUNDS = 100
 HOST_ROUNDS = 3
 
+# WordEmbedding secondary config (reference Applications/WordEmbedding:
+# skipgram + negative sampling + adagrad — the BASELINE.json north-star app)
+WE_VOCAB = 100_000
+WE_DIM = 128
+WE_PAIRS = 8192          # pair batch per step
+WE_NEG = 5
+WE_STAGED = 8            # staged batches scanned per rep
+WE_STEPS = 160
+
 INIT_TIMEOUT_S = 120
 
 
@@ -177,6 +186,72 @@ def bench_logreg(np, rng):
     return total / tpu_secs, total / cpu_secs
 
 
+def bench_wordembedding(np, rng):
+    """-> pairs/sec of the flagship skipgram+NEG+adagrad train step
+    (reference trainer logs words/thread/sec, trainer.cpp:45-49; a pair =
+    one (center, context) sample, the unit the hot loop processes)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from multiverso_tpu.models.wordembedding.model import (TrainState,
+                                                           make_train_step)
+
+    inputs = rng.integers(0, WE_VOCAB,
+                          (WE_STAGED, WE_PAIRS, 1)).astype(np.int32)
+    imask = np.ones((WE_STAGED, WE_PAIRS, 1), np.float32)
+    outputs = rng.integers(0, WE_VOCAB,
+                           (WE_STAGED, WE_PAIRS, 1 + WE_NEG)).astype(np.int32)
+    labels = np.broadcast_to(
+        np.concatenate([np.ones((1, 1), np.float32),
+                        np.zeros((1, WE_NEG), np.float32)], axis=1),
+        (WE_STAGED, WE_PAIRS, 1 + WE_NEG)).copy()
+    omask = np.ones_like(labels)
+
+    step = make_train_step(use_adagrad=True)
+
+    @jax.jit
+    def epoch(state, inputs, imask, outputs, labels, omask):
+        def body(state, x):
+            i, im, o, lb, om = x
+            state, loss = step(state, i, im, o, lb, om, jnp.float32(0.025))
+            return state, loss
+        reps = WE_STEPS // WE_STAGED
+        def rep(state, _):
+            return lax.scan(body, state, (inputs, imask, outputs, labels,
+                                          omask))
+        return lax.scan(rep, state, None, length=reps)
+
+    @jax.jit
+    def fresh_state():
+        # device-side init: the tunnel to the chip is slow (~25MB/s), so a
+        # host-built 51MB embedding upload would dominate the timing
+        key = jax.random.PRNGKey(1)
+        ie = ((jax.random.uniform(key, (WE_VOCAB, WE_DIM), jnp.float32)
+               - 0.5) / WE_DIM)
+        return TrainState(
+            ie=ie, eo=jnp.zeros((WE_VOCAB, WE_DIM), jnp.float32),
+            ie_g2=jnp.zeros((WE_VOCAB, WE_DIM), jnp.float32),
+            eo_g2=jnp.zeros((WE_VOCAB, WE_DIM), jnp.float32))
+
+    args = [jax.device_put(a) for a in (inputs, imask, outputs, labels,
+                                        omask)]
+    state, losses = epoch(fresh_state(), *args)
+    first, final = float(losses[0, 0]), float(losses[-1, -1])
+    if not (np.isfinite(final) and final < first):
+        _fail("we_train_throughput",
+              f"loss did not decrease: {first} -> {final}", "pairs/s")
+    secs = float("inf")
+    for _ in range(3):   # min-of-3 (see logreg comment)
+        s0 = fresh_state()
+        float(s0.ie[0, 0])   # forced fetch: init lands before the clock
+        t0 = time.perf_counter()
+        _, losses = epoch(s0, *args)
+        float(losses[-1, -1])  # forced fetch = sync
+        secs = min(secs, time.perf_counter() - t0)
+    return WE_STEPS * WE_PAIRS / secs
+
+
 def bench_matrix_table(np, rng):
     """-> (device_Melem_s, host_Melem_s, numpy_Melem_s)."""
     import jax
@@ -270,6 +345,7 @@ def main() -> int:
     import numpy as np
     rng = np.random.default_rng(0)
     tpu_sps, cpu_sps = bench_logreg(np, rng)
+    we_pps = bench_wordembedding(np, rng)
     dev_me, host_me, base_me = bench_matrix_table(np, rng)
     print(json.dumps({
         "metric": "logreg_train_samples_per_sec",
@@ -285,6 +361,9 @@ def main() -> int:
         "matrix_table_numpy_baseline_Melem_s": round(base_me, 1),
         "matrix_config": f"{N_ROWS}x{N_COLS} f32, {ROW_FRACTION:.0%} "
                          f"rows/op, {ROUNDS} rounds",
+        "we_pairs_per_sec": round(we_pps),
+        "we_config": f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
+                     f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad",
     }))
     return 0
 

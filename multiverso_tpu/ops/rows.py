@@ -46,10 +46,10 @@ def _pallas_eligible(data) -> bool:
 def use_pallas(data=None) -> bool:
     mode = str(GetFlag("use_pallas")).lower()
     if mode == "on":
-        # forced on: always in interpreter mode (tests); on a real TPU still
-        # respect the lowering constraint — an ineligible shape would be a
-        # Mosaic compile error, not a kernel choice
-        return _interpret() or data is None or _pallas_eligible(data)
+        # forced on (interpreter mode off-TPU; tests): still respect the
+        # lowering constraints — an ineligible shape would be a Mosaic
+        # compile error (or a zero chunk) rather than a kernel choice
+        return data is None or _pallas_eligible(data)
     if mode == "off":
         return False
     return (jax.default_backend() == "tpu"

@@ -33,6 +33,7 @@ contract for the others.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
@@ -49,6 +50,28 @@ from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
 from multiverso_tpu.utils.log import CHECK
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _pad_row_batch(ids: jax.Array, deltas: jax.Array, bucket: int):
+    """Pad an exact-size (ids, deltas) batch to its power-of-two bucket ON
+    DEVICE (pad lane = -1 -> trash row, pad delta = 0). The host sends
+    exact-size arrays — host->device wire bytes are what the protocol pays
+    for (the reference likewise ships only the partitioned row payloads,
+    matrix_table.cpp:235-296) — and this tiny jitted pad (one compile per
+    distinct batch size) expands to the handful of shapes the big row
+    program is compiled for."""
+    pad = bucket - ids.shape[0]
+    ids = jnp.concatenate([ids, jnp.full((pad,), -1, ids.dtype)])
+    deltas = jnp.concatenate(
+        [deltas, jnp.zeros((pad, deltas.shape[1]), deltas.dtype)])
+    return ids, deltas
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _pad_id_batch(ids: jax.Array, bucket: int):
+    pad = bucket - ids.shape[0]
+    return jnp.concatenate([ids, jnp.full((pad,), -1, ids.dtype)])
 
 
 @dataclass
@@ -287,11 +310,10 @@ class MatrixServerTable(ServerTable):
         deltas = np.asarray(values, self.dtype).reshape(len(ids), self.num_cols)
         self._check_ids(ids)
         ids, deltas = self._combine_duplicates(ids, deltas)
-        padded_ids = self._pad_ids(ids)
-        padded_deltas = np.zeros((len(padded_ids), self.num_cols), self.dtype)
-        padded_deltas[: len(ids)] = deltas
-        self.state = self._update_rows(self.state, jnp.asarray(padded_ids),
-                                       jnp.asarray(padded_deltas),
+        # ship exact-size arrays; pad to the bucket on device (_pad_row_batch)
+        padded_ids, padded_deltas = _pad_row_batch(
+            jnp.asarray(ids), jnp.asarray(deltas), next_bucket(len(ids)))
+        self.state = self._update_rows(self.state, padded_ids, padded_deltas,
                                        option.as_jnp())
 
     def ProcessGet(self, option: GetOption,
@@ -302,10 +324,12 @@ class MatrixServerTable(ServerTable):
             return self._from_storage(np.asarray(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
-        padded_ids = self._pad_ids(ids)
+        padded_ids = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
         rows = self._gather_rows(self.state["data"], self.state["aux"],
-                                 jnp.asarray(padded_ids))
-        return np.asarray(rows)[: len(ids)]
+                                 padded_ids)
+        # device-slice the pad off BEFORE fetching: only the requested rows
+        # cross the (slow) host<->device link
+        return np.asarray(rows[: len(ids)])
 
     def raw(self) -> np.ndarray:
         """Logical-view snapshot (host numpy)."""
