@@ -50,6 +50,12 @@ class Configure:
     updater_type: str = "default"    # default / sgd / ftrl
     objective_type: str = "default"  # default / sigmoid / softmax / ftrl
     regular_type: str = "default"    # default / L1 / L2
+    # TPU-native extension (no reference counterpart): dtype the dense
+    # objective's matmuls run in. "bfloat16" feeds the MXU at its native
+    # width and halves data-side HBM traffic; weights, gradients, and the
+    # loss stay float32 (mixed precision), so training trajectories track
+    # the float32 ones to bf16 rounding.
+    compute_type: str = "float32"    # float32 / bfloat16
 
     @classmethod
     def from_file(cls, config_file: str) -> "Configure":
@@ -88,3 +94,7 @@ class Configure:
             # (reference updater.cpp:106-108, ftrl uses sparse entries)
             self.updater_type = "ftrl"
             self.sparse = True
+        if self.compute_type not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_type={self.compute_type!r}: must be 'float32' or "
+                "'bfloat16'")

@@ -68,17 +68,27 @@ def make_dense_grad_fn(config) -> Callable:
     act_fn = _activation(config.objective_type)
     reg_fn = _regular_grad(config.regular_type, config.regular_coef)
     out = config.output_size
+    # mixed precision (Configure.compute_type): matmuls in cdt, everything
+    # else float32. All casts are no-ops at the default float32.
+    cdt = jnp.dtype(getattr(config, "compute_type", "float32"))
 
     @jax.jit
     def grad_fn(W, X, labels, weights):
-        logits = X @ W                                    # (B, out) on MXU
+        # bf16 inputs on the MXU, f32 accumulate AND f32 output
+        # (preferred_element_type — a bf16-out dot would round the result
+        # tile to 8 mantissa bits before any upcast could recover it)
+        Xc = X.astype(cdt)
+        logits = jnp.matmul(Xc, W.astype(cdt),
+                            preferred_element_type=jnp.float32)  # (B, out)
         act = act_fn(logits)
         onehot = (jax.nn.one_hot(labels, out, dtype=act.dtype) if out > 1
                   else (labels == 1).astype(act.dtype)[:, None])
         loss = _loss_metric(act, onehot, weights, out)
         diff = (act - onehot) * weights[:, None]
         count = jnp.maximum(jnp.sum(weights > 0), 1).astype(act.dtype)
-        grad = (X.T @ diff) / count + reg_fn(W)
+        grad = jnp.matmul(Xc.T, diff.astype(cdt),
+                          preferred_element_type=jnp.float32) / count \
+            + reg_fn(W)
         return grad, loss
 
     return grad_fn

@@ -78,6 +78,22 @@ class TestLocalDense:
         assert os.path.exists(cfg.output_model_file)
         assert os.path.exists(cfg.output_file)
 
+    def test_bfloat16_compute_tracks_float32(self, dense_binary):
+        """compute_type=bfloat16 (mixed precision) must learn like f32:
+        same data, both reach high accuracy and nearby weights."""
+        weights = {}
+        for ct in ("float32", "bfloat16"):
+            cfg = _config(dense_binary, input_size=8, output_size=1,
+                          objective_type="sigmoid", updater_type="sgd",
+                          learning_rate=0.5, train_epoch=5)
+            cfg.compute_type = ct
+            lr = LogReg(cfg)
+            lr.Train()
+            assert lr.Test() > 0.9
+            weights[ct] = lr.model.weights().copy()
+        np.testing.assert_allclose(weights["bfloat16"], weights["float32"],
+                                   rtol=0.15, atol=0.05)
+
     def test_softmax_multiclass(self, tmp_path):
         rng = np.random.default_rng(2)
         centers = np.array([[2, 0, 0], [0, 2, 0], [0, 0, 2]], np.float32)
