@@ -167,6 +167,53 @@ class TestArrayTable:
         assert offs == partition_offsets(100, 4)
 
 
+class TestUserExtensibleTable:
+    """The reference proves its table interface is user-extensible by the LR
+    app defining its own WorkerTable/ServerTable subclasses
+    (Applications/LogisticRegression/src/util/sparse_table.h, SURVEY.md
+    §2f). Same proof here: a custom max-merge table wired through
+    CreateTable runs over the real engine with Waiter semantics intact."""
+
+    def test_custom_table_through_engine(self, mv_env):
+        from dataclasses import dataclass
+
+        from multiverso_tpu.tables.base import (ServerTable, TableOption,
+                                                WorkerTable)
+
+        class MaxServerTable(ServerTable):
+            def __init__(self, size):
+                self.data = np.full(size, -np.inf, np.float32)
+
+            def ProcessAdd(self, values, option):
+                self.data = np.maximum(self.data, values)
+
+            def ProcessGet(self, option):
+                return self.data.copy()
+
+        class MaxWorkerTable(WorkerTable):
+            def Push(self, values):
+                return self.Wait(self.AddAsync(
+                    {"values": np.asarray(values, np.float32)}))
+
+            def Pull(self):
+                return self.Wait(self.GetAsync({}))
+
+        @dataclass
+        class MaxTableOption(TableOption):
+            size: int = 0
+
+            def make_server(self, zoo):
+                return MaxServerTable(self.size)
+
+            def make_worker(self, zoo):
+                return MaxWorkerTable()
+
+        table = mv_env.MV_CreateTable(MaxTableOption(size=4))
+        table.Push([1.0, 5.0, -2.0, 0.0])
+        table.Push([3.0, 4.0, -7.0, 1.0])
+        np.testing.assert_allclose(table.Pull(), [3.0, 5.0, -2.0, 1.0])
+
+
 class TestMatrixTable:
     def test_whole_add_get(self, mv_env):
         table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=20, num_cols=5))
