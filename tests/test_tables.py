@@ -33,6 +33,22 @@ class TestPartitionMath:
         offs = partition_offsets(3, 8)
         assert sum(c for _, c in offs) == 3
 
+    def test_next_bucket_ladder(self):
+        from multiverso_tpu.parallel.mesh import next_bucket
+        # powers of two up to 256
+        assert next_bucket(1) == 8
+        assert next_bucket(9) == 16
+        assert next_bucket(256) == 256
+        # quarter-octave rungs above 256: waste <= 25%, 64-aligned
+        assert next_bucket(257) == 320
+        assert next_bucket(10_000) == 10_240
+        assert next_bucket(16_384) == 16_384
+        for n in (300, 1000, 5000, 10_000, 100_000, 123_457):
+            b = next_bucket(n)
+            assert b >= n and (b - n) <= n // 4 + 8
+            if b > 256:
+                assert b % 64 == 0
+
     def test_row_partition(self):
         # row -> server = row / (num_rows/num_servers), tail clamped
         # (reference matrix_table.cpp:24-46)
