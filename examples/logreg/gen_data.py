@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Generate synthetic MNIST-shaped data for the logreg example.
+
+The reference example downloads MNIST and converts it to the dense text
+format (reference Applications/LogisticRegression/example/convert.py);
+this environment has no network, so we synthesize a linearly-separable
+10-class problem of the same shape (784 features) instead. The config
+file is the reference's mnist.config, parsed unchanged by
+multiverso_tpu.models.logreg.configure.
+"""
+import numpy as np
+
+FEATURES, CLASSES = 784, 10
+
+
+def write(path, n, rng, centers):
+    y = rng.integers(0, CLASSES, n)
+    X = (centers[y] + rng.standard_normal((n, FEATURES)) * 0.35).astype(
+        np.float32)
+    with open(path, "w") as f:
+        for label, row in zip(y, X):
+            f.write(f"{label} " + " ".join(f"{v:.4f}" for v in row) + "\n")
+
+
+def main():
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((CLASSES, FEATURES)).astype(np.float32)
+    write("train.data", 6000, rng, centers)
+    write("test.data", 1000, rng, centers)
+    print("wrote train.data (6000) and test.data (1000)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Mirror of the reference example runner
+# (Applications/LogisticRegression/example/run.sh): generate data, train,
+# report accuracy. Run from this directory.
+set -e
+python gen_data.py
+python -m multiverso_tpu.models.logreg.main mnist.config

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Generate a small synthetic corpus with Zipf-distributed vocabulary and
+local co-occurrence structure (words from the same topic cluster appear
+together), so the example produces embeddings where cluster-mates are
+nearest neighbours."""
+import numpy as np
+
+VOCAB, TOPICS, SENTS, SENT_LEN = 2000, 20, 20000, 12
+
+
+def main():
+    rng = np.random.default_rng(0)
+    topic_of = rng.integers(0, TOPICS, VOCAB)
+    by_topic = [np.where(topic_of == t)[0] for t in range(TOPICS)]
+    zipf = 1.0 / np.arange(1, VOCAB + 1)
+    with open("corpus.txt", "w") as f:
+        for _ in range(SENTS):
+            t = rng.integers(0, TOPICS)
+            pool = by_topic[t]
+            w = zipf[pool] / zipf[pool].sum()
+            words = rng.choice(pool, SENT_LEN, p=w)
+            f.write(" ".join(f"w{i}" for i in words) + "\n")
+    print(f"wrote corpus.txt ({SENTS} sentences)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Mirror of the reference example runner
+# (Applications/WordEmbedding/example/run.bat): build a corpus, train
+# skip-gram + negative sampling, write word2vec-format vectors.
+# Run from this directory. Flags are word2vec-style (reference util.h:20-44).
+set -e
+python gen_corpus.py
+python -m multiverso_tpu.models.wordembedding.distributed \
+    -train_file corpus.txt -output vectors.txt \
+    -size 64 -epoch 3 -negative 5 -min_count 1 \
+    -data_block_size 100000 -is_pipeline 1
