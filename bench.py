@@ -54,6 +54,12 @@ ROW_FRACTION = 0.01
 ROUNDS = 100
 HOST_ROUNDS = 3
 
+# KVTable sparse push-pull config (BASELINE.json config matrix: "KVTable
+# sparse push-pull (hashed int64->float parameter shards)")
+KV_KEYSPACE = 2_000_000
+KV_BATCH = 100_000
+KV_ROUNDS = 5
+
 # WordEmbedding secondary config (reference Applications/WordEmbedding:
 # skipgram + negative sampling + adagrad — the BASELINE.json north-star app)
 WE_VOCAB = 100_000
@@ -187,6 +193,32 @@ def bench_logreg(np, rng):
 
     total = LR_STEPS * LR_BATCH
     return total / tpu_secs, total / cpu_secs
+
+
+def bench_kv_table(np, rng):
+    """-> Melem/s of KV sparse push-pull through the blocking protocol verbs
+    (BASELINE config matrix; reference kv_table.h has no published number —
+    its server Add is an unordered_map '+=' loop)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import KVTableOption
+
+    mv.MV_Init([])
+    try:
+        kv = mv.MV_CreateTable(KVTableOption(init_capacity=KV_KEYSPACE))
+        keys_all = [rng.choice(KV_KEYSPACE, KV_BATCH,
+                               replace=False).astype(np.int64)
+                    for _ in range(KV_ROUNDS)]
+        vals = np.ones(KV_BATCH, np.float32)
+        kv.Add(keys_all[0], vals)   # warm (slot creation + compiles)
+        kv.Get(keys_all[0])
+        t0 = time.perf_counter()
+        for keys in keys_all:
+            kv.Add(keys, vals)      # mix of new + existing keys
+            kv.Get(keys)
+        secs = time.perf_counter() - t0
+    finally:
+        mv.MV_ShutDown()
+    return 2 * KV_ROUNDS * KV_BATCH / secs / 1e6
 
 
 def bench_wordembedding(np, rng):
@@ -350,6 +382,7 @@ def main() -> int:
     tpu_sps, cpu_sps = bench_logreg(np, rng)
     we_pps = bench_wordembedding(np, rng)
     dev_me, host_me, base_me = bench_matrix_table(np, rng)
+    kv_me = bench_kv_table(np, rng)
     print(json.dumps({
         "metric": "logreg_train_samples_per_sec",
         "value": round(tpu_sps),
@@ -368,6 +401,9 @@ def main() -> int:
         "we_pairs_per_sec": round(we_pps),
         "we_config": f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
                      f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad",
+        "kv_push_pull_Melem_s": round(kv_me, 1),
+        "kv_config": f"int64 keys, {KV_KEYSPACE} keyspace, "
+                     f"{KV_BATCH}/op, {KV_ROUNDS} rounds",
     }))
     return 0
 

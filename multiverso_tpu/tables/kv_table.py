@@ -57,6 +57,14 @@ class KVServerTable(ServerTable):
         self.capacity = pad_to_multiple(max(init_capacity, _MIN_BUCKET),
                                         ctx.num_servers)
         self._index: Dict[int, int] = {}
+        # vectorized lookup: sorted key/slot arrays serve bulk searchsorted
+        # lookups; keys inserted since the last rebuild live in ``_pending``
+        # (consulted only for searchsorted misses), and the sorted arrays
+        # rebuild when pending grows past a fraction of the index — so a
+        # trickle of new keys never triggers whole-index rebuilds
+        self._sorted_keys = np.empty(0, np.int64)
+        self._sorted_slots = np.empty(0, np.int32)
+        self._pending: Dict[int, int] = {}
         # 64-bit dtypes (e.g. the WordEmbedding int64 word-count table,
         # reference communicator.cpp:17-33) stay host-resident: jax truncates
         # them to 32 bits without global x64 mode, and scalar counters are
@@ -90,20 +98,57 @@ class KVServerTable(ServerTable):
 
     # -- slot management ----------------------------------------------------
 
+    def _rebuild_lookup(self) -> None:
+        n = len(self._index)
+        ks = np.fromiter(self._index.keys(), np.int64, n)
+        vs = np.fromiter(self._index.values(), np.int32, n)
+        order = np.argsort(ks, kind="stable")
+        self._sorted_keys = ks[order]
+        self._sorted_slots = vs[order]
+        self._pending = {}
+
+    def _bulk_lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized key -> slot (-1 = absent): searchsorted against the
+        sorted cache, misses patched from the small pending dict."""
+        if len(self._sorted_keys):
+            pos = np.searchsorted(self._sorted_keys, keys)
+            pos_c = np.minimum(pos, len(self._sorted_keys) - 1)
+            hit = self._sorted_keys[pos_c] == keys
+            slots = np.where(hit, self._sorted_slots[pos_c],
+                             -1).astype(np.int32)
+        else:
+            slots = np.full(len(keys), -1, np.int32)
+        if self._pending:
+            pend = self._pending
+            for i in np.nonzero(slots < 0)[0]:
+                s = pend.get(int(keys[i]))
+                if s is not None:
+                    slots[i] = s
+        return slots
+
     def _slots_for(self, keys: np.ndarray, create: bool) -> np.ndarray:
-        slots = np.empty(len(keys), np.int32)
-        for i, k in enumerate(keys):
-            k = int(k)
-            slot = self._index.get(k)
-            if slot is None:
-                if not create:
-                    slot = -1  # read of absent key -> trash slot semantics
-                else:
-                    slot = len(self._index)
-                    self._index[k] = slot
-            slots[i] = slot
-        if create and len(self._index) >= self.capacity:
-            self._grow(len(self._index))
+        slots = self._bulk_lookup(keys)
+        if create:
+            miss = slots < 0
+            if miss.any():
+                # python loop only over NEW keys (first sight of a key;
+                # steady-state batches take the vectorized path above).
+                # Duplicates of a new key inside one batch must share a slot.
+                for i in np.nonzero(miss)[0]:
+                    k = int(keys[i])
+                    slot = self._index.get(k)
+                    if slot is None:
+                        slot = len(self._index)
+                        self._index[k] = slot
+                        self._pending[k] = slot
+                    slots[i] = slot
+                # amortized rebuild: only once pending outgrows ~1/8 of the
+                # index does the sorted cache re-sort (a key trickle never
+                # pays O(N log N) per batch)
+                if len(self._pending) > max(1024, len(self._index) // 8):
+                    self._rebuild_lookup()
+            if len(self._index) >= self.capacity:
+                self._grow(len(self._index))
         return slots
 
     def _grow(self, needed: int) -> None:
@@ -177,6 +222,7 @@ class KVServerTable(ServerTable):
         keys = np.frombuffer(stream.Read(n * 8), np.int64)
         vals = np.frombuffer(stream.Read(n * self.dtype.itemsize), self.dtype)
         self._index = {int(k): i for i, k in enumerate(keys)}
+        self._rebuild_lookup()
         ctx = self._zoo.mesh_ctx
         if n >= self.capacity:
             self.capacity = pad_to_multiple(max(n + 1, _MIN_BUCKET),
@@ -200,8 +246,7 @@ class KVWorkerTable(WorkerTable):
     def Get(self, keys, option: Optional[GetOption] = None) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
         vals = self.Wait(self.GetAsync({"keys": keys}, option))
-        for k, v in zip(keys, vals):
-            self._cache[int(k)] = v
+        self._cache.update(zip(keys.tolist(), vals.tolist()))
         return vals
 
     def Add(self, keys, values, option: Optional[AddOption] = None) -> None:
