@@ -127,17 +127,50 @@ def _read_table(stream: Stream, table) -> None:
         replace, table.state["aux"])
 
 
+def _quiesce(zoo) -> None:
+    """Drain the engine mailbox, then (multihost) barrier: no in-flight
+    async Add may still be issuing collectives on any process's engine
+    thread when checkpoint fetches start issuing theirs on the caller
+    thread — interleaved collectives across threads would mismatch across
+    processes. Also makes the checkpoint consistent with every Add
+    enqueued before the call, single-process included. Concurrent Adds
+    *during* a checkpoint violate the collective contract (don't)."""
+    from multiverso_tpu.parallel import multihost
+    zoo.DrainServer()
+    multihost.host_barrier("mv_checkpoint_quiesce")
+
+
+def _write_all(stream: Stream, tables) -> None:
+    stream.WriteStr(_MAGIC)
+    stream.WriteInt(len(tables))
+    for table_id, table in enumerate(tables):
+        _write_table(stream, table_id, table)
+
+
 def save_checkpoint(uri: str, zoo=None) -> int:
     """Store every registered server table (+ updater aux) to ``uri``.
-    Returns the number of tables written."""
+    Returns the number of tables written.
+
+    Collective in a multi-process job: every process serializes (the
+    device->host fetches of sharded stores are collective), but only
+    process 0 streams to the file — the reference's rank-0-saves
+    convention (distributed_wordembedding.cpp:263-306) — and a barrier
+    makes the file complete before anyone proceeds. ``uri`` must name
+    shared storage for a later multi-process load."""
+    from multiverso_tpu.parallel import multihost
     from multiverso_tpu.zoo import Zoo
     zoo = zoo or Zoo.Get()
     tables = zoo.server_tables
-    with StreamFactory.GetStream(uri, "w") as stream:
-        stream.WriteStr(_MAGIC)
-        stream.WriteInt(len(tables))
-        for table_id, table in enumerate(tables):
-            _write_table(stream, table_id, table)
+    _quiesce(zoo)
+    if multihost.process_index() == 0:
+        # stream straight to storage: O(largest frame) host memory
+        with StreamFactory.GetStream(uri, "w") as stream:
+            _write_all(stream, tables)
+    else:
+        # non-zero ranks serialize into a throwaway sink purely to drive
+        # their half of the collective fetches
+        _write_all(Stream(_io.BytesIO(), uri), tables)
+    multihost.host_barrier("mv_checkpoint_save")
     Log.Info("checkpoint: saved %d tables to %s", len(tables), uri)
     return len(tables)
 
@@ -149,6 +182,7 @@ def load_checkpoint(uri: str, zoo=None) -> int:
     from multiverso_tpu.zoo import Zoo
     zoo = zoo or Zoo.Get()
     tables = zoo.server_tables
+    _quiesce(zoo)
     with StreamFactory.GetStream(uri, "r") as stream:
         CHECK(stream.ReadStr() == _MAGIC, "not a multiverso_tpu checkpoint")
         n = stream.ReadInt()

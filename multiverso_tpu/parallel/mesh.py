@@ -142,3 +142,19 @@ class MeshContext:
     def place(self, array, sharding: NamedSharding):
         """Host -> HBM placement with an explicit layout."""
         return jax.device_put(array, sharding)
+
+    def fetch(self, arr) -> np.ndarray:
+        """Device -> host of a possibly globally-sharded array.
+
+        Single-process (and fully-replicated) arrays fetch directly. In a
+        multi-process job a shard-spanning array lives partly on
+        non-addressable devices — reassemble the global value by
+        allgathering every process's local shards. That makes this a
+        COLLECTIVE in multihost mode, which the table layer's collective
+        contract already guarantees (parallel/multihost.py docstring)."""
+        if not isinstance(arr, jax.Array):
+            return np.asarray(arr)
+        if arr.is_fully_addressable or arr.is_fully_replicated:
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

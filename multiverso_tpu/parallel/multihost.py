@@ -173,6 +173,58 @@ def host_allgather_bytes(data: bytes) -> list:
             for i in range(process_count())]
 
 
+def host_allgather_objects(obj) -> list:
+    """Every process's picklable object, ordered by process index
+    (collective; single-process: ``[obj]``). Used by the table layer to
+    merge per-process host-plane payloads — e.g. each process's row-id/delta
+    batch of one logical Add — so reference PS semantics (every worker's
+    Add accumulates, whichever process it ran on) hold across hosts."""
+    if process_count() <= 1:
+        return [obj]
+    import pickle
+    blobs = host_allgather_bytes(pickle.dumps(obj))
+    return [pickle.loads(b) for b in blobs]
+
+
+def merge_collective_add(option, *arrays) -> tuple:
+    """Merge every process's payload of one collective row/key Add:
+    allgathers ``(arrays..., option)``, CHECKs the option agrees on every
+    process (divergent option scalars — worker_id, lr, momentum — would
+    feed different jit'd updates into the same globally-sharded state and
+    silently corrupt it), and returns per-position concatenations in
+    process order. Identity single-process."""
+    if process_count() <= 1:
+        return arrays
+    parts = host_allgather_objects((arrays, option))
+    opts = [p[1] for p in parts]
+    CHECK(all(o == opts[0] for o in opts),
+          f"collective Add options diverge across processes: {opts}")
+    return tuple(np.concatenate([p[0][i] for p in parts])
+                 for i in range(len(arrays)))
+
+
+def sum_collective_add(option, values: np.ndarray) -> np.ndarray:
+    """Sum every process's delta of one collective whole-table Add (same
+    option agreement CHECK as merge_collective_add). Identity
+    single-process."""
+    if process_count() <= 1:
+        return values
+    parts = host_allgather_objects((values, option))
+    opts = [p[1] for p in parts]
+    CHECK(all(o == opts[0] for o in opts),
+          f"collective Add options diverge across processes: {opts}")
+    return np.sum([p[0] for p in parts], axis=0).astype(values.dtype)
+
+
+def union_collective_ids(ids: np.ndarray) -> Optional[np.ndarray]:
+    """Sorted union of every process's id/key set of one collective Get —
+    the one identical set all processes gather so their device programs
+    match. None single-process (caller keeps its local fast path)."""
+    if process_count() <= 1:
+        return None
+    return np.unique(np.concatenate(host_allgather_objects(ids)))
+
+
 def broadcast_from_master(data: np.ndarray) -> np.ndarray:
     """Host 0's value to everyone (identity single-process). Collective."""
     if process_count() <= 1:

@@ -90,6 +90,10 @@ class Server(Actor):
         self.RegisterHandler(MsgType.Request_Get, self.ProcessGet)
         self.RegisterHandler(MsgType.Request_Add, self.ProcessAdd)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
+        # barrier ping: replies once the mailbox drained up to this point —
+        # must NOT touch the BSP clocks, unlike FinishTrain (native
+        # ServerC registers the same handler, native/src/store.cc)
+        self.RegisterHandler(MsgType.Request_Barrier, lambda m: m.reply(None))
 
     def RegisterTable(self, server_table) -> int:
         table_id = len(self.store_)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import pad_to_multiple, partition_offsets
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
@@ -94,6 +95,11 @@ class ArrayServer(ServerTable):
     def ProcessAdd(self, values: np.ndarray, option: AddOption) -> None:
         values = np.asarray(values, self.dtype).ravel()
         CHECK(values.size == self.size, "Add size mismatch")
+        # multihost: one logical Add is issued collectively by every
+        # process; summing the per-process deltas first gives the reference
+        # semantics (every worker's Add accumulates, src/server.cpp:48-58)
+        # — identity in a single-process job
+        values = multihost.sum_collective_add(option, values)
         if self.padded != self.size:
             values = np.pad(values, (0, self.padded - self.size))
         delta = self._zoo.mesh_ctx.place(values, self._sharding)
@@ -101,7 +107,7 @@ class ArrayServer(ServerTable):
 
     def ProcessGet(self, option: GetOption) -> np.ndarray:
         out = self._access(self.state, None)
-        return np.asarray(out)[: self.size]
+        return self._zoo.mesh_ctx.fetch(out)[: self.size]
 
     def raw(self) -> jax.Array:
         """The live sharded device array (padded)."""
@@ -111,7 +117,7 @@ class ArrayServer(ServerTable):
 
     def Store(self, stream) -> None:
         stream.WriteInt(self.size)
-        data = np.asarray(self.state["data"])[: self.size]
+        data = self._zoo.mesh_ctx.fetch(self.state["data"])[: self.size]
         stream.Write(data.tobytes())
 
     def Load(self, stream) -> None:
@@ -129,7 +135,7 @@ class ArrayServer(ServerTable):
 
     def aux_to_logical(self, leaf) -> np.ndarray:
         """Strip padding: last axis padded -> logical size."""
-        return np.asarray(leaf)[..., : self.size]
+        return self._zoo.mesh_ctx.fetch(leaf)[..., : self.size]
 
     def aux_from_logical(self, arr: np.ndarray) -> np.ndarray:
         pad = self.padded - self.size

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import next_bucket, pad_to_multiple
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, GetOption
@@ -158,7 +159,8 @@ class KVServerTable(ServerTable):
         ctx = self._zoo.mesh_ctx
         new_cap = pad_to_multiple(new_cap, ctx.num_servers)
         host = np.zeros(new_cap, self.dtype)
-        host[: self.capacity] = np.asarray(self._values)
+        host[: self.capacity] = (self._values if self._host_backed
+                                 else ctx.fetch(self._values))
         self.capacity = new_cap
         if self._host_backed:
             self._values = host
@@ -181,6 +183,11 @@ class KVServerTable(ServerTable):
         keys = np.asarray(keys, np.int64).ravel()
         deltas = np.asarray(values, self.dtype).ravel()
         CHECK(keys.size == deltas.size, "kv add size mismatch")
+        # multihost: merge every process's (keys, values) of this
+        # collective Add — concatenation order is process order, so slot
+        # creation (and therefore the whole index) evolves identically on
+        # all hosts (identity single-process)
+        keys, deltas = multihost.merge_collective_add(option, keys, deltas)
         slots = self._slots_for(keys, create=True)
         padded = self._pad_slots(slots)
         pad_deltas = np.zeros(len(padded), self.dtype)
@@ -194,10 +201,25 @@ class KVServerTable(ServerTable):
     def ProcessGet(self, keys: np.ndarray,
                    option: Optional[GetOption] = None) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
+        union = (multihost.union_collective_ids(keys)
+                 if not self._host_backed else None)
+        if union is not None:
+            # collective Get over possibly different key sets: gather the
+            # union with one identical device program, slice ours out
+            union_slots = self._slots_for(union, create=False)
+            padded = self._pad_slots(union_slots)
+            vals = self._zoo.mesh_ctx.fetch(
+                self._gather(self._values, jnp.asarray(padded)))
+            u_out = vals[: len(union_slots)].copy()
+            u_out[union_slots < 0] = 0
+            return u_out[np.searchsorted(union, keys)]
         slots = self._slots_for(keys, create=False)
         padded = self._pad_slots(slots)
-        vals = np.asarray(self._gather(
-            self._values, padded if self._host_backed else jnp.asarray(padded)))
+        if self._host_backed:
+            vals = self._gather(self._values, padded)
+        else:
+            vals = self._zoo.mesh_ctx.fetch(
+                self._gather(self._values, jnp.asarray(padded)))
         out = vals[: len(slots)].copy()
         out[slots < 0] = 0  # absent keys read as default-constructed (0)
         return out
@@ -211,8 +233,12 @@ class KVServerTable(ServerTable):
     def Store(self, stream) -> None:
         keys = np.fromiter(self._index.keys(), np.int64, len(self._index))
         slots = np.fromiter(self._index.values(), np.int64, len(self._index))
-        vals = np.asarray(self._values)[slots] if len(self._index) else \
-            np.empty(0, self.dtype)
+        if len(self._index):
+            host_vals = (self._values if self._host_backed
+                         else self._zoo.mesh_ctx.fetch(self._values))
+            vals = host_vals[slots]
+        else:
+            vals = np.empty(0, self.dtype)
         stream.WriteInt(len(keys))
         stream.Write(keys.tobytes())
         stream.Write(vals.tobytes())

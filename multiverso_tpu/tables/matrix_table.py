@@ -44,6 +44,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from multiverso_tpu import ops
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
                                           next_bucket,
                                           storage_partition_server)
@@ -302,6 +303,9 @@ class MatrixServerTable(ServerTable):
         if row_ids is None:
             values = np.asarray(values, self.dtype).reshape(self.num_rows,
                                                             self.num_cols)
+            # multihost: sum the per-process deltas of this collective Add
+            # (reference semantics — every worker's Add accumulates)
+            values = multihost.sum_collective_add(option, values)
             delta = self._zoo.mesh_ctx.place(self._to_storage(values),
                                              self._sharding)
             self.state = self._update_full(self.state, delta, option.as_jnp())
@@ -309,6 +313,11 @@ class MatrixServerTable(ServerTable):
         ids = np.asarray(row_ids, np.int32).ravel()
         deltas = np.asarray(values, self.dtype).reshape(len(ids), self.num_cols)
         self._check_ids(ids)
+        # multihost: merge every process's (ids, deltas) batch of this
+        # collective Add — each process may push different rows; after the
+        # merge all processes issue identical device programs over
+        # identical data (identity single-process)
+        ids, deltas = multihost.merge_collective_add(option, ids, deltas)
         ids, deltas = self._combine_duplicates(ids, deltas)
         # ship exact-size arrays; pad to the bucket on device (_pad_row_batch)
         padded_ids, padded_deltas = _pad_row_batch(
@@ -321,26 +330,38 @@ class MatrixServerTable(ServerTable):
         if row_ids is None:
             data = self.updater.access(self.state["data"], self.state["aux"],
                                        None)
-            return self._from_storage(np.asarray(data))
+            return self._from_storage(self._zoo.mesh_ctx.fetch(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        union = multihost.union_collective_ids(ids)
+        if union is not None:
+            # each process may request different rows of this collective
+            # Get: gather the union with one identical program everywhere,
+            # then slice this process's rows out of the union result
+            union = union.astype(np.int32)
+            padded_ids = _pad_id_batch(jnp.asarray(union),
+                                       next_bucket(len(union)))
+            rows = self._gather_rows(self.state["data"], self.state["aux"],
+                                     padded_ids)
+            host_rows = self._zoo.mesh_ctx.fetch(rows[: len(union)])
+            return host_rows[np.searchsorted(union, ids)]
         padded_ids = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
         rows = self._gather_rows(self.state["data"], self.state["aux"],
                                  padded_ids)
         # device-slice the pad off BEFORE fetching: only the requested rows
         # cross the (slow) host<->device link
-        return np.asarray(rows[: len(ids)])
+        return self._zoo.mesh_ctx.fetch(rows[: len(ids)])
 
     def raw(self) -> np.ndarray:
         """Logical-view snapshot (host numpy)."""
-        return self._from_storage(np.asarray(self.state["data"]))
+        return self._from_storage(self._zoo.mesh_ctx.fetch(self.state["data"]))
 
     # -- aux (updater state) <-> logical layout, for the checkpoint driver --
 
     def aux_to_logical(self, leaf) -> np.ndarray:
         """(padded_rows, cols) or (workers, padded_rows, cols) storage ->
         logical row layout (interleaving + trash rows stripped)."""
-        host = np.asarray(leaf)
+        host = self._zoo.mesh_ctx.fetch(leaf)
         if host.ndim == 2:
             return self._from_storage(host)
         return np.stack([self._from_storage(h) for h in host])

@@ -48,6 +48,14 @@ class SparseMatrixServerTable(MatrixServerTable):
                  initializer=None):
         super().__init__(num_rows, num_cols, dtype, zoo, updater_type,
                          initializer)
+        # Per-worker freshness is host control-plane state keyed by the
+        # per-process worker-id space; in a multi-process job the bit
+        # matrices (and the dynamic stale sets shipped per Get) would
+        # diverge across hosts, breaking the collective contract — use
+        # MatrixTable or the device plane there (documented limitation).
+        from multiverso_tpu.parallel import multihost
+        CHECK(multihost.process_count() <= 1,
+              "SparseMatrixTable host-plane is single-process")
         # all-fresh at start (reference ctor sets true,
         # sparse_matrix_table.cpp:184-196)
         self.up_to_date = np.ones((zoo.num_workers, num_rows), dtype=bool)

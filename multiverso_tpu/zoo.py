@@ -205,6 +205,20 @@ class Zoo:
 
     # -- collectives --------------------------------------------------------
 
+    def DrainServer(self) -> None:
+        """Round-trip a barrier ping through the engine mailbox: returns
+        only after every previously-enqueued request — including
+        fire-and-forget Adds — has been applied (native ServerC
+        kRequestBarrier parity). No-op when no engine runs (-ma mode)."""
+        if self.server_engine is None:
+            return
+        waiter = Waiter(1)
+        msg = Message(msg_type=MsgType.Request_Barrier, waiter=waiter)
+        self.server_engine.Receive(msg)
+        waiter.Wait()
+        if isinstance(msg.result, Exception):
+            raise msg.result
+
     def Barrier(self) -> None:
         """Worker barrier (reference zoo.cpp:164-177 controller roundtrip):
         all in-process worker threads, then — multihost — all processes

@@ -68,6 +68,19 @@ class TestCheckpointDriver:
         resumed = run(interrupt=True)
         np.testing.assert_allclose(resumed, uninterrupted, rtol=1e-6)
 
+    def test_save_drains_in_flight_async_adds(self, mv_env, ckpt_path):
+        """Fire-and-forget pushes enqueued before the save must be in the
+        checkpoint: save_checkpoint drains the engine mailbox first
+        (checkpoint._quiesce; native ServerC kRequestBarrier parity)."""
+        from multiverso_tpu.tables import ArrayTableOption
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=8))
+        for _ in range(50):
+            table.AddFireForget(np.ones(8, np.float32))
+        mv_env.MV_SaveCheckpoint(ckpt_path)
+        table.Add(np.full(8, 100.0, np.float32))  # diverge post-save
+        mv_env.MV_LoadCheckpoint(ckpt_path)
+        np.testing.assert_allclose(table.Get(), 50.0)
+
     def test_type_mismatch_rejected(self, mv_env, ckpt_path, tmp_path):
         from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
         from multiverso_tpu.utils.log import FatalError

@@ -1,11 +1,21 @@
-"""Multi-host layer (parallel/multihost.py) — single-process behavior.
+"""Multi-host layer (parallel/multihost.py).
 
-True multi-process runs need a pod (or multiple local processes with a
-coordinator); these tests pin down the 1-process degradations (identity /
-no-op), the flag gating, and the cross_reduce hook the Zoo wires into
-MV_Aggregate's rendezvous.
+Two tiers here, mirroring the reference's split between in-process
+fixtures and mpirun-launched integration tests (SURVEY.md §4.2):
+
+* single-process behavior — the 1-process degradations (identity / no-op),
+  flag gating, and the cross_reduce hook the Zoo wires into MV_Aggregate's
+  rendezvous;
+* a REAL 2-process integration test — two subprocesses joined through
+  ``jax.distributed`` with a local coordinator (the moral equivalent of
+  ``mpirun -n 2 multiverso.test array``, reference Test/main.cpp), driving
+  PS tables with *divergent per-process payloads* and checkpointing.
 """
 
+import os
+import socket
+import subprocess
+import sys
 import threading
 
 import numpy as np
@@ -45,6 +55,85 @@ class TestSingleProcessDegradation:
         from multiverso_tpu.zoo import Zoo
         assert Zoo.Get().size == 1
         assert Zoo.Get().rank == 0
+
+
+_CHILD = r'''
+import os, sys
+rank, port, ckpt = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+assert mv.MV_Size() == 2 and mv.MV_Rank() == rank
+
+# array: per-process deltas of one collective Add SUM (reference semantics)
+arr = mv.MV_CreateTable(ArrayTableOption(size=16))
+arr.Add(np.full(16, float(rank + 1), np.float32))
+assert np.allclose(arr.Get(), 3.0)
+
+# matrix: divergent row sets; both processes' adds land, each process
+# reads its own row set out of the collective Get
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=4))
+my_rows = np.array([rank, 10 + rank], np.int32)
+mat.AddRows(my_rows, np.full((2, 4), float(rank + 1), np.float32))
+rows = mat.GetRows(np.array([0, 1, 10, 11], np.int32))
+assert np.allclose(rows[[0, 2]], 1.0) and np.allclose(rows[[1, 3]], 2.0)
+assert np.allclose(mat.GetRows(my_rows), float(rank + 1))
+
+# kv: divergent key sets; slot index stays consistent on every host
+kv = mv.MV_CreateTable(KVTableOption())
+kv.Add(np.array([100 + rank, 500], np.int64),
+       np.array([1.0, 1.0], np.float32))
+assert np.allclose(kv.Get(np.array([100, 101, 500], np.int64)),
+                   [1.0, 1.0, 2.0])
+
+# checkpoint: collective serialize, process-0 write, everyone reloads
+mv.MV_SaveCheckpoint(ckpt)
+arr.Add(np.ones(16, np.float32))           # diverge (collectively)
+mv.MV_LoadCheckpoint(ckpt)
+assert np.allclose(arr.Get(), 3.0)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} OK", flush=True)
+'''
+
+
+class TestTwoProcessIntegration:
+    def test_ps_tables_across_two_processes(self, tmp_path):
+        child = tmp_path / "child.py"
+        child.write_text(_CHILD)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ,
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        ckpt = f"file://{tmp_path}/ckpt.mvt"
+        procs = [subprocess.Popen(
+            [sys.executable, str(child), str(r), str(port), ckpt],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+                pytest.fail(f"2-process run hung:\n{out[-2000:]}")
+            outs.append(out)
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+            assert f"child {r} OK" in out
 
 
 class TestCrossReduceHook:
