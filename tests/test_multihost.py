@@ -136,6 +136,90 @@ class TestTwoProcessIntegration:
             assert f"child {r} OK" in out
 
 
+_LR_CHILD = r'''
+import os, sys
+rank, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg.configure import Configure
+from multiverso_tpu.models.logreg.logreg import LogReg
+
+os.chdir(workdir)
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+cfg = Configure(input_size=16, output_size=1, objective_type="sigmoid",
+                updater_type="sgd", learning_rate=0.3, train_epoch=3,
+                minibatch_size=32, use_ps=True, sync_frequency=2,
+                train_file=f"train_{rank}.data", test_file="test.data",
+                output_model_file=f"model_{rank}.bin",
+                output_file=f"out_{rank}.txt")
+lr = LogReg(cfg)
+lr.Train()
+acc = lr.Test()
+np.save(f"W_{rank}.npy", lr.model.weights())
+mv.MV_Barrier()
+mv.MV_ShutDown()
+assert acc > 0.85, acc
+print(f"child {rank} LR acc {acc:.3f} OK", flush=True)
+'''
+
+
+class TestTwoProcessLogReg:
+    """The BASELINE north star in miniature: the bundled LogisticRegression
+    app training DATA-PARALLEL across two jax.distributed processes through
+    the parameter server — each process streams a different data shard,
+    pushes lr-scaled deltas, pulls every sync_frequency batches. Both
+    processes must converge AND hold identical final weights (the PS is the
+    single source of truth; merged collective Adds are deterministic)."""
+
+    def test_data_parallel_lr_converges_identically(self, tmp_path):
+        rng = np.random.default_rng(0)
+        true_w = rng.standard_normal(16).astype(np.float32)
+
+        def write(path, n, seed):
+            r = np.random.default_rng(seed)
+            X = r.standard_normal((n, 16)).astype(np.float32)
+            y = (X @ true_w > 0).astype(int)
+            with open(path, "w") as f:
+                for lab, row in zip(y, X):
+                    f.write(f"{lab} " +
+                            " ".join(f"{v:.4f}" for v in row) + "\n")
+
+        write(tmp_path / "train_0.data", 640, 1)
+        write(tmp_path / "train_1.data", 640, 2)  # different shard
+        write(tmp_path / "test.data", 400, 3)
+        child = tmp_path / "child_lr.py"
+        child.write_text(_LR_CHILD)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ,
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        procs = [subprocess.Popen(
+            [sys.executable, str(child), str(r), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=280)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+                pytest.fail(f"2-process LR hung:\n{out[-2000:]}")
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+            assert f"child {r} LR acc" in out
+        W0 = np.load(tmp_path / "W_0.npy")
+        W1 = np.load(tmp_path / "W_1.npy")
+        np.testing.assert_array_equal(W0, W1)
+
+
 class TestCrossReduceHook:
     def test_applied_once_per_round_by_last_thread(self):
         from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
