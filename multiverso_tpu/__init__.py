@@ -39,6 +39,8 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_Aggregate,
     MV_SaveCheckpoint,
     MV_LoadCheckpoint,
+    MV_StartProfiler,
+    MV_StopProfiler,
 )
 
 __version__ = "0.1.0"

@@ -117,3 +117,18 @@ def MV_LoadCheckpoint(uri: str) -> int:
     """Restore every registered server table from ``uri``."""
     from multiverso_tpu.checkpoint import load_checkpoint
     return load_checkpoint(uri)
+
+
+def MV_StartProfiler(logdir: str) -> None:
+    """Start a JAX profiler trace (xplane) into ``logdir`` — the
+    device-side complement of the host-side Monitor dashboard (SURVEY.md
+    §5: 'jax profiler/xplane traces + the same named-region dashboard');
+    view with TensorBoard or xprof. One trace at a time."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def MV_StopProfiler() -> None:
+    """Stop the trace started by ``MV_StartProfiler`` and flush it."""
+    import jax
+    jax.profiler.stop_trace()

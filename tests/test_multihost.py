@@ -220,6 +220,83 @@ class TestTwoProcessLogReg:
         np.testing.assert_array_equal(W0, W1)
 
 
+_WE_CHILD = r'''
+import os, sys
+rank, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import multiverso_tpu as mv
+from multiverso_tpu.models.wordembedding.option import Option
+from multiverso_tpu.models.wordembedding.distributed import (
+    DistributedWordEmbedding)
+
+os.chdir(workdir)
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+opt = Option.parse_args([
+    "-train_file", f"corpus_{rank}.txt", "-output", f"vectors_{rank}.txt",
+    "-size", "16", "-epoch", "2", "-negative", "3", "-min_count", "1",
+    "-read_vocab", "vocab.txt", "-data_block_size", "20000",
+    "-is_pipeline", "0"])
+dwe = DistributedWordEmbedding(opt)
+dwe.run()
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} WE OK", flush=True)
+'''
+
+
+class TestTwoProcessWordEmbedding:
+    """The second bundled app data-parallel across two processes: 4 shared
+    embedding/accumulator MatrixTables + the int64 word-count KVTable, each
+    process streaming a different corpus shard. Both processes must finish
+    and save IDENTICAL embeddings (the PS is the single source of truth)."""
+
+    def test_we_trains_across_two_processes(self, tmp_path):
+        rng = np.random.default_rng(0)
+        words = [f"w{i}" for i in range(200)]
+
+        def gen(path, seed, sents):
+            r = np.random.default_rng(seed)
+            with open(path, "w") as f:
+                for _ in range(sents):
+                    f.write(" ".join(r.choice(words, 10)) + "\n")
+
+        gen(tmp_path / "corpus_0.txt", 1, 800)
+        gen(tmp_path / "corpus_1.txt", 2, 800)  # different shard
+        with open(tmp_path / "vocab.txt", "w") as f:
+            for w in words:
+                f.write(f"{w} 100\n")
+        child = tmp_path / "child_we.py"
+        child.write_text(_WE_CHILD)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ,
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        procs = [subprocess.Popen(
+            [sys.executable, str(child), str(r), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=280)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+                pytest.fail(f"2-process WE hung:\n{out[-2000:]}")
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+            assert f"child {r} WE OK" in out
+        v0 = (tmp_path / "vectors_0.txt").read_text()
+        v1 = (tmp_path / "vectors_1.txt").read_text()
+        assert v0 == v1, "processes saved different embeddings"
+
+
 class TestCrossReduceHook:
     def test_applied_once_per_round_by_last_thread(self):
         from multiverso_tpu.parallel.allreduce import RendezvousAllreduce

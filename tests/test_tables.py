@@ -183,6 +183,73 @@ class TestArrayTable:
         assert offs == partition_offsets(100, 4)
 
 
+class TestConcurrencyStress:
+    """Tier-2 hammer (reference Test/test_array_table.cpp multi-worker
+    accumulation invariant, scaled up): 8 worker threads mixing blocking,
+    async-handle, and fire-and-forget verbs over three table kinds at
+    once; exact accumulation invariants at the end."""
+
+    def test_mixed_tables_hammer(self):
+        import threading
+
+        import multiverso_tpu as mv
+        from multiverso_tpu.zoo import Zoo
+        W, ITERS = 8, 20
+        mv.MV_Init([f"-num_workers={W}"])
+        try:
+            arr = mv.MV_CreateTable(ArrayTableOption(size=64))
+            mat = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                      num_cols=8))
+            kv = mv.MV_CreateTable(KVTableOption())
+            errors = []
+
+            def work(wid):
+                try:
+                    with Zoo.Get().worker_context(wid):
+                        rows = np.array([wid * 8 + i for i in range(8)],
+                                        np.int32)
+                        handles = []
+                        for i in range(ITERS):
+                            if i % 3 == 0:
+                                arr.Add(np.ones(64, np.float32))
+                            elif i % 3 == 1:
+                                handles.append(arr.AddAsyncHandle(
+                                    np.ones(64, np.float32)))
+                            else:
+                                arr.AddFireForget(np.ones(64, np.float32))
+                            mat.AddRows(rows[i % 8: i % 8 + 1],
+                                        np.ones((1, 8), np.float32))
+                            kv.Add([wid, 1000 + wid], [1.0, 2.0])
+                            if i % 5 == 0:
+                                arr.Get()
+                                mat.GetRows(rows)
+                        for h in handles:
+                            arr.Wait(h)
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+
+            ts = [threading.Thread(target=work, args=(w,)) for w in range(W)]
+            [t.start() for t in ts]
+            [t.join(timeout=120) for t in ts]
+            assert not any(t.is_alive() for t in ts), "hammer deadlocked"
+            assert not errors, errors
+            Zoo.Get().DrainServer()   # fire-and-forget adds land
+            np.testing.assert_allclose(arr.Get(), W * ITERS)
+            got = mat.GetRows(np.arange(64, dtype=np.int32))
+            # each worker hit its own 8 rows, row (wid*8 + j) exactly
+            # ceil/floor of ITERS/8 times
+            counts = got[:, 0].reshape(W, 8)
+            for j in range(8):
+                expect = len([i for i in range(ITERS) if i % 8 == j])
+                np.testing.assert_allclose(counts[:, j], expect)
+            np.testing.assert_allclose(
+                kv.Get(list(range(W))), ITERS)
+            np.testing.assert_allclose(
+                kv.Get([1000 + w for w in range(W)]), 2 * ITERS)
+        finally:
+            mv.MV_ShutDown()
+
+
 class TestUserExtensibleTable:
     """The reference proves its table interface is user-extensible by the LR
     app defining its own WorkerTable/ServerTable subclasses

@@ -117,6 +117,18 @@ class TestWaiter:
 
 
 class TestDashboard:
+    def test_profiler_trace_wrappers(self, tmp_path):
+        """MV_StartProfiler/MV_StopProfiler wrap jax.profiler (SURVEY §5:
+        device-side truth belongs to xplane traces)."""
+        import jax.numpy as jnp
+
+        import multiverso_tpu as mv
+        mv.MV_StartProfiler(str(tmp_path))
+        jnp.ones(8).sum().block_until_ready()
+        mv.MV_StopProfiler()
+        assert list(tmp_path.rglob("*.xplane.pb")), \
+            "no xplane trace written"
+
     def test_monitor_accumulates(self):
         mon = Monitor("test.region")
         mon.Begin()
