@@ -22,6 +22,39 @@ import numpy as np
 import pytest
 
 
+def run_two_process(child_src: str, tmp_path, *child_args,
+                    timeout: int = 280, expect: str = "OK") -> list:
+    """Launch two jax.distributed subprocesses running ``child_src`` (argv:
+    rank, coordinator-port, *child_args); assert both exit 0 and print
+    ``child <rank> ... {expect}``. Returns both outputs."""
+    child = tmp_path / "child.py"
+    child.write_text(child_src)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    procs = [subprocess.Popen(
+        [sys.executable, str(child), str(r), str(port),
+         *[str(a) for a in child_args]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for r in range(2)]
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"2-process run hung:\n{out[-2000:]}")
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+        assert f"child {r}" in out and expect in out, out[-500:]
+        outs.append(out)
+    return outs
+
+
 class TestSingleProcessDegradation:
     def test_identity_ops(self):
         from multiverso_tpu.parallel import multihost as mh
@@ -107,33 +140,42 @@ print(f"child {rank} OK", flush=True)
 
 class TestTwoProcessIntegration:
     def test_ps_tables_across_two_processes(self, tmp_path):
-        child = tmp_path / "child.py"
-        child.write_text(_CHILD)
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        env = dict(os.environ,
-                   PYTHONPATH=os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))))
-        ckpt = f"file://{tmp_path}/ckpt.mvt"
-        procs = [subprocess.Popen(
-            [sys.executable, str(child), str(r), str(port), ckpt],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True) for r in range(2)]
-        outs = []
-        for p in procs:
-            try:
-                out, _ = p.communicate(timeout=240)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                out, _ = p.communicate()
-                pytest.fail(f"2-process run hung:\n{out[-2000:]}")
-            outs.append(out)
-        for r, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
-            assert f"child {r} OK" in out
+        run_two_process(_CHILD, tmp_path, f"file://{tmp_path}/ckpt.mvt")
+
+
+_SYNC_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-sync=true"])
+arr = mv.MV_CreateTable(ArrayTableOption(size=8))
+for i in range(4):
+    arr.Add(np.full(8, float(rank + 1), np.float32))
+    g = arr.Get()
+    # BSP across processes: round i sees BOTH processes' adds (1+2 per
+    # round) and every process's i-th Get is identical
+    assert np.allclose(g, 3.0 * (i + 1)), (i, g)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} SYNC OK", flush=True)
+'''
+
+
+class TestTwoProcessSync:
+    def test_bsp_guarantee_across_processes(self, tmp_path):
+        """The SyncServer BSP guarantee (reference server.cpp:60-67) holds
+        across jax.distributed processes: per-process engines make
+        identical defer/drain decisions because the merged collective verb
+        stream is identical everywhere."""
+        run_two_process(_SYNC_CHILD, tmp_path, expect="SYNC OK")
 
 
 _LR_CHILD = r'''
@@ -192,29 +234,7 @@ class TestTwoProcessLogReg:
         write(tmp_path / "train_0.data", 640, 1)
         write(tmp_path / "train_1.data", 640, 2)  # different shard
         write(tmp_path / "test.data", 400, 3)
-        child = tmp_path / "child_lr.py"
-        child.write_text(_LR_CHILD)
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        env = dict(os.environ,
-                   PYTHONPATH=os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))))
-        procs = [subprocess.Popen(
-            [sys.executable, str(child), str(r), str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True) for r in range(2)]
-        for r, p in enumerate(procs):
-            try:
-                out, _ = p.communicate(timeout=280)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                out, _ = p.communicate()
-                pytest.fail(f"2-process LR hung:\n{out[-2000:]}")
-            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
-            assert f"child {r} LR acc" in out
+        run_two_process(_LR_CHILD, tmp_path, tmp_path, expect="LR acc")
         W0 = np.load(tmp_path / "W_0.npy")
         W1 = np.load(tmp_path / "W_1.npy")
         np.testing.assert_array_equal(W0, W1)
@@ -269,29 +289,7 @@ class TestTwoProcessWordEmbedding:
         with open(tmp_path / "vocab.txt", "w") as f:
             for w in words:
                 f.write(f"{w} 100\n")
-        child = tmp_path / "child_we.py"
-        child.write_text(_WE_CHILD)
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        env = dict(os.environ,
-                   PYTHONPATH=os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))))
-        procs = [subprocess.Popen(
-            [sys.executable, str(child), str(r), str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True) for r in range(2)]
-        for r, p in enumerate(procs):
-            try:
-                out, _ = p.communicate(timeout=280)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                out, _ = p.communicate()
-                pytest.fail(f"2-process WE hung:\n{out[-2000:]}")
-            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
-            assert f"child {r} WE OK" in out
+        run_two_process(_WE_CHILD, tmp_path, tmp_path, expect="WE OK")
         v0 = (tmp_path / "vectors_0.txt").read_text()
         v1 = (tmp_path / "vectors_1.txt").read_text()
         assert v0 == v1, "processes saved different embeddings"
