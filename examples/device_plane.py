@@ -53,8 +53,12 @@ def main():
     server = table.server()
     opt = AddOption().as_jnp()
 
-    ids_all = rng.integers(0, ROWS, (STEPS, BATCH)).astype(np.int32)
-    bucket = BATCH  # BATCH is already a bucket size
+    # unique ids per batch: the device row ops require duplicate-free live
+    # ids (the host verbs pre-combine duplicates; the traceable plane leaves
+    # that to the caller — matrix_table.py module docstring)
+    ids_all = np.stack([
+        rng.permutation(ROWS)[:BATCH].astype(np.int32)
+        for _ in range(STEPS)])
     Ad = jax.device_put(A)
     Bd = jax.device_put(B)
     ids_d = jax.device_put(ids_all)

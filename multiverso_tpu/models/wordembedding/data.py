@@ -58,18 +58,55 @@ class DataBlock:
 
 def sentences_from_file(path: str, dictionary: Dictionary) -> Iterator[Tuple[np.ndarray, int]]:
     """Tokenize -> word ids; yields (ids, raw_token_count) per sentence
-    (line), clipped to MAX_SENTENCE_LENGTH (reference reader.cpp)."""
+    (line), clipped to MAX_SENTENCE_LENGTH (reference reader.cpp).
+
+    Fast path: the native tokenizer (native/src/reader.cc, loaded via
+    multiverso_tpu.native.VocabTokenizer) tokenizes megabyte chunks in ONE
+    foreign call each — ids come back with -2 sentinels at newlines and
+    are split into sentences vectorized; pure-python fallback otherwise."""
+    from multiverso_tpu.native import VocabTokenizer
+    tok = VocabTokenizer.create(dictionary.words())
+
+    def emit(ids: np.ndarray):
+        for start in range(0, len(ids), MAX_SENTENCE_LENGTH):
+            chunk = ids[start: start + MAX_SENTENCE_LENGTH]
+            if chunk.size:
+                yield chunk, len(chunk)
+
+    if tok is not None:
+        CHUNK_BYTES = 1 << 20
+        with open(path, "rb") as f:
+            tail = b""
+            while True:
+                block = f.read(CHUNK_BYTES)
+                if not block:
+                    break
+                block = tail + block
+                # cut at the last newline; carry the partial line over
+                nl = block.rfind(b"\n")
+                if nl < 0:
+                    tail = block
+                    continue
+                tail = block[nl + 1:]
+                ids = tok.tokenize_lines(block[: nl + 1])
+                # split on the -2 newline sentinels, drop -1 OOV ids
+                for sent in np.split(ids, np.nonzero(ids == -2)[0]):
+                    sent = sent[sent >= 0]
+                    yield from emit(sent)
+            if tail.strip():
+                ids = tok.tokenize_lines(tail)
+                for sent in np.split(ids, np.nonzero(ids == -2)[0]):
+                    sent = sent[sent >= 0]
+                    yield from emit(sent)
+        return
+
     with open(path, encoding="utf-8", errors="replace") as f:
         for line in f:
             tokens = line.split()
             if not tokens:
                 continue
             ids = [dictionary.GetWordIdx(t) for t in tokens]
-            ids = np.asarray([i for i in ids if i >= 0], np.int32)
-            for start in range(0, len(ids), MAX_SENTENCE_LENGTH):
-                chunk = ids[start: start + MAX_SENTENCE_LENGTH]
-                if chunk.size:
-                    yield chunk, len(chunk)
+            yield from emit(np.asarray([i for i in ids if i >= 0], np.int32))
 
 
 class PairGenerator:

@@ -48,9 +48,17 @@ def lib() -> Optional[ctypes.CDLL]:
             return None
         try:
             handle = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        _configure_signatures(handle)
+            _configure_signatures(handle)
+        except (OSError, AttributeError):
+            # AttributeError = stale prebuilt .so missing a newer symbol:
+            # rebuild once, then degrade to pure python (module contract)
+            if not _build():
+                return None
+            try:
+                handle = ctypes.CDLL(_LIB_PATH)
+                _configure_signatures(handle)
+            except (OSError, AttributeError):
+                return None
         _lib = handle
         return _lib
 
@@ -66,6 +74,17 @@ def _configure_signatures(h: ctypes.CDLL) -> None:
         np.ctypeslib.ndpointer(np.int32), np.ctypeslib.ndpointer(np.float32),
         np.ctypeslib.ndpointer(np.int64), np.ctypeslib.ndpointer(np.int64),
         np.ctypeslib.ndpointer(np.float32)]
+    h.MV_BuildVocabHash.restype = i64
+    h.MV_BuildVocabHash.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int64), i64]
+    h.MV_TokenizeToIds.restype = i64
+    h.MV_TokenizeToIds.argtypes = [
+        ctypes.c_char_p, i64, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int32, np.ctypeslib.ndpointer(np.int64), i64,
+        np.ctypeslib.ndpointer(np.int32), i64]
+    h.MV_TokenizeLinesToIds.restype = i64
+    h.MV_TokenizeLinesToIds.argtypes = h.MV_TokenizeToIds.argtypes
 
 
 def parse_libsvm(text: bytes, weighted: bool = False
@@ -96,3 +115,49 @@ def parse_libsvm(text: bytes, weighted: bool = False
     if parsed != ns:
         return None
     return labels[:ns], weights[:ns], offsets, keys[:ne], values[:ne]
+
+
+class VocabTokenizer:
+    """Native tokenize + vocab lookup (native/src/reader.cc
+    MV_BuildVocabHash / MV_TokenizeToIds): builds an open-addressing word
+    hash once, then maps whitespace-tokenized text to word ids in C++ —
+    the reference WordEmbedding reader's hot loop (reader.cpp tokenize +
+    Dictionary::GetWordIdx per token) off the python interpreter.
+    Out-of-vocab tokens come back as -1 (caller filters)."""
+
+    def __init__(self, handle: ctypes.CDLL, words):
+        self._h = handle
+        self._word_bytes = [w.encode("utf-8") for w in words]  # keep alive
+        self._words = (ctypes.c_char_p * len(words))(*self._word_bytes)
+        self._n = len(words)
+        cap = 8
+        while cap < 2 * self._n + 1:
+            cap <<= 1
+        self._table = np.empty(cap, np.int64)
+        self._cap = cap
+        handle.MV_BuildVocabHash(self._words, self._n, self._table, cap)
+
+    @classmethod
+    def create(cls, words) -> Optional["VocabTokenizer"]:
+        handle = lib()
+        if handle is None or not len(words):
+            return None
+        return cls(handle, list(words))
+
+    def tokenize(self, text: bytes, max_ids: int) -> np.ndarray:
+        """Word ids of ``text`` in order, -1 for out-of-vocab tokens."""
+        out = np.empty(max(max_ids, 1), np.int32)
+        n = self._h.MV_TokenizeToIds(text, len(text), self._words, self._n,
+                                     self._table, self._cap, out,
+                                     len(out))
+        return out[:n]
+
+    def tokenize_lines(self, text: bytes) -> np.ndarray:
+        """Word ids of a multi-line chunk with -2 sentinels at newlines —
+        one foreign call per chunk (per-line calls cost more than the
+        tokenizing). -1 still marks out-of-vocab."""
+        out = np.empty(len(text) + 2, np.int32)
+        n = self._h.MV_TokenizeLinesToIds(text, len(text), self._words,
+                                          self._n, self._table, self._cap,
+                                          out, len(out))
+        return out[:n]

@@ -63,6 +63,14 @@ int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
                          const int64_t* table, int64_t capacity,
                          int32_t* out_ids, int64_t out_cap);
 
+/* Like MV_TokenizeToIds over a multi-line chunk: emits -2 at every '\n'
+ * so the caller recovers sentence boundaries from ONE call (per-line
+ * foreign-function calls are slower than the tokenizing itself). */
+int64_t MV_TokenizeLinesToIds(const char* text, int64_t text_len,
+                              const char** words, int32_t n_words,
+                              const int64_t* table, int64_t capacity,
+                              int32_t* out_ids, int64_t out_cap);
+
 #ifdef __cplusplus
 }
 #endif

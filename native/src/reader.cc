@@ -136,6 +136,16 @@ int64_t MV_BuildVocabHash(const char** words, int32_t n_words,
   return n_words;
 }
 
+namespace {
+// ASCII whitespace, locale-independent (python str.split semantics for
+// byte corpora; std::isspace is locale-dependent and can claim 0xA0,
+// splitting mid-UTF-8-character under some locales)
+inline bool is_ws(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+         c == '\f';
+}
+}  // namespace
+
 int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
                          const char** words, int32_t n_words,
                          const int64_t* table, int64_t capacity,
@@ -145,9 +155,9 @@ int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
   const char* end = text + text_len;
   int64_t out = 0;
   while (p < end && out < out_cap) {
-    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+    while (p < end && is_ws(*p)) ++p;
     const char* tok = p;
-    while (p < end && !std::isspace(static_cast<unsigned char>(*p))) ++p;
+    while (p < end && !is_ws(*p)) ++p;
     if (p == tok) break;
     size_t len = static_cast<size_t>(p - tok);
     uint64_t h = hash_str(tok, len) % static_cast<uint64_t>(capacity);
@@ -161,6 +171,45 @@ int64_t MV_TokenizeToIds(const char* text, int64_t text_len,
       h = (h + 1) % static_cast<uint64_t>(capacity);
     }
     out_ids[out++] = id;  // -1 marks out-of-vocab (caller filters)
+  }
+  return out;
+}
+
+int64_t MV_TokenizeLinesToIds(const char* text, int64_t text_len,
+                              const char** words, int32_t n_words,
+                              const int64_t* table, int64_t capacity,
+                              int32_t* out_ids, int64_t out_cap) {
+  (void)n_words;
+  const char* p = text;
+  const char* end = text + text_len;
+  int64_t out = 0;
+  while (p < end && out < out_cap) {
+    // skip non-newline whitespace; a '\n' becomes a -2 sentinel
+    while (p < end && is_ws(*p)) {
+      if (*p == '\n' || *p == '\r') {  // \r\n yields an empty segment
+                                       // the caller filters out
+        out_ids[out++] = -2;
+        ++p;
+        if (out >= out_cap) return out;
+      } else {
+        ++p;
+      }
+    }
+    const char* tok = p;
+    while (p < end && !is_ws(*p)) ++p;
+    if (p == tok) break;
+    size_t len = static_cast<size_t>(p - tok);
+    uint64_t h = hash_str(tok, len) % static_cast<uint64_t>(capacity);
+    int32_t id = -1;
+    while (table[h] != -1) {
+      int64_t cand = table[h];
+      if (strncmp(words[cand], tok, len) == 0 && words[cand][len] == '\0') {
+        id = static_cast<int32_t>(cand);
+        break;
+      }
+      h = (h + 1) % static_cast<uint64_t>(capacity);
+    }
+    out_ids[out++] = id;
   }
   return out;
 }

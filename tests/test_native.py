@@ -123,6 +123,30 @@ class TestNativeReader:
             np.testing.assert_array_equal(k1, k2)
             np.testing.assert_allclose(v1, v2)
 
+    def test_vocab_tokenizer_matches_python(self, native_build, tmp_path):
+        """WE sentence reader: native tokenizer path == python path."""
+        from multiverso_tpu.models.wordembedding import data as we_data
+        from multiverso_tpu.models.wordembedding.dictionary import Dictionary
+        d = Dictionary()
+        for w in ["the", "cat", "sat", "on", "mat"]:
+            d.Insert(w, 10)
+        corpus = tmp_path / "c.txt"
+        # mixed line endings: \n, blank line, \r\n (both paths must agree)
+        corpus.write_bytes(
+            b"the cat sat on the unknown mat\n\nmat cat\r\nsat mat\n")
+        native_out = [(ids.tolist(), n) for ids, n in
+                      we_data.sentences_from_file(str(corpus), d)]
+        from multiverso_tpu import native as native_mod
+        orig = native_mod.lib
+        native_mod.lib = lambda: None
+        try:
+            py_out = [(ids.tolist(), n) for ids, n in
+                      we_data.sentences_from_file(str(corpus), d)]
+        finally:
+            native_mod.lib = orig
+        assert native_out == py_out
+        assert len(native_out) == 3  # blank line skipped, OOV filtered
+
     def test_malformed_input_raises(self, native_build):
         """Malformed tokens must fail the run, not parse as zeros
         (native parser returns -1 -> ValueError)."""
