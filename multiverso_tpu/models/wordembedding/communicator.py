@@ -110,6 +110,47 @@ class Communicator:
                 np.asarray(state.eo_g2) - fetched["eo_g2"],
                 row_ids=output_rows)
 
+    # -- device plane (rows never leave HBM) --------------------------------
+
+    def _row_specs(self, input_rows, output_rows):
+        specs = [("ie", self.input_table, input_rows),
+                 ("eo", self.output_table, output_rows)]
+        if self.opt.use_adagrad:
+            specs += [("ie_g2", self.ie_g2_table, input_rows),
+                      ("eo_g2", self.eo_g2_table, output_rows)]
+        return specs
+
+    def request_parameter_device(self, input_rows: np.ndarray,
+                                 output_rows: np.ndarray
+                                 ) -> Tuple[TrainState, dict]:
+        """Device-plane fetch: gather the block's rows straight out of the
+        sharded stores (docs/DESIGN.md §4) — the TrainState AND the
+        originals kept for the delta push stay in HBM. Single-process,
+        single-writer path: the caller owns the tables while training
+        (the app's block loop is sequential; reference omp-thread sharing
+        is the host plane's job)."""
+        rows = {}
+        train = {}
+        for name, table, ids in self._row_specs(input_rows, output_rows):
+            rows[name] = table.server().device_fetch_rows(ids)
+            # the train step DONATES its state; the original must survive
+            # for the delta push, so the state gets its own buffer
+            train[name] = jnp.copy(rows[name])
+        state = TrainState(ie=train["ie"], eo=train["eo"],
+                           ie_g2=train.get("ie_g2"),
+                           eo_g2=train.get("eo_g2"))
+        return state, rows
+
+    def add_delta_parameter_device(self, state: TrainState, fetched: dict,
+                                   input_rows: np.ndarray,
+                                   output_rows: np.ndarray) -> None:
+        """Push trained - fetched without leaving the device: the delta is
+        computed in HBM and scattered into the store by the same jit'd row
+        program the engine uses."""
+        for name, table, ids in self._row_specs(input_rows, output_rows):
+            delta = getattr(state, name) - fetched[name]
+            table.server().device_apply_rows(ids, delta)
+
     # -- word count (lr decay coordination) ---------------------------------
 
     def add_word_count(self, count: int) -> None:

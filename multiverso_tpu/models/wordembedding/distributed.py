@@ -92,7 +92,10 @@ class DistributedWordEmbedding:
         while current is not None:
             if opt.is_pipeline:
                 next_block = queue.pop()
-                if next_block is not None and next_block.batches:
+                # host-plane prefetch only: the device plane's fetch is an
+                # async dispatch already (nothing to overlap by hand)
+                if (next_block is not None and next_block.batches
+                        and not opt.device_plane):
                     prefetch = self.comm.request_parameter_async(
                         next_block.input_rows, next_block.output_rows)
             loss, pairs = self._train_block(current, step)
@@ -108,7 +111,8 @@ class DistributedWordEmbedding:
             if opt.is_pipeline:
                 if next_block is not None and next_block.batches \
                         and prefetch is not None:
-                    next_block._prefetched = self.comm.wait_parameter(prefetch)
+                    next_block._prefetched = self.comm.wait_parameter(
+                        prefetch)
                 current, prefetch = next_block, None
             else:
                 current = queue.pop()
@@ -127,7 +131,11 @@ class DistributedWordEmbedding:
             return 0.0, 0
         import jax.numpy as jnp
         pre = getattr(block, "_prefetched", None)
-        if pre is not None:
+        if self.opt.device_plane:
+            # rows gathered, trained, and pushed without leaving HBM
+            state, fetched = self.comm.request_parameter_device(
+                block.input_rows, block.output_rows)
+        elif pre is not None:
             state, fetched = pre
         else:
             state, fetched = self.comm.request_parameter(block.input_rows,
@@ -148,8 +156,12 @@ class DistributedWordEmbedding:
                                jnp.asarray(batch.output_mask), lr)
             loss_sum += float(loss)
             pairs += batch.count
-        self.comm.add_delta_parameter(state, fetched, block.input_rows,
-                                      block.output_rows)
+        if self.opt.device_plane:
+            self.comm.add_delta_parameter_device(
+                state, fetched, block.input_rows, block.output_rows)
+        else:
+            self.comm.add_delta_parameter(state, fetched, block.input_rows,
+                                          block.output_rows)
         return loss_sum, pairs
 
     # -- export (word2vec format) -------------------------------------------

@@ -41,6 +41,10 @@ class Option:
     init_learning_rate: float = 0.025
     pair_batch_size: int = 1024   # TPU minibatch of training pairs
     seed: int = 1
+    # TPU-native extension: fetch/train/push a block's rows entirely on
+    # device (communicator device plane, docs/DESIGN.md §4) — no host
+    # round-trip per block. Single-process, single-worker path.
+    device_plane: bool = False
 
     _FLAGS = {
         "size": ("embedding_size", int),
@@ -66,6 +70,7 @@ class Option:
         "total_words": ("total_words", int),
         "pair_batch": ("pair_batch_size", int),
         "seed": ("seed", int),
+        "device_plane": ("device_plane", lambda v: bool(int(v))),
     }
 
     @classmethod

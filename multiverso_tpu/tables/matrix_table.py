@@ -352,6 +352,51 @@ class MatrixServerTable(ServerTable):
         # cross the (slow) host<->device link
         return self._zoo.mesh_ctx.fetch(rows[: len(ids)])
 
+    # -- eager device plane (public) ----------------------------------------
+    # device_gather_rows / device_update_rows above are the TRACEABLE hooks
+    # (scan them into a jit'd step — bench.py, examples/device_plane.py);
+    # these two are their eager siblings for callers that want per-block
+    # dispatch with host-plane validation but no host round-trip of the
+    # row data (e.g. the WordEmbedding communicator's -device_plane path).
+    # Both are single-process: the device plane bypasses the engine, so
+    # there is no collective merge and no single-writer arbitration —
+    # the caller owns the table while using them.
+
+    def _check_device_plane(self) -> None:
+        from multiverso_tpu.parallel import multihost
+        CHECK(multihost.process_count() <= 1,
+              "the device plane is single-process (the engine's collective "
+              "merge is bypassed)")
+
+    def device_fetch_rows(self, row_ids) -> jax.Array:
+        """Rows for ``row_ids`` as a DEVICE array (never leaves HBM)."""
+        self._check_device_plane()
+        ids = np.asarray(row_ids, np.int32).ravel()
+        self._check_ids(ids)
+        padded = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
+        rows = self._gather_rows(self.state["data"], self.state["aux"],
+                                 padded)
+        return rows[: len(ids)]
+
+    def device_apply_rows(self, row_ids, deltas,
+                          option: Optional[AddOption] = None) -> None:
+        """Apply a (device or host) delta batch to ``row_ids`` in place —
+        same validation and duplicate pre-combining as ProcessAdd."""
+        self._check_device_plane()
+        ids = np.asarray(row_ids, np.int32).ravel()
+        self._check_ids(ids)
+        if len(np.unique(ids)) != len(ids):
+            # duplicates must pre-combine on the host (scatter order is
+            # undefined — module docstring); costs a device->host hop, so
+            # callers should dedupe their id sets (block row sets are)
+            host = np.asarray(deltas, self.dtype).reshape(len(ids),
+                                                          self.num_cols)
+            ids, deltas = self._combine_duplicates(ids, host)
+        padded_ids, padded_deltas = _pad_row_batch(
+            jnp.asarray(ids), jnp.asarray(deltas), next_bucket(len(ids)))
+        self.state = self._update_rows(self.state, padded_ids, padded_deltas,
+                                       (option or AddOption()).as_jnp())
+
     def raw(self) -> np.ndarray:
         """Logical-view snapshot (host numpy)."""
         return self._from_storage(self._zoo.mesh_ctx.fetch(self.state["data"]))

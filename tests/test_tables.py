@@ -297,6 +297,43 @@ class TestUserExtensibleTable:
         np.testing.assert_allclose(table.Pull(), [3.0, 5.0, -2.0, 1.0])
 
 
+class TestDevicePlaneEager:
+    """Public eager device-plane verbs (device_fetch_rows /
+    device_apply_rows): host-plane validation semantics, data in HBM."""
+
+    def test_fetch_apply_roundtrip(self, mv_env):
+        import jax
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                        num_cols=4))
+        srv = table.server()
+        ids = np.array([3, 7, 11], np.int32)
+        rows = srv.device_fetch_rows(ids)
+        assert isinstance(rows, jax.Array)
+        np.testing.assert_allclose(np.asarray(rows), 0.0)
+        srv.device_apply_rows(ids, np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(table.GetRows(ids), 1.0)
+
+    def test_duplicates_pre_combined(self, mv_env):
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                        num_cols=4))
+        srv = table.server()
+        ids = np.array([2, 5, 2], np.int32)   # duplicate id must stack
+        deltas = np.ones((3, 4), np.float32)
+        srv.device_apply_rows(ids, deltas)
+        np.testing.assert_allclose(table.GetRows([2])[0], 2.0)
+        np.testing.assert_allclose(table.GetRows([5])[0], 1.0)
+
+    def test_out_of_range_raises(self, mv_env):
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                        num_cols=4))
+        srv = table.server()
+        with pytest.raises(FatalError):
+            srv.device_fetch_rows([99])
+        with pytest.raises(FatalError):
+            srv.device_apply_rows([99], np.ones((1, 4), np.float32))
+
+
 class TestMatrixTable:
     def test_whole_add_get(self, mv_env):
         table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=20, num_cols=5))

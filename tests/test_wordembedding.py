@@ -157,6 +157,29 @@ class TestEndToEnd:
         _, avg_loss = _run(tmp_path, is_pipeline=False)
         assert avg_loss < 0.69 * 4 * 0.9
 
+    def test_device_plane_matches_host_plane(self, tmp_path):
+        """-device_plane 1: fetch/train/push entirely in HBM must produce
+        the same embeddings as the host-plane run (same verb order, same
+        math — only the transport differs)."""
+        (tmp_path / "host").mkdir()
+        (tmp_path / "dev").mkdir()
+        # pipeline off: the host pipeline prefetches the NEXT block before
+        # the current push lands (deliberate staleness, reference
+        # ps_model-style) — the device plane always fetches fresh, so the
+        # apples-to-apples comparison is unpipelined
+        opt_h, _ = _run(tmp_path / "host", use_adagrad=True,
+                        init_learning_rate=0.1, is_pipeline=False)
+        opt_d, _ = _run(tmp_path / "dev", use_adagrad=True,
+                        init_learning_rate=0.1, device_plane=True)
+        host = open(opt_h.output_file).read().splitlines()[1:]
+        dev = open(opt_d.output_file).read().splitlines()[1:]
+        hv = {l.split()[0]: np.array(l.split()[1:], np.float64)
+              for l in host}
+        dv = {l.split()[0]: np.array(l.split()[1:], np.float64) for l in dev}
+        assert hv.keys() == dv.keys()
+        for w in hv:
+            np.testing.assert_allclose(dv[w], hv[w], rtol=1e-3, atol=1e-4)
+
     def test_binary_output(self, tmp_path):
         opt, _ = _run(tmp_path, output_binary=True)
         raw = open(opt.output_file, "rb").read()
