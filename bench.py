@@ -287,6 +287,45 @@ def bench_wordembedding(np, rng):
     return WE_STEPS * WE_PAIRS / secs
 
 
+def bench_we_app(np, rng, tmpdir="/tmp/mvt_bench_we"):
+    """-> words/s of the FULL WordEmbedding app (data pipeline + PS tables
+    + jit'd training) in -device_plane mode — the end-to-end number the
+    reference's wall-clock headline is made of (BASELINE.json: 'WE 1B-word
+    wall-clock'); bench_wordembedding above isolates the raw step."""
+    import os
+    import shutil
+
+    from multiverso_tpu.models.wordembedding.distributed import (
+        DistributedWordEmbedding)
+    from multiverso_tpu.models.wordembedding.option import Option
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    os.makedirs(tmpdir)
+    words = [f"w{i}" for i in range(5000)]
+    n_words = 0
+    with open(f"{tmpdir}/corpus.txt", "w") as f:
+        for _ in range(15_000):
+            f.write(" ".join(rng.choice(words, 12)) + "\n")
+            n_words += 12
+    opt = Option(train_file=f"{tmpdir}/corpus.txt",
+                 output_file=f"{tmpdir}/vec.txt",
+                 embedding_size=128, window_size=5, negative_num=5,
+                 min_count=1, epoch=1, data_block_size=400_000,
+                 pair_batch_size=4096, init_learning_rate=0.05,
+                 use_adagrad=True, device_plane=True, is_pipeline=False)
+    # time the TRAIN phase (the reference's logged words/sec is training
+    # too, trainer.cpp:45-49); dictionary/sampler/table setup excluded
+    we = DistributedWordEmbedding(opt)
+    we.prepare()
+    t0 = time.perf_counter()
+    loss = we.train()
+    secs = time.perf_counter() - t0
+    we.close()
+    if not (loss == loss and loss > 0):
+        _fail("we_app_words_per_sec", f"bad loss {loss}", "words/s")
+    return n_words / secs
+
+
 def bench_matrix_table(np, rng):
     """-> (device_Melem_s, host_Melem_s, numpy_Melem_s)."""
     import jax
@@ -381,6 +420,7 @@ def main() -> int:
     rng = np.random.default_rng(0)
     tpu_sps, cpu_sps = bench_logreg(np, rng)
     we_pps = bench_wordembedding(np, rng)
+    we_app_wps = bench_we_app(np, rng)
     dev_me, host_me, base_me = bench_matrix_table(np, rng)
     kv_me = bench_kv_table(np, rng)
     print(json.dumps({
@@ -401,6 +441,7 @@ def main() -> int:
         "we_pairs_per_sec": round(we_pps),
         "we_config": f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
                      f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad",
+        "we_app_words_per_sec": round(we_app_wps),
         "kv_push_pull_Melem_s": round(kv_me, 1),
         "kv_config": f"int64 keys, {KV_KEYSPACE} keyspace, "
                      f"{KV_BATCH}/op, {KV_ROUNDS} rounds",

@@ -25,6 +25,7 @@ from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
 from multiverso_tpu.models.wordembedding.model import (decayed_lr,
                                                        make_train_step)
 from multiverso_tpu.models.wordembedding.option import Option
+from multiverso_tpu.parallel.mesh import next_bucket
 from multiverso_tpu.models.wordembedding.sampler import Sampler
 from multiverso_tpu.utils.log import Log
 from multiverso_tpu.utils.timer import Timer
@@ -126,16 +127,71 @@ class DistributedWordEmbedding:
         return decayed_lr(opt.init_learning_rate, self.comm.get_word_count(),
                           opt.total_words, opt.epoch)
 
+    def _block_scan_fn(self, step):
+        """One jit'd program scanning the train step over a whole block's
+        stacked batches: the device-plane path pays ONE upload + ONE
+        dispatch per block instead of one per batch (the tunnel's
+        per-transfer cost dwarfs the payload). Retraces per distinct
+        batch-count, which block sizing keeps to a handful."""
+        if getattr(self, "_block_scan_cache", None) is None \
+                or self._block_scan_cache[0] is not step:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            def run(state, inputs, imask, outputs, labels, omask, lr):
+                def body(st, x):
+                    return step(st, *x, lr)
+                st, losses = lax.scan(body, state,
+                                      (inputs, imask, outputs, labels,
+                                       omask))
+                return st, jnp.sum(losses)
+
+            # donate the block state: the fetch path hands this jit its own
+            # buffers (jnp.copy in request_parameter_device keeps the
+            # originals alive for the delta push), so the scan may update
+            # the row matrices in place
+            self._block_scan_cache = (step, jax.jit(run,
+                                                    donate_argnums=(0,)))
+        return self._block_scan_cache[1]
+
     def _train_block(self, block: DataBlock, step) -> tuple:
         if not block.batches:
             return 0.0, 0
         import jax.numpy as jnp
         pre = getattr(block, "_prefetched", None)
         if self.opt.device_plane:
-            # rows gathered, trained, and pushed without leaving HBM
+            # rows gathered, trained, and pushed without leaving HBM;
+            # all batches ride one stacked upload + one scanned dispatch
             state, fetched = self.comm.request_parameter_device(
                 block.input_rows, block.output_rows)
-        elif pre is not None:
+            bs = block.batches
+            inputs = np.searchsorted(
+                block.input_rows,
+                np.stack([b.inputs for b in bs])).astype(np.int32)
+            outputs = np.searchsorted(
+                block.output_rows,
+                np.stack([b.outputs for b in bs])).astype(np.int32)
+            imask = np.stack([b.input_mask for b in bs])
+            labels = np.stack([b.labels for b in bs])
+            omask = np.stack([b.output_mask for b in bs])
+            # pad the batch COUNT to a bucket: a fresh scan length would
+            # recompile the whole block program (~10s over the tunnel);
+            # all-zero-mask batches are exact no-ops for every update rule
+            pad = next_bucket(len(bs), min_bucket=4) - len(bs)
+            if pad:
+                z = lambda a: np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                inputs, outputs = z(inputs), z(outputs)
+                imask, labels, omask = z(imask), z(labels), z(omask)
+            state, loss_dev = self._block_scan_fn(step)(
+                state, jnp.asarray(inputs), jnp.asarray(imask),
+                jnp.asarray(outputs), jnp.asarray(labels),
+                jnp.asarray(omask), jnp.float32(self._current_lr()))
+            self.comm.add_delta_parameter_device(
+                state, fetched, block.input_rows, block.output_rows)
+            return float(loss_dev), sum(b.count for b in bs)
+        if pre is not None:
             state, fetched = pre
         else:
             state, fetched = self.comm.request_parameter(block.input_rows,
@@ -143,7 +199,7 @@ class DistributedWordEmbedding:
         # remap global row ids -> block-local indices
         in_map = block.input_rows
         out_map = block.output_rows
-        loss_sum = 0.0
+        losses = []
         pairs = 0
         lr = jnp.float32(self._current_lr())
         for batch in block.batches:
@@ -154,14 +210,11 @@ class DistributedWordEmbedding:
                                jnp.asarray(local_out),
                                jnp.asarray(batch.labels),
                                jnp.asarray(batch.output_mask), lr)
-            loss_sum += float(loss)
-            pairs += batch.count
-        if self.opt.device_plane:
-            self.comm.add_delta_parameter_device(
-                state, fetched, block.input_rows, block.output_rows)
-        else:
-            self.comm.add_delta_parameter(state, fetched, block.input_rows,
-                                          block.output_rows)
+            losses.append(loss)   # device scalar: fetch ONCE per block —
+            pairs += batch.count  # a per-batch fetch is a sync round-trip
+        loss_sum = float(jnp.sum(jnp.stack(losses))) if losses else 0.0
+        self.comm.add_delta_parameter(state, fetched, block.input_rows,
+                                      block.output_rows)
         return loss_sum, pairs
 
     # -- export (word2vec format) -------------------------------------------

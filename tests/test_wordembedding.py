@@ -170,7 +170,8 @@ class TestEndToEnd:
         opt_h, _ = _run(tmp_path / "host", use_adagrad=True,
                         init_learning_rate=0.1, is_pipeline=False)
         opt_d, _ = _run(tmp_path / "dev", use_adagrad=True,
-                        init_learning_rate=0.1, device_plane=True)
+                        init_learning_rate=0.1, device_plane=True,
+                        is_pipeline=False)
         host = open(opt_h.output_file).read().splitlines()[1:]
         dev = open(opt_d.output_file).read().splitlines()[1:]
         hv = {l.split()[0]: np.array(l.split()[1:], np.float64)
