@@ -112,7 +112,7 @@ class Model:
     def train_window(self, window: Window) -> float:
         """Train on one window of minibatches; returns summed train loss
         (reference Model::Update, model.cpp:64-110)."""
-        loss_total = 0.0
+        losses = []
         for batch in window.batches:
             self._timer.Start()
             lr = jnp.float32(self.updater.learning_rate())
@@ -133,10 +133,10 @@ class Model:
                     self.W, jnp.asarray(batch.dense, self.config.compute_type),
                     jnp.asarray(batch.labels), jnp.asarray(batch.weights), lr)
             self.updater.tick()
-            loss_total += float(loss)
+            losses.append(loss)   # device scalar: fetched ONCE per window —
             self.computation_time_ms += self._timer.elapse_ms()
-            self.compute_count += 1
-        return loss_total
+            self.compute_count += 1  # a per-batch fetch is a sync round-trip
+        return float(jnp.sum(jnp.stack(losses))) if losses else 0.0
 
     # -- inference ----------------------------------------------------------
 
