@@ -131,12 +131,15 @@ class MatrixServerTable(ServerTable):
 
         block_rows = self.block_rows
         updater = self.updater
+        single = self.num_servers == 1
 
         def _local_lanes(ids):
             """Map the replicated global id vector to this shard's rows.
 
-            Lanes owned elsewhere (and -1 padding) go to the trash row."""
-            s = lax.axis_index(SERVER_AXIS)
+            Lanes owned elsewhere (and -1 padding) go to the trash row.
+            On the 1-server fast path the shard index is the constant 0
+            (these fns run outside shard_map there)."""
+            s = 0 if single else lax.axis_index(SERVER_AXIS)
             shard_of = jnp.where(ids >= 0, ids // block_rows, -1)
             mine = shard_of == s
             safe = jnp.where(mine, ids - s * block_rows, block_rows)
@@ -194,6 +197,13 @@ class MatrixServerTable(ServerTable):
             if deltas.shape[-1] != store_cols:   # logical cols in, pad zeros
                 deltas = jnp.pad(
                     deltas, ((0, 0), (0, store_cols - deltas.shape[-1])))
+            if single:
+                # 1-server fast path: identical lane semantics (pad lanes
+                # -> trash row) without the shard_map wrapper/psum — the
+                # single-chip case compiles a leaner program
+                data, aux = _update_rows_local(state["data"], state["aux"],
+                                               ids, deltas, opt)
+                return {"data": data, "aux": aux}
             data, aux = jax.shard_map(
                 _update_rows_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
@@ -229,9 +239,14 @@ class MatrixServerTable(ServerTable):
             # slice the storage pad off BEFORE the psum: only logical
             # columns ride ICI
             rows = jnp.where(mine[:, None], rows[:, :num_cols_], 0)
+            if single:
+                return rows  # no peers to sum with
             return lax.psum(rows, SERVER_AXIS)
 
         def _gather_rows(data, aux, ids):
+            if single:
+                # 1-server fast path (see _update_rows)
+                return _gather_rows_local(data, aux, ids)
             return jax.shard_map(
                 _gather_rows_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P()),
