@@ -314,13 +314,18 @@ def bench_we_app(np, rng, tmpdir="/tmp/mvt_bench_we"):
                  pair_batch_size=4096, init_learning_rate=0.05,
                  use_adagrad=True, device_plane=True, is_pipeline=False)
     # time the TRAIN phase (the reference's logged words/sec is training
-    # too, trainer.cpp:45-49); dictionary/sampler/table setup excluded
-    we = DistributedWordEmbedding(opt)
-    we.prepare()
-    t0 = time.perf_counter()
-    loss = we.train()
-    secs = time.perf_counter() - t0
-    we.close()
+    # too, trainer.cpp:45-49); dictionary/sampler/table setup excluded.
+    # First instance warms every jit compile (shared in-process cache);
+    # min-of-2 sheds tunnel hiccups.
+    loss = 0.0
+    secs = float("inf")
+    for _ in range(2):
+        we = DistributedWordEmbedding(opt)
+        we.prepare()
+        t0 = time.perf_counter()
+        loss = we.train()
+        secs = min(secs, time.perf_counter() - t0)
+        we.close()
     if not (loss == loss and loss > 0):
         _fail("we_app_words_per_sec", f"bad loss {loss}", "words/s")
     return n_words / secs
@@ -418,12 +423,9 @@ def main() -> int:
     jax, platform = _init_jax_guarded()
     import numpy as np
     rng = np.random.default_rng(0)
+    # headline: failures here fail the bench (it IS the metric)
     tpu_sps, cpu_sps = bench_logreg(np, rng)
-    we_pps = bench_wordembedding(np, rng)
-    we_app_wps = bench_we_app(np, rng)
-    dev_me, host_me, base_me = bench_matrix_table(np, rng)
-    kv_me = bench_kv_table(np, rng)
-    print(json.dumps({
+    out = {
         "metric": "logreg_train_samples_per_sec",
         "value": round(tpu_sps),
         "unit": "samples/s",
@@ -433,19 +435,50 @@ def main() -> int:
         "config": f"dense sigmoid LR, {LR_FEATURES} features, "
                   f"batch {LR_BATCH}, {LR_STEPS} steps, bf16 matmuls / "
                   "f32 weights+grads (loss parity vs f32 numpy asserted)",
-        "matrix_table_device_Melem_s": round(dev_me, 1),
-        "matrix_table_host_Melem_s": round(host_me, 1),
-        "matrix_table_numpy_baseline_Melem_s": round(base_me, 1),
-        "matrix_config": f"{N_ROWS}x{N_COLS} f32, {ROW_FRACTION:.0%} "
-                         f"rows/op, {ROUNDS} rounds",
-        "we_pairs_per_sec": round(we_pps),
-        "we_config": f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
-                     f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad",
-        "we_app_words_per_sec": round(we_app_wps),
-        "kv_push_pull_Melem_s": round(kv_me, 1),
-        "kv_config": f"int64 keys, {KV_KEYSPACE} keyspace, "
-                     f"{KV_BATCH}/op, {KV_ROUNDS} rounds",
-    }))
+    }
+
+    # secondaries: record an error note instead of zeroing the headline
+    def section(fn, fill):
+        try:
+            fill(fn(np, rng))
+        except SystemExit:          # a section's _fail: escalate honestly
+            raise
+        except Exception as exc:    # pragma: no cover - env hiccups
+            try:                    # leave no half-open world behind
+                import multiverso_tpu as mv
+                mv.MV_ShutDown()
+            except Exception:
+                pass
+            out.setdefault("section_errors", []).append(
+                f"{fn.__name__}: {exc!r}")
+
+    def fill_we(pps):
+        out["we_pairs_per_sec"] = round(pps)
+        out["we_config"] = (f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
+                            f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad")
+
+    def fill_we_app(wps):
+        out["we_app_words_per_sec"] = round(wps)
+
+    def fill_matrix(res):
+        dev_me, host_me, base_me = res
+        out["matrix_table_device_Melem_s"] = round(dev_me, 1)
+        out["matrix_table_host_Melem_s"] = round(host_me, 1)
+        out["matrix_table_numpy_baseline_Melem_s"] = round(base_me, 1)
+        out["matrix_config"] = (f"{N_ROWS}x{N_COLS} f32, "
+                                f"{ROW_FRACTION:.0%} rows/op, "
+                                f"{ROUNDS} rounds")
+
+    def fill_kv(me):
+        out["kv_push_pull_Melem_s"] = round(me, 1)
+        out["kv_config"] = (f"int64 keys, {KV_KEYSPACE} keyspace, "
+                            f"{KV_BATCH}/op, {KV_ROUNDS} rounds")
+
+    section(bench_wordembedding, fill_we)
+    section(bench_we_app, fill_we_app)
+    section(bench_matrix_table, fill_matrix)
+    section(bench_kv_table, fill_kv)
+    print(json.dumps(out))
     return 0
 
 
