@@ -93,6 +93,30 @@ class TestMatrixOracle:
             mv.MV_Init([])  # hand mv_env a live world to tear down
 
 
+class TestMatrixOraclePallas:
+    def test_random_walk_through_pallas_kernels(self, mv_env):
+        """Same oracle walk with -use_pallas=on: the interpreter runs the
+        actual kernel code (fused RMW, row gather) inside the PS path."""
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        SetCMDFlag("use_pallas", "on")
+        try:
+            rng = np.random.default_rng(12)
+            R, C = 24, 8
+            table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=R,
+                                                            num_cols=C))
+            oracle = np.zeros((R, C), np.float32)
+            for _ in range(12):
+                k = int(rng.integers(1, R + 1))
+                ids = rng.integers(0, R, k).astype(np.int32)
+                deltas = rng.standard_normal((k, C)).astype(np.float32)
+                table.AddRows(ids, deltas)
+                np.add.at(oracle, ids, deltas)
+                np.testing.assert_allclose(table.GetRows(ids), oracle[ids],
+                                           rtol=1e-5, atol=1e-5)
+        finally:
+            SetCMDFlag("use_pallas", "auto")
+
+
 class TestArrayKVOracle:
     @pytest.mark.parametrize("seed", [7, 8])
     def test_array_and_kv_walk(self, mv_env, seed):
