@@ -122,7 +122,9 @@ class TorchParamManager(MVModelParamManager):
         import torch
         with torch.no_grad():
             for p, arr in zip(self._params, _unflatten(vec, self._shapes)):
-                p.copy_(torch.from_numpy(np.ascontiguousarray(arr)))
+                # explicit copy: the unflattened view may be read-only and
+                # torch.from_numpy refuses non-writable arrays
+                p.copy_(torch.from_numpy(np.array(arr, copy=True)))
 
 
 class SyncCallback:
