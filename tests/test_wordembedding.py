@@ -181,6 +181,18 @@ class TestEndToEnd:
         for w in hv:
             np.testing.assert_allclose(dv[w], hv[w], rtol=1e-3, atol=1e-4)
 
+    def test_device_plane_cbow_and_hs(self, tmp_path):
+        """The device-plane path must serve every model variant (CBOW,
+        hierarchical softmax), not just skipgram+NEG."""
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        _, loss_cbow = _run(tmp_path / "a", cbow=True, device_plane=True,
+                            is_pipeline=False)
+        assert loss_cbow < 0.69 * 4 * 0.9
+        _, loss_hs = _run(tmp_path / "b", hs=True, negative_num=0,
+                          device_plane=True, is_pipeline=False)
+        assert loss_hs > 0
+
     def test_binary_output(self, tmp_path):
         opt, _ = _run(tmp_path, output_binary=True)
         raw = open(opt.output_file, "rb").read()
