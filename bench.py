@@ -43,7 +43,7 @@ import time
 LR_FEATURES = 47_236
 LR_BATCH = 1024
 LR_STAGED_BATCHES = 8
-LR_STEPS = 400
+LR_STEPS = 1600
 LR_BASE_STEPS = 40          # numpy baseline steps (extrapolated)
 LR_LR = 0.1
 
@@ -51,7 +51,7 @@ LR_LR = 0.1
 N_ROWS = 1_000_000
 N_COLS = 50
 ROW_FRACTION = 0.01
-ROUNDS = 100
+ROUNDS = 300
 HOST_ROUNDS = 3
 
 # KVTable sparse push-pull config (BASELINE.json config matrix: "KVTable
@@ -67,7 +67,7 @@ WE_DIM = 128
 WE_PAIRS = 8192          # pair batch per step
 WE_NEG = 5
 WE_STAGED = 8            # staged batches scanned per rep
-WE_STEPS = 160
+WE_STEPS = 640
 
 INIT_TIMEOUT_S = 120
 
