@@ -196,6 +196,38 @@ def bench_logreg(np, rng):
     return total / tpu_secs, total / cpu_secs
 
 
+def bench_sparse_matrix(np, rng):
+    """-> Melem/s of the SparseMatrixTable dirty-row protocol (reference
+    TestSparsePerf, test_matrix_perf.cpp:129-155: add p% of rows, a Get
+    ships only the rows stale for the requesting worker)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import SparseMatrixTableOption
+    from multiverso_tpu.updaters.base import AddOption, GetOption
+
+    mv.MV_Init(["-num_workers=2"])
+    try:
+        table = mv.MV_CreateTable(SparseMatrixTableOption(
+            num_rows=N_ROWS, num_cols=N_COLS))
+        k = int(N_ROWS * ROW_FRACTION)
+        ids = rng.choice(N_ROWS, size=k, replace=False).astype(np.int32)
+        deltas = rng.standard_normal((k, N_COLS)).astype(np.float32)
+        # warm (compiles + dirty-bit init)
+        table.AddRows(ids, deltas, AddOption(worker_id=0))
+        got_ids, rows = table.Get(GetOption(worker_id=1))
+        if sorted(got_ids.tolist()) != sorted(ids.tolist()):
+            _fail("sparse_matrix", "dirty-row set mismatch", "Melem/s")
+        elems = 0
+        t0 = time.perf_counter()
+        for _ in range(HOST_ROUNDS):
+            table.AddRows(ids, deltas, AddOption(worker_id=0))
+            got_ids, rows = table.Get(GetOption(worker_id=1))
+            elems += deltas.size + rows.size
+        secs = time.perf_counter() - t0
+    finally:
+        mv.MV_ShutDown()
+    return elems / secs / 1e6
+
+
 def bench_kv_table(np, rng):
     """-> Melem/s of KV sparse push-pull through the blocking protocol verbs
     (BASELINE config matrix; reference kv_table.h has no published number —
@@ -474,6 +506,9 @@ def main() -> int:
                                 f"{ROW_FRACTION:.0%} rows/op, "
                                 f"{ROUNDS} rounds")
 
+    def fill_sparse(me):
+        out["sparse_matrix_host_Melem_s"] = round(me, 1)
+
     def fill_kv(me):
         out["kv_push_pull_Melem_s"] = round(me, 1)
         out["kv_config"] = (f"int64 keys, {KV_KEYSPACE} keyspace, "
@@ -482,6 +517,7 @@ def main() -> int:
     section(bench_wordembedding, fill_we)
     section(bench_we_app, fill_we_app)
     section(bench_matrix_table, fill_matrix)
+    section(bench_sparse_matrix, fill_sparse)
     section(bench_kv_table, fill_kv)
     print(json.dumps(out))
     return 0
