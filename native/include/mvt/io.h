@@ -1,0 +1,63 @@
+// Stream IO with URI scheme dispatch — native equivalent of reference
+// include/multiverso/io/io.h (URI, Stream, StreamFactory, TextReader) and
+// src/io/local_stream.cpp. Schemes: "file://" (and bare paths) are local
+// files; "hdfs://" is gated exactly like the reference's
+// MULTIVERSO_USE_HDFS build flag (src/io/io.cpp:14-17) — unregistered
+// schemes fail loudly.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+
+namespace mvt {
+
+struct UriC {
+  explicit UriC(const std::string& uri);
+  std::string scheme;  // empty for bare paths
+  std::string path;
+};
+
+class StreamC {
+ public:
+  StreamC(const std::string& path, const char* mode);
+  ~StreamC();
+  StreamC(const StreamC&) = delete;
+  StreamC& operator=(const StreamC&) = delete;
+
+  bool ok() const { return f_ != nullptr; }
+  size_t Read(void* buf, size_t n);
+  void Write(const void* buf, size_t n);
+  // length-framed helpers matching the python Stream verbs (utils/io.py)
+  void WriteInt(int64_t v);
+  int64_t ReadInt();
+  void WriteStr(const std::string& s);
+  std::string ReadStr();
+
+ private:
+  std::FILE* f_ = nullptr;
+};
+
+class StreamFactoryC {
+ public:
+  // nullptr (with a fatal log) for unregistered schemes (hdfs, ...)
+  static std::unique_ptr<StreamC> GetStream(const std::string& uri,
+                                            const char* mode);
+};
+
+// Line reader over a StreamC (reference TextReader, io.h:106-130)
+class TextReaderC {
+ public:
+  explicit TextReaderC(std::unique_ptr<StreamC> stream);
+  // false at EOF; strips the trailing newline
+  bool GetLine(std::string* line);
+
+ private:
+  std::unique_ptr<StreamC> stream_;
+  std::string buf_;
+  size_t pos_ = 0;
+  bool eof_ = false;
+};
+
+}  // namespace mvt

@@ -108,6 +108,12 @@ class TableC {
   void GetAll(float* out, size_t n) const;
   void GetRows(const int* row_ids, int n_rows, float* out) const;
 
+  // Serializable contract (reference table_interface.h:61-79): dims then
+  // raw f32 payload, host-endian — matches the python tables' format on
+  // the little-endian hosts TPU jobs run on
+  void Store(class StreamC* stream) const;
+  void Load(class StreamC* stream);
+
  private:
   size_t rows_, cols_;
   std::vector<float> data_;

@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cstring>
 
+#include "mvt/io.h"
 #include "mvt/log.h"
 
 namespace mvt {
@@ -118,6 +119,21 @@ void TableC::GetRows(const int* row_ids, int n_rows, float* out) const {
                 data_.data() + static_cast<size_t>(row_ids[r]) * cols_,
                 cols_ * sizeof(float));
   }
+}
+
+void TableC::Store(StreamC* stream) const {
+  stream->WriteInt(static_cast<int64_t>(rows_));
+  stream->WriteInt(static_cast<int64_t>(cols_));
+  stream->Write(data_.data(), data_.size() * sizeof(float));
+}
+
+void TableC::Load(StreamC* stream) {
+  int64_t rows = stream->ReadInt();
+  int64_t cols = stream->ReadInt();
+  MVT_CHECK(rows == static_cast<int64_t>(rows_) &&
+            cols == static_cast<int64_t>(cols_));
+  MVT_CHECK(stream->Read(data_.data(), data_.size() * sizeof(float)) ==
+            data_.size() * sizeof(float));
 }
 
 // -- vector clock (reference server.cpp:81-137) -----------------------------

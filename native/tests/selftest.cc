@@ -13,6 +13,7 @@
 #include "mvt/blob.h"
 #include "mvt/c_api.h"
 #include "mvt/configure.h"
+#include "mvt/io.h"
 #include "mvt/mt_queue.h"
 #include "mvt/store.h"
 #include "mvt/waiter.h"
@@ -194,12 +195,65 @@ static void test_reader() {
   std::printf("reader OK\n");
 }
 
+static void test_io_and_serializable() {
+  // URI dispatch + framed stream verbs + TextReader (reference io.h) and
+  // TableC Store/Load (reference table_interface.h:61-79)
+  const char* path = "/tmp/mvt_selftest_io.bin";
+  {
+    auto s = mvt::StreamFactoryC::GetStream(
+        std::string("file://") + path, "wb");
+    assert(s != nullptr);
+    s->WriteInt(42);
+    s->WriteStr("hello");
+  }
+  {
+    auto s = mvt::StreamFactoryC::GetStream(path, "rb");  // bare path too
+    assert(s != nullptr);
+    assert(s->ReadInt() == 42);
+    assert(s->ReadStr() == "hello");
+  }
+  assert(mvt::StreamFactoryC::GetStream("hdfs://h/p", "rb") == nullptr);
+  {
+    auto w = mvt::StreamFactoryC::GetStream(path, "wb");
+    w->Write("a b\nc\n\nd", 8);
+  }
+  {
+    mvt::TextReaderC reader(mvt::StreamFactoryC::GetStream(path, "rb"));
+    std::string line;
+    assert(reader.GetLine(&line) && line == "a b");
+    assert(reader.GetLine(&line) && line == "c");
+    assert(reader.GetLine(&line) && line.empty());
+    assert(reader.GetLine(&line) && line == "d");
+    assert(!reader.GetLine(&line));
+  }
+  // table round-trip
+  mvt::TableC t(3, 2, "default", 1);
+  mvt::AddOptionC opt;
+  std::vector<float> d = {1, 2, 3, 4, 5, 6};
+  t.AddAll(d.data(), 6, opt);
+  {
+    auto s = mvt::StreamFactoryC::GetStream(path, "wb");
+    t.Store(s.get());
+  }
+  t.AddAll(d.data(), 6, opt);  // diverge
+  {
+    auto s = mvt::StreamFactoryC::GetStream(path, "rb");
+    t.Load(s.get());
+  }
+  std::vector<float> out(6);
+  t.GetAll(out.data(), 6);
+  for (int i = 0; i < 6; ++i) assert(out[i] == d[i]);
+  std::remove(path);
+  std::printf("io + serializable OK\n");
+}
+
 int main() {
   test_utils();
   test_async_tables();
   test_sync_bsp();
   test_updaters();
   test_reader();
+  test_io_and_serializable();
   std::printf("ALL NATIVE TESTS OK\n");
   return 0;
 }
