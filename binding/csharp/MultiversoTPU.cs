@@ -43,6 +43,8 @@ namespace MultiversoTPU
         [DllImport(Lib)] internal static extern void MV_GetMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
         [DllImport(Lib)] internal static extern void MV_AddMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
         [DllImport(Lib)] internal static extern void MV_AddAsyncMatrixTableByRows(IntPtr handler, float[] data, int size, int[] rowIds, int rowIdsN);
+        [DllImport(Lib)] internal static extern int MV_StoreTable(IntPtr handler, string uri);
+        [DllImport(Lib)] internal static extern int MV_LoadTable(IntPtr handler, string uri);
     }
 
     /// <summary>Static facade mirroring MultiversoCLR.MultiversoWrapper.</summary>
@@ -87,6 +89,13 @@ namespace MultiversoTPU
         public static int Rank() => Native.MV_WorkerId();
         public static int Size() => Native.MV_NumWorkers();
         public static void Barrier() => Native.MV_Barrier();
+
+        // Table persistence over the native stream layer (extension over
+        // the reference ABI): true on success.
+        public static bool StoreTable(int tableId, string uri)
+            => Native.MV_StoreTable(Tables[tableId].Handle, uri) == 0;
+        public static bool LoadTable(int tableId, string uri)
+            => Native.MV_LoadTable(Tables[tableId].Handle, uri) == 0;
 
         /// <summary>Create several tables at once (reference CreateTables).
         /// eleTypes must be "float" — the C ABI is float-only.</summary>

@@ -52,3 +52,15 @@ function ArrayTableHandler:add(data, sync)
 end
 
 return ArrayTableHandler
+
+-- Persist / restore this table via the native stream layer
+-- (MV_StoreTable/MV_LoadTable; extension over the reference ABI).
+function ArrayTableHandler:store(uri)
+    local mv = require('multiverso.init')
+    return mv.C.MV_StoreTable(self._h, uri) == 0
+end
+
+function ArrayTableHandler:load(uri)
+    local mv = require('multiverso.init')
+    return mv.C.MV_LoadTable(self._h, uri) == 0
+end

@@ -79,3 +79,15 @@ function MatrixTableHandler:add(data, row_ids, sync)
 end
 
 return MatrixTableHandler
+
+-- Persist / restore this table via the native stream layer
+-- (MV_StoreTable/MV_LoadTable; extension over the reference ABI).
+function MatrixTableHandler:store(uri)
+    local mv = require('multiverso.init')
+    return mv.C.MV_StoreTable(self._h, uri) == 0
+end
+
+function MatrixTableHandler:load(uri)
+    local mv = require('multiverso.init')
+    return mv.C.MV_LoadTable(self._h, uri) == 0
+end

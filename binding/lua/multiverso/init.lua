@@ -30,6 +30,8 @@ int  MV_NumWorkers();
 int  MV_WorkerId();
 int  MV_ServerId();
 void MV_SetThreadWorkerId(int worker_id);
+int  MV_StoreTable(TableHandler handler, const char* uri);
+int  MV_LoadTable(TableHandler handler, const char* uri);
 
 void MV_NewArrayTable(int size, TableHandler* out);
 void MV_GetArrayTable(TableHandler handler, float* data, int size);

@@ -41,6 +41,13 @@ void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
 // Worker identity for multi-threaded native clients (thread-local).
 void MV_SetThreadWorkerId(int worker_id);
 
+/* Table persistence for native clients (extension over the reference C
+ * ABI, which has none; semantics = the Serializable contract,
+ * table_interface.h:61-79). URI schemes per the native stream layer
+ * (file:// or bare paths). Returns 0 on success, -1 on stream errors. */
+int MV_StoreTable(TableHandler handler, const char* uri);
+int MV_LoadTable(TableHandler handler, const char* uri);
+
 // -- fast data readers (TPU-build addition: the host-side parse loop is the
 //    reader bottleneck; python calls these via ctypes) ----------------------
 

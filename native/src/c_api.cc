@@ -10,6 +10,7 @@
 #include <vector>
 
 #include "mvt/configure.h"
+#include "mvt/io.h"
 #include "mvt/log.h"
 #include "mvt/store.h"
 
@@ -123,6 +124,24 @@ void MV_NewArrayTable(int size, TableHandler* out) {
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
   *out = new_table(static_cast<size_t>(num_row),
                    static_cast<size_t>(num_col));
+}
+
+int MV_StoreTable(TableHandler handler, const char* uri) {
+  auto* ref = static_cast<TableRef*>(handler);
+  MV_Barrier();  // drain in-flight async adds before snapshotting
+  auto stream = mvt::StreamFactoryC::GetStream(uri, "wb");
+  if (stream == nullptr) return -1;
+  rt().server->table(ref->table_id)->Store(stream.get());
+  return 0;
+}
+
+int MV_LoadTable(TableHandler handler, const char* uri) {
+  auto* ref = static_cast<TableRef*>(handler);
+  MV_Barrier();
+  auto stream = mvt::StreamFactoryC::GetStream(uri, "rb");
+  if (stream == nullptr) return -1;
+  rt().server->table(ref->table_id)->Load(stream.get());
+  return 0;
 }
 
 static void do_get(TableHandler handler, float* data, int size,

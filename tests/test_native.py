@@ -78,6 +78,23 @@ class TestCApiFromPython:
         assert capi.MV_NumWorkers() == 1
         assert capi.MV_WorkerId() == 0
 
+    def test_store_load_table(self, capi, tmp_path):
+        """MV_StoreTable/MV_LoadTable: native-client persistence over the
+        native stream layer (extension — the reference C ABI has none)."""
+        handle = ctypes.c_void_p()
+        capi.MV_NewArrayTable(6, ctypes.byref(handle))
+        data = np.arange(6, dtype=np.float32)
+        fptr = ctypes.POINTER(ctypes.c_float)
+        capi.MV_AddArrayTable(handle, data.ctypes.data_as(fptr), 6)
+        uri = str(tmp_path / "t.bin").encode()
+        assert capi.MV_StoreTable(handle, uri) == 0
+        capi.MV_AddArrayTable(handle, data.ctypes.data_as(fptr), 6)  # diverge
+        assert capi.MV_LoadTable(handle, uri) == 0
+        out = np.zeros(6, np.float32)
+        capi.MV_GetArrayTable(handle, out.ctypes.data_as(fptr), 6)
+        np.testing.assert_allclose(out, data)
+        assert capi.MV_LoadTable(handle, b"hdfs://h/p") == -1
+
 
 class TestNativeReader:
     def test_parse_libsvm(self, native_build):
