@@ -41,6 +41,7 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_LoadCheckpoint,
     MV_StartProfiler,
     MV_StopProfiler,
+    MV_WorkerContext,
 )
 
 __version__ = "0.1.0"

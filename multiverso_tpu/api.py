@@ -119,6 +119,15 @@ def MV_LoadCheckpoint(uri: str) -> int:
     return load_checkpoint(uri)
 
 
+def MV_WorkerContext(worker_id: int):
+    """Bind the calling thread to a worker id for the ``with`` block —
+    in-process worker threads stand in for the reference's MPI rank
+    workers (``-num_workers=N``); table verbs issued inside carry this
+    worker id (per-worker AdaGrad state, BSP clocks, dirty-row bits)."""
+    from multiverso_tpu.zoo import Zoo
+    return Zoo.Get().worker_context(worker_id)
+
+
 def MV_StartProfiler(logdir: str) -> None:
     """Start a JAX profiler trace (xplane) into ``logdir`` — the
     device-side complement of the host-side Monitor dashboard (SURVEY.md
