@@ -17,6 +17,12 @@ import threading
 import time
 from typing import IO, Optional
 
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_bool
+
+# reference src/util/log.cpp:11: when true, messages go to stderr even if
+# a file sink is configured (glog-style)
+MV_DEFINE_bool("logtostderr", False, "log to stderr instead of the file sink")
+
 
 class LogLevel(enum.IntEnum):
     Debug = 0
@@ -67,9 +73,14 @@ class Logger:
                 rank = ""
         line = f"[{level.name.upper()}] [{stamp}]{rank} {msg}"
         with self._lock:
-            sink = self._file if self._file else sys.stderr
+            try:
+                to_stderr = bool(GetFlag("logtostderr"))
+            except Exception:  # registry torn down mid-shutdown
+                to_stderr = False
+            sink = self._file if (self._file and not to_stderr) else sys.stderr
             print(line, file=sink, flush=True)
-            if self._file:  # mirror fatal to stderr as the reference does
+            if self._file and not to_stderr:
+                # mirror errors to stderr as the reference does
                 if level >= LogLevel.Error:
                     print(line, file=sys.stderr, flush=True)
 

@@ -2,7 +2,18 @@
 
 #include <ctime>
 
+#include "mvt/configure.h"
+
 namespace mvt {
+
+namespace {
+// reference src/util/log.cpp:11: stderr instead of the file sink when set
+const bool kFlagRegistered = [] {
+  config::Define("logtostderr", false,
+                 "log to stderr instead of the file sink");
+  return true;
+}();
+}  // namespace
 
 Logger& Logger::Get() {
   static Logger logger;
@@ -39,7 +50,8 @@ void Logger::Write(LogLevel level, const char* fmt, ...) {
   std::time_t now = std::time(nullptr);
   std::strftime(stamp, sizeof(stamp), "%F %T", std::localtime(&now));
   std::lock_guard<std::mutex> lk(mu_);
-  std::FILE* sink = file_ != nullptr ? file_ : stderr;
+  const bool to_stderr = config::GetBool("logtostderr");
+  std::FILE* sink = (file_ != nullptr && !to_stderr) ? file_ : stderr;
   std::fprintf(sink, "[%s] [%s] %s\n", level_name(level), stamp, body);
   std::fflush(sink);
 }

@@ -50,6 +50,25 @@ class TestConfigure:
 
 
 class TestLog:
+    def test_logtostderr_overrides_file_sink(self, tmp_path, capsys):
+        """-logtostderr=true routes past a configured file sink
+        (reference log.cpp:11, glog-style)."""
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        from multiverso_tpu.utils.log import Logger, LogLevel
+        path = str(tmp_path / "log.txt")
+        logger = Logger()
+        logger.ResetLogFile(path)
+        logger.Write(LogLevel.Info, "to-file")
+        SetCMDFlag("logtostderr", True)
+        try:
+            logger.Write(LogLevel.Info, "to-stderr")
+        finally:
+            SetCMDFlag("logtostderr", False)
+        logger.ResetLogFile("")
+        content = open(path).read()
+        assert "to-file" in content and "to-stderr" not in content
+        assert "to-stderr" in capsys.readouterr().err
+
     def test_fatal_raises(self):
         with pytest.raises(FatalError):
             Log.Fatal("boom %d", 42)
