@@ -54,6 +54,18 @@ class TestBindingApi:
         np.testing.assert_allclose(t.get(), 2.0)
 
 
+class TestNetStubs:
+    def test_net_bind_connect_are_documented_stubs(self):
+        """MV_NetBind/MV_NetConnect exist for API parity and explain why
+        they cannot apply (TPU meshes are wired by hardware, not sockets —
+        reference multiverso.h:54-64)."""
+        import multiverso_tpu as mv
+        with pytest.raises(NotImplementedError):
+            mv.MV_NetBind(0, "tcp://0.0.0.0:5555")
+        with pytest.raises(NotImplementedError):
+            mv.MV_NetConnect([0], ["tcp://127.0.0.1:5555"])
+
+
 class TestParamManager:
     def test_jax_param_manager_sync(self, binding):
         from multiverso_tpu.binding.param_manager import JaxParamManager
