@@ -37,6 +37,8 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_CreateTable,
     MV_SetFlag,
     MV_Aggregate,
+    MV_NetBind,
+    MV_NetConnect,
     MV_SaveCheckpoint,
     MV_LoadCheckpoint,
     MV_StartProfiler,
