@@ -26,8 +26,9 @@ Timing note: on the axon TPU tunnel ``block_until_ready`` does not reliably
 block, so every timed region ends with a forced scalar fetch.
 
 Safety: the axon TPU tunnel is single-client and can wedge; if backend init
-doesn't complete within --init-timeout seconds the bench re-execs itself on
-CPU so the driver never hangs (recorded in the JSON as "cpu-fallback").
+doesn't complete within MVT_BENCH_INIT_TIMEOUT seconds (env var, default
+120) the bench re-execs itself on CPU so the driver never hangs (recorded
+in the JSON as "cpu-fallback").
 """
 
 from __future__ import annotations
@@ -70,7 +71,7 @@ WE_NEG = 5
 WE_STAGED = 8            # staged batches scanned per rep
 WE_STEPS = 640
 
-INIT_TIMEOUT_S = 120
+INIT_TIMEOUT_S = int(os.environ.get("MVT_BENCH_INIT_TIMEOUT", "120"))
 
 
 def _init_jax_guarded():
