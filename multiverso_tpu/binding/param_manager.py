@@ -36,13 +36,19 @@ class MVModelParamManager:
     """Generic delta-sync manager over a flat float32 parameter vector."""
 
     def __init__(self, get_params: Callable[[], np.ndarray],
-                 set_params: Callable[[np.ndarray], None]):
+                 set_params: Callable[[np.ndarray], None], table=None):
         """``get_params()`` returns the current flat parameter vector;
-        ``set_params(vec)`` installs one."""
+        ``set_params(vec)`` installs one. ``table`` shares an existing
+        ArrayTableHandler — in-process worker threads must share ONE table
+        (each process creates its own handler in multi-process jobs, where
+        table ids align across processes like the reference)."""
         self._get = get_params
         self._set = set_params
         init = np.asarray(self._get(), np.float32)
-        self.tbh = mv.ArrayTableHandler(init.size, init_value=init)
+        if table is None:
+            self.tbh = mv.ArrayTableHandler(init.size, init_value=init)
+        else:
+            self.tbh = table
         mv.barrier()
         self.last_synced = self.tbh.get().copy()
         self._set(self.last_synced)
@@ -109,11 +115,11 @@ class JaxParamManager(MVModelParamManager):
 class TorchParamManager(MVModelParamManager):
     """Sync a torch ``nn.Module``'s parameters (CPU tensors)."""
 
-    def __init__(self, model):
+    def __init__(self, model, table=None):
         self._model = model
         self._params = list(model.parameters())
         self._shapes = [tuple(p.shape) for p in self._params]
-        super().__init__(self._get_flat, self._set_flat)
+        super().__init__(self._get_flat, self._set_flat, table=table)
 
     def _get_flat(self) -> np.ndarray:
         return _flatten([p.detach().cpu().numpy() for p in self._params])

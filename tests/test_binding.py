@@ -54,6 +54,47 @@ class TestBindingApi:
         np.testing.assert_allclose(t.get(), 2.0)
 
 
+class TestSharedTableManagers:
+    def test_in_process_workers_share_one_table(self):
+        """Two worker threads with private replicas + ONE shared table:
+        delta-syncs merge both workers' progress (the examples/torch_asgd
+        pattern; multi-process jobs create one handler per process
+        instead)."""
+        import multiverso_tpu as mvt
+        from multiverso_tpu.binding import ArrayTableHandler
+        from multiverso_tpu.binding.param_manager import MVModelParamManager
+        import threading
+        mvt.MV_Init(["-num_workers=2"])
+        try:
+            init = np.zeros(4, np.float32)
+            shared = ArrayTableHandler(4, init_value=init)
+            merged = {}
+
+            def worker(wid):
+                with mvt.MV_WorkerContext(wid):
+                    state = {"v": init.copy()}
+                    mgr = MVModelParamManager(
+                        lambda: state["v"],
+                        lambda vec: state.update(v=vec.copy()),
+                        table=shared)
+                    state["v"] = state["v"] + (wid + 1)  # local progress
+                    mgr.sync_all_param()
+                    mvt.MV_Barrier()      # both pushes landed
+                    mgr.sync_all_param()  # second sync pulls peer's delta
+                    merged[wid] = state["v"].copy()
+
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(2)]
+            [t.start() for t in ts]
+            [t.join(timeout=60) for t in ts]
+            assert not any(t.is_alive() for t in ts)
+            # both deltas (1 and 2) land exactly once
+            np.testing.assert_allclose(merged[0], 3.0)
+            np.testing.assert_allclose(merged[1], 3.0)
+        finally:
+            mvt.MV_ShutDown()
+
+
 class TestNetStubs:
     def test_net_bind_connect_are_documented_stubs(self):
         """MV_NetBind/MV_NetConnect exist for API parity and explain why
