@@ -1,0 +1,23 @@
+#!/bin/sh
+# Build the UNMODIFIED reference (at /root/reference) against the 1-process
+# MPI shim, producing its own Test binary (multiverso.test) so the
+# reference's perf harness (Test/test_matrix_perf.cpp) runs on this host
+# as a measured baseline.
+set -e
+REF=${REF:-/root/reference}
+HERE=$(cd "$(dirname "$0")" && pwd)
+OUT=$HERE/build
+mkdir -p "$OUT"
+SRCS=$(ls "$REF"/src/*.cpp "$REF"/src/net/*.cpp "$REF"/src/table/*.cpp \
+          "$REF"/src/updater/*.cpp "$REF"/src/util/*.cpp \
+          "$REF"/src/io/io.cpp "$REF"/src/io/local_stream.cpp \
+          "$REF"/src/io/hdfs_stream.cpp)
+TESTS=$(ls "$REF"/Test/*.cpp)
+g++ -O2 -std=c++11 -w -pthread -include cstddef -DMULTIVERSO_USE_MPI \
+    -I"$HERE/mpi_stub" -I"$REF/include" -I"$REF" \
+    $SRCS $TESTS -o "$OUT/multiverso.test"
+PERF=$(ls "$REF"/Test/*.cpp | grep -v main.cpp)
+g++ -O2 -std=c++11 -w -pthread -include cstddef -DMULTIVERSO_USE_MPI \
+    -I"$HERE/mpi_stub" -I"$REF/include" -I"$REF" \
+    $SRCS $PERF "$HERE/perf_main.cpp" -o "$OUT/multiverso.perf"
+echo "built $OUT/multiverso.test and $OUT/multiverso.perf"

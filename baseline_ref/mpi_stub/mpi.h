@@ -1,0 +1,185 @@
+// Minimal single-process MPI shim — JUST enough of the MPI-3 surface for
+// the reference Multiverso's MPINetWrapper (mpi_net.h) to run a 1-process
+// world (rank 0 = controller+server+worker; every send is a self-send).
+// Used only to build and run the UNMODIFIED reference as a measured
+// baseline (baseline_ref/README.md); this is not part of the framework.
+#pragma once
+
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+typedef int MPI_Datatype;
+typedef int MPI_Comm;
+typedef int MPI_Op;
+typedef int MPI_Request;
+
+#define MPI_COMM_WORLD 0
+#define MPI_SUCCESS 0
+#define MPI_BYTE 1
+#define MPI_CHAR 2
+#define MPI_INT 3
+#define MPI_FLOAT 4
+#define MPI_DOUBLE 5
+#define MPI_SUM 0
+#define MPI_ANY_SOURCE (-1)
+#define MPI_ANY_TAG (-1)
+#define MPI_THREAD_SINGLE 0
+#define MPI_THREAD_FUNNELED 1
+#define MPI_THREAD_SERIALIZED 2
+#define MPI_THREAD_MULTIPLE 3
+#define MPI_IN_PLACE ((void*)1)
+#define MPI_MAX_PROCESSOR_NAME 256
+
+typedef struct MPI_Status {
+  int MPI_SOURCE;
+  int MPI_TAG;
+  int MPI_ERROR;
+  int count_;  // bytes
+} MPI_Status;
+
+namespace mpi_stub {
+struct Msg {
+  std::vector<char> bytes;
+  int tag;
+};
+inline std::deque<Msg>& queue() {
+  static std::deque<Msg> q;
+  return q;
+}
+inline std::mutex& mu() {
+  static std::mutex m;
+  return m;
+}
+inline int& init_flag() {
+  static int f = 0;
+  return f;
+}
+inline int type_size(MPI_Datatype t) {
+  switch (t) {
+    case MPI_INT: return 4;
+    case MPI_FLOAT: return 4;
+    case MPI_DOUBLE: return 8;
+    default: return 1;  // BYTE / CHAR
+  }
+}
+}  // namespace mpi_stub
+
+inline int MPI_Init(int*, char***) {
+  mpi_stub::init_flag() = 1;
+  return MPI_SUCCESS;
+}
+inline int MPI_Init_thread(int*, char***, int required, int* provided) {
+  mpi_stub::init_flag() = 1;
+  *provided = required;
+  return MPI_SUCCESS;
+}
+inline int MPI_Initialized(int* flag) {
+  *flag = mpi_stub::init_flag();
+  return MPI_SUCCESS;
+}
+inline int MPI_Query_thread(int* provided) {
+  *provided = MPI_THREAD_SERIALIZED;
+  return MPI_SUCCESS;
+}
+inline int MPI_Finalize() {
+  mpi_stub::init_flag() = 0;
+  return MPI_SUCCESS;
+}
+inline int MPI_Comm_rank(MPI_Comm, int* rank) {
+  *rank = 0;
+  return MPI_SUCCESS;
+}
+inline int MPI_Comm_size(MPI_Comm, int* size) {
+  *size = 1;
+  return MPI_SUCCESS;
+}
+inline int MPI_Barrier(MPI_Comm) { return MPI_SUCCESS; }
+
+inline int MPI_Isend(const void* buf, int count, MPI_Datatype type, int /*dst*/,
+                     int tag, MPI_Comm, MPI_Request* req) {
+  // 1-process world: every destination is self; copy eagerly, complete
+  // immediately (the request is a dummy)
+  std::lock_guard<std::mutex> lk(mpi_stub::mu());
+  mpi_stub::Msg m;
+  const char* p = static_cast<const char*>(buf);
+  m.bytes.assign(p, p + static_cast<size_t>(count) * mpi_stub::type_size(type));
+  m.tag = tag;
+  mpi_stub::queue().push_back(std::move(m));
+  *req = 1;
+  return MPI_SUCCESS;
+}
+
+inline void mpi_stub_fill_status(MPI_Status* st, const mpi_stub::Msg& m) {
+  if (st != nullptr) {
+    st->MPI_SOURCE = 0;
+    st->MPI_TAG = m.tag;
+    st->MPI_ERROR = MPI_SUCCESS;
+    st->count_ = static_cast<int>(m.bytes.size());
+  }
+}
+
+inline int MPI_Iprobe(int /*src*/, int /*tag*/, MPI_Comm, int* flag,
+                      MPI_Status* st) {
+  std::lock_guard<std::mutex> lk(mpi_stub::mu());
+  if (mpi_stub::queue().empty()) {
+    *flag = 0;
+  } else {
+    *flag = 1;
+    mpi_stub_fill_status(st, mpi_stub::queue().front());
+  }
+  return MPI_SUCCESS;
+}
+
+inline int MPI_Probe(int src, int tag, MPI_Comm comm, MPI_Status* st) {
+  int flag = 0;
+  while (flag == 0) MPI_Iprobe(src, tag, comm, &flag, st);
+  return MPI_SUCCESS;
+}
+
+inline int MPI_Get_count(const MPI_Status* st, MPI_Datatype type, int* count) {
+  *count = st->count_ / mpi_stub::type_size(type);
+  return MPI_SUCCESS;
+}
+
+inline int MPI_Recv(void* buf, int count, MPI_Datatype type, int /*src*/,
+                    int /*tag*/, MPI_Comm, MPI_Status* st) {
+  for (;;) {
+    std::lock_guard<std::mutex> lk(mpi_stub::mu());
+    if (!mpi_stub::queue().empty()) {
+      mpi_stub::Msg m = std::move(mpi_stub::queue().front());
+      mpi_stub::queue().pop_front();
+      size_t cap = static_cast<size_t>(count) * mpi_stub::type_size(type);
+      std::memcpy(buf, m.bytes.data(),
+                  m.bytes.size() < cap ? m.bytes.size() : cap);
+      mpi_stub_fill_status(st, m);
+      return MPI_SUCCESS;
+    }
+  }
+}
+
+inline int MPI_Wait(MPI_Request*, MPI_Status*) { return MPI_SUCCESS; }
+inline int MPI_Waitall(int, MPI_Request*, MPI_Status*) { return MPI_SUCCESS; }
+inline int MPI_Test(MPI_Request*, int* flag, MPI_Status*) {
+  *flag = 1;
+  return MPI_SUCCESS;
+}
+inline int MPI_Testall(int, MPI_Request*, int* flag, MPI_Status*) {
+  *flag = 1;
+  return MPI_SUCCESS;
+}
+
+inline int MPI_Allreduce(const void* send, void* recv, int count,
+                         MPI_Datatype type, MPI_Op, MPI_Comm) {
+  if (send != MPI_IN_PLACE && send != recv) {
+    std::memcpy(recv, send,
+                static_cast<size_t>(count) * mpi_stub::type_size(type));
+  }
+  return MPI_SUCCESS;  // size-1 sum = identity
+}
+
+inline int MPI_Abort(MPI_Comm, int code) {
+  std::abort();
+  return code;
+}

@@ -1,0 +1,17 @@
+// Entry point for the reference's dense matrix perf harness
+// (Test/test_matrix_perf.cpp TestDensePerf is not wired into the
+// reference's Test/main.cpp dispatch; this main calls it directly).
+namespace multiverso { namespace test {
+void TestDensePerf(int argc, char* argv[]);
+void TestSparsePerf(int argc, char* argv[]);
+} }
+
+#include <cstring>
+
+int main(int argc, char* argv[]) {
+  if (argc > 1 && std::strcmp(argv[1], "sparse") == 0)
+    multiverso::test::TestSparsePerf(argc, argv);
+  else
+    multiverso::test::TestDensePerf(argc, argv);
+  return 0;
+}
