@@ -20,4 +20,14 @@ PERF=$(ls "$REF"/Test/*.cpp | grep -v main.cpp)
 g++ -O2 -std=c++11 -w -pthread -include cstddef -DMULTIVERSO_USE_MPI \
     -I"$HERE/mpi_stub" -I"$REF/include" -I"$REF" \
     $SRCS $PERF "$HERE/perf_main.cpp" -o "$OUT/multiverso.perf"
-echo "built $OUT/multiverso.test and $OUT/multiverso.perf"
+LR="$REF/Applications/LogisticRegression/src"
+LRSRCS=$(find "$LR" -name "*.cpp")
+g++ -O2 -std=c++11 -w -pthread -include cstddef -DMULTIVERSO_USE_MPI \
+    -I"$HERE/mpi_stub" -I"$REF/include" -I"$LR" \
+    $SRCS $LRSRCS -o "$OUT/logistic_regression"
+WE="$REF/Applications/WordEmbedding/src"
+WESRCS=$(find "$WE" -name "*.cpp")
+g++ -O2 -std=c++11 -w -pthread -fopenmp -include cstddef -DMULTIVERSO_USE_MPI \
+    -I"$HERE/mpi_stub" -I"$REF/include" -I"$WE" \
+    $SRCS $WESRCS -o "$OUT/word_embedding"
+echo "built $OUT/multiverso.test, $OUT/multiverso.perf, $OUT/logistic_regression, $OUT/word_embedding"

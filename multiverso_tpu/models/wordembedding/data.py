@@ -185,12 +185,80 @@ class PairGenerator:
                                      count=len(chunk)))
         return batches
 
+    def _skipgram_neg_batches(self, sentences: List[np.ndarray],
+                              batch_size: int) -> List[PairBatch]:
+        """Vectorized skip-gram + NEG pair construction over the whole
+        block (2*window offset passes over the concatenated ids instead of
+        a python loop per pair — the loop capped the app at ~27k words/s).
+        Distributionally identical to pairs_from_sentence: per-center
+        shrunk window b~U[1,w], subsampling keep-rule, unigram^0.75
+        negatives with center-collision lanes masked out."""
+        opt = self.opt
+        lens = np.fromiter((len(s) for s in sentences), np.int64,
+                           len(sentences))
+        ids = np.concatenate(sentences) if sentences else \
+            np.empty(0, np.int32)
+        sent = np.repeat(np.arange(len(sentences)), lens)
+        if opt.sample > 0 and len(ids):
+            keep = self.sampler.KeepMask(ids, opt.sample)
+            ids, sent = ids[keep], sent[keep]
+        if len(ids) == 0:
+            return []
+        # positions within (possibly filtered) sentences
+        _, start_idx, rank, new_lens = np.unique(
+            sent, return_index=True, return_inverse=True, return_counts=True)
+        pos = np.arange(len(ids)) - start_idx[rank]
+        slen = new_lens[rank]
+        b = self.sampler.rand_windows(len(ids), opt.window_size)
+        centers_l, contexts_l = [], []
+        for d in range(-opt.window_size, opt.window_size + 1):
+            if d == 0:
+                continue
+            valid = (np.abs(d) <= b) & (pos + d >= 0) & (pos + d < slen)
+            idx = np.nonzero(valid)[0]
+            centers_l.append(ids[idx])
+            contexts_l.append(ids[idx + d])
+        centers = np.concatenate(centers_l).astype(np.int32)
+        contexts = np.concatenate(contexts_l).astype(np.int32)
+        P = len(centers)
+        if P == 0:
+            return []
+        K = opt.negative_num
+        negs = self.sampler.SampleNegatives((P, K)).astype(np.int32)
+        outputs_all = np.concatenate([centers[:, None], negs], axis=1)
+        omask_all = np.concatenate(
+            [np.ones((P, 1), np.float32),
+             (negs != centers[:, None]).astype(np.float32)], axis=1)
+        labels_row = np.zeros(1 + K, np.float32)
+        labels_row[0] = 1.0
+        batches = []
+        for s0 in range(0, P, batch_size):
+            chunk = slice(s0, min(s0 + batch_size, P))
+            n = chunk.stop - chunk.start
+            inputs = np.zeros((batch_size, 1), np.int32)
+            imask = np.zeros((batch_size, 1), np.float32)
+            outputs = np.zeros((batch_size, 1 + K), np.int32)
+            labels = np.zeros((batch_size, 1 + K), np.float32)
+            omask = np.zeros((batch_size, 1 + K), np.float32)
+            inputs[:n, 0] = contexts[chunk]
+            imask[:n, 0] = 1.0
+            outputs[:n] = outputs_all[chunk]
+            labels[:n] = labels_row
+            omask[:n] = omask_all[chunk]
+            batches.append(PairBatch(inputs, imask, outputs, labels, omask,
+                                     count=n))
+        return batches
+
     def make_block(self, sentences: List[np.ndarray],
                    word_count: int) -> DataBlock:
-        pairs = []
-        for ids in sentences:
-            pairs.extend(self.pairs_from_sentence(ids))
-        batches = self.batch_pairs(pairs, self.opt.pair_batch_size)
+        if not self.opt.cbow and not self.opt.hs:
+            batches = self._skipgram_neg_batches(sentences,
+                                                 self.opt.pair_batch_size)
+        else:
+            pairs = []
+            for ids in sentences:
+                pairs.extend(self.pairs_from_sentence(ids))
+            batches = self.batch_pairs(pairs, self.opt.pair_batch_size)
         if batches:
             input_rows = np.unique(np.concatenate(
                 [(b.inputs[b.input_mask > 0]) for b in batches]))
