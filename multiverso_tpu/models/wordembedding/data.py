@@ -190,9 +190,13 @@ class PairGenerator:
         """Vectorized skip-gram + NEG pair construction over the whole
         block (2*window offset passes over the concatenated ids instead of
         a python loop per pair — the loop capped the app at ~27k words/s).
-        Distributionally identical to pairs_from_sentence: per-center
+        Same marginal distributions as pairs_from_sentence (per-center
         shrunk window b~U[1,w], subsampling keep-rule, unigram^0.75
-        negatives with center-collision lanes masked out."""
+        negatives, center-collision lanes masked instead of dropped), with
+        two documented differences: negatives are drawn independently per
+        pair (the loop shared one draw across a center's context pairs)
+        and pair order is offset-major rather than sentence-major — SGD
+        visits the same pairs in a different, still random-ish order."""
         opt = self.opt
         lens = np.fromiter((len(s) for s in sentences), np.int64,
                            len(sentences))
@@ -250,7 +254,12 @@ class PairGenerator:
         return batches
 
     def make_block(self, sentences: List[np.ndarray],
-                   word_count: int) -> DataBlock:
+                   word_count: int, rng_stream=None) -> DataBlock:
+        # per-block deterministic randomness: the loader spawns streams in
+        # block order (sampler.spawn_stream) so -seed reproduces exactly,
+        # independent of -threads and scheduling
+        if rng_stream is not None:
+            self.sampler.set_thread_stream(rng_stream)
         if not self.opt.cbow and not self.opt.hs:
             batches = self._skipgram_neg_batches(sentences,
                                                  self.opt.pair_batch_size)
@@ -298,24 +307,58 @@ class BlockQueue:
 def start_loader(option, dictionary: Dictionary, generator: PairGenerator,
                  queue: BlockQueue, epochs: int) -> threading.Thread:
     """Background loader: stream the corpus into DataBlocks
-    (reference distributed_wordembedding.cpp:33-57 loader thread)."""
+    (reference distributed_wordembedding.cpp:33-57 loader thread).
+
+    ``-threads N`` (the reference's trainer-thread knob; training here is
+    one jit stream, so the threads go where the host work is) prepares
+    blocks in a pool — pair construction is numpy-heavy and releases the
+    GIL, so block prep scales while training consumes in order."""
+
+    workers = max(1, int(getattr(option, "thread_cnt", 1)))
+
+    def chunks():
+        for _ in range(epochs):
+            sentences: List[np.ndarray] = []
+            n_words = 0
+            n_bytes = 0
+            for ids, raw_count in sentences_from_file(option.train_file,
+                                                      dictionary):
+                sentences.append(ids)
+                n_words += raw_count
+                n_bytes += raw_count * 8
+                if n_bytes >= option.data_block_size:
+                    yield sentences, n_words, generator.sampler.spawn_stream()
+                    sentences, n_words, n_bytes = [], 0, 0
+            if sentences:
+                yield sentences, n_words, generator.sampler.spawn_stream()
+
+    def run_sequential():
+        for sentences, n_words, stream in chunks():
+            queue.push(generator.make_block(sentences, n_words,
+                                            rng_stream=stream))
+
+    def run_pooled():
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+        pending = collections.deque()
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for sentences, n_words, stream in chunks():
+                pending.append(pool.submit(generator.make_block,
+                                           sentences, n_words, stream))
+                # emit in order; bound in-flight work (queue.push also
+                # backpressures via the BlockQueue capacity)
+                while pending and (pending[0].done()
+                                   or len(pending) > workers + 1):
+                    queue.push(pending.popleft().result())
+            while pending:
+                queue.push(pending.popleft().result())
 
     def run():
         try:
-            for _ in range(epochs):
-                sentences: List[np.ndarray] = []
-                n_words = 0
-                n_bytes = 0
-                for ids, raw_count in sentences_from_file(option.train_file,
-                                                          dictionary):
-                    sentences.append(ids)
-                    n_words += raw_count
-                    n_bytes += raw_count * 8
-                    if n_bytes >= option.data_block_size:
-                        queue.push(generator.make_block(sentences, n_words))
-                        sentences, n_words, n_bytes = [], 0, 0
-                if sentences:
-                    queue.push(generator.make_block(sentences, n_words))
+            if workers == 1:
+                run_sequential()
+            else:
+                run_pooled()
         finally:
             queue.close()
 

@@ -13,6 +13,7 @@ reference's 1e8-slot int table — same distribution, ~0 memory.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -22,11 +23,39 @@ class Sampler:
     def __init__(self, counts: Sequence[int], power: float = 0.75,
                  seed: int = 1):
         counts = np.asarray(counts, np.float64)
-        self._rng = np.random.default_rng(seed)
+        # thread-local generators spawned from one SeedSequence: block
+        # preparation runs in a pool (data.start_loader) and numpy
+        # Generators are not thread-safe
+        self._seed_seq = np.random.SeedSequence(seed)
+        self._spawn_lock = threading.Lock()
+        self._local = threading.local()
         probs = counts ** power
         self._cum = np.cumsum(probs / probs.sum())
         self._counts = counts
         self._total = counts.sum()
+
+    @property
+    def _rng(self) -> np.random.Generator:
+        rng = getattr(self._local, "rng", None)
+        if rng is None:
+            with self._spawn_lock:
+                child = self._seed_seq.spawn(1)[0]
+            rng = np.random.default_rng(child)
+            self._local.rng = rng
+        return rng
+
+    def spawn_stream(self) -> np.random.Generator:
+        """A fresh deterministic child generator. The block loader spawns
+        one per block IN BLOCK ORDER from its single producer thread and
+        installs it in whichever pool thread builds that block
+        (set_thread_stream) — so seeded runs are reproducible regardless
+        of -threads and of OS scheduling."""
+        with self._spawn_lock:
+            child = self._seed_seq.spawn(1)[0]
+        return np.random.default_rng(child)
+
+    def set_thread_stream(self, rng: np.random.Generator) -> None:
+        self._local.rng = rng
 
     def SampleNegatives(self, shape) -> np.ndarray:
         """Vocabulary ids ~ unigram^0.75 (reference SetNegativeSamplingDistribution)."""
