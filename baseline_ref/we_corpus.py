@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Generate the synthetic head-to-head WordEmbedding corpus + vocab file.
+
+5k-word vocabulary split into 50 topic clusters; each sentence draws one
+topic and samples its words from that cluster (with a sprinkle of global
+noise words), zipf-weighted inside the cluster. ~240k words (x3 epochs =
+720k trained words), deterministic. Both the unmodified reference app and
+this framework's app train on the identical files, and the cluster
+structure gives `we_eval.py` a ground truth to score both embedding sets
+against — the "equal loss" check of the head-to-head.
+"""
+import sys
+
+import numpy as np
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "."
+VOCAB = 5000
+TOPICS = 50
+SENTS = 20_000
+SENT_LEN = 12
+NOISE = 0.1          # fraction of tokens drawn from the global distribution
+rng = np.random.default_rng(7)
+words = np.array([f"t{i // (VOCAB // TOPICS)}_w{i}" for i in range(VOCAB)])
+per = VOCAB // TOPICS
+# zipf-ish weights inside a cluster and globally
+w_local = 1.0 / np.arange(1, per + 1) ** 0.9
+w_local /= w_local.sum()
+w_global = 1.0 / np.arange(1, VOCAB + 1) ** 1.05
+w_global /= w_global.sum()
+counts = np.zeros(VOCAB, np.int64)
+with open(f"{OUT}/corpus.txt", "w") as f:
+    for _ in range(SENTS):
+        topic = rng.integers(TOPICS)
+        local = topic * per + rng.choice(per, SENT_LEN, p=w_local)
+        noise = rng.choice(VOCAB, SENT_LEN, p=w_global)
+        use_noise = rng.random(SENT_LEN) < NOISE
+        idx = np.where(use_noise, noise, local)
+        counts += np.bincount(idx, minlength=VOCAB)
+        f.write(" ".join(words[idx]) + "\n")
+order = np.argsort(-counts, kind="stable")
+with open(f"{OUT}/vocab.txt", "w") as f:
+    for i in order:
+        if counts[i] > 0:
+            f.write(f"{words[i]} {counts[i]}\n")
+print(f"wrote {OUT}/corpus.txt ({SENTS * SENT_LEN} words), "
+      f"{OUT}/vocab.txt ({int((counts > 0).sum())} words)")

@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Score word2vec-format embeddings against we_corpus.py's topic clusters.
+
+Metric: neighbor purity@10 — for each of the most frequent words, the
+fraction of its top-10 cosine neighbors that belong to the same topic
+cluster (cluster = the `tK_` prefix we_corpus.py bakes into each word).
+Random embeddings score ~1/50; a model that learned the co-occurrence
+structure scores far higher. Used to show the framework's app reaches the
+same embedding quality as the unmodified reference at the measured
+wall-clocks (the head-to-head's "equal loss" check).
+
+usage: we_eval.py vec_a.txt [vec_b.txt ...]
+"""
+import sys
+
+import numpy as np
+
+
+def load(path):
+    with open(path, encoding="utf-8") as f:
+        n, dim = map(int, f.readline().split())
+        words, rows = [], []
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < dim + 1:
+                continue
+            words.append(parts[0])
+            rows.append(np.asarray(parts[1: dim + 1], np.float32))
+    return words, np.vstack(rows)
+
+
+def purity(path, top_words=500, k=10):
+    words, emb = load(path)
+    words, emb = words[:top_words], emb[:top_words]
+    topic = np.asarray([int(w.split("_")[0][1:]) for w in words])
+    norm = emb / np.maximum(np.linalg.norm(emb, axis=1, keepdims=True), 1e-9)
+    sim = norm @ norm.T
+    np.fill_diagonal(sim, -np.inf)
+    nbrs = np.argsort(-sim, axis=1)[:, :k]
+    return float((topic[nbrs] == topic[:, None]).mean())
+
+
+if __name__ == "__main__":
+    for p in sys.argv[1:]:
+        print(f"{p}: purity@10 = {purity(p):.3f}")

@@ -29,31 +29,28 @@ import numpy as np
 from multiverso_tpu.models.wordembedding.dictionary import Dictionary
 from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
 from multiverso_tpu.models.wordembedding.sampler import Sampler
+from multiverso_tpu.parallel.mesh import next_bucket
 from multiverso_tpu.utils.mt_queue import MtQueue
 
 MAX_SENTENCE_LENGTH = 1000  # reference constant.h kMaxSentenceLength
 
 
 @dataclass
-class PairBatch:
-    """Static-shape batch of training pairs."""
-
-    inputs: np.ndarray        # (P, Cin) int32 local or global row ids
-    input_mask: np.ndarray    # (P, Cin) float32
-    outputs: np.ndarray       # (P, Cout) int32
-    labels: np.ndarray        # (P, Cout) float32 (already HS-folded)
-    output_mask: np.ndarray   # (P, Cout) float32
-    count: int                # true number of pairs
-
-
-@dataclass
 class DataBlock:
-    """Sentences + derived pair batches + touched row sets."""
+    """A block's training pairs in device-ready form + touched row sets.
 
-    batches: List[PairBatch] = field(default_factory=list)
+    ``stacked`` is what the scanned train step consumes: a dict of
+    (B, P, C) arrays — inputs/input_mask/outputs/labels/output_mask —
+    with row ids already remapped to *block-local* indices (positions in
+    input_rows/output_rows) and the batch count B padded to a bucket so
+    scan lengths don't retrace. Built by the loader threads so the serial
+    train loop pays zero host prep per block."""
+
     input_rows: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
     output_rows: np.ndarray = field(default_factory=lambda: np.empty(0, np.int32))
     word_count: int = 0
+    stacked: Optional[dict] = None
+    pair_count: int = 0
 
 
 def sentences_from_file(path: str, dictionary: Dictionary) -> Iterator[Tuple[np.ndarray, int]]:
@@ -159,34 +156,7 @@ class PairGenerator:
                     out.append(([c], outputs, labels))
         return out
 
-    def batch_pairs(self, pairs, batch_size: int) -> List[PairBatch]:
-        opt = self.opt
-        cin_max = (2 * opt.window_size) if opt.cbow else 1
-        if opt.hs:
-            cout_max = self.huffman.max_code_length
-        else:
-            cout_max = 1 + opt.negative_num
-        batches = []
-        for start in range(0, len(pairs), batch_size):
-            chunk = pairs[start: start + batch_size]
-            P = batch_size
-            inputs = np.zeros((P, cin_max), np.int32)
-            imask = np.zeros((P, cin_max), np.float32)
-            outputs = np.zeros((P, cout_max), np.int32)
-            labels = np.zeros((P, cout_max), np.float32)
-            omask = np.zeros((P, cout_max), np.float32)
-            for i, (ins, outs, labs) in enumerate(chunk):
-                inputs[i, : len(ins)] = ins
-                imask[i, : len(ins)] = 1.0
-                outputs[i, : len(outs)] = outs
-                labels[i, : len(labs)] = labs
-                omask[i, : len(outs)] = 1.0
-            batches.append(PairBatch(inputs, imask, outputs, labels, omask,
-                                     count=len(chunk)))
-        return batches
-
-    def _skipgram_neg_batches(self, sentences: List[np.ndarray],
-                              batch_size: int) -> List[PairBatch]:
+    def _skipgram_neg_arrays(self, sentences: List[np.ndarray]):
         """Vectorized skip-gram + NEG pair construction over the whole
         block (2*window offset passes over the concatenated ids instead of
         a python loop per pair — the loop capped the app at ~27k words/s).
@@ -196,7 +166,10 @@ class PairGenerator:
         two documented differences: negatives are drawn independently per
         pair (the loop shared one draw across a center's context pairs)
         and pair order is offset-major rather than sentence-major — SGD
-        visits the same pairs in a different, still random-ish order."""
+        visits the same pairs in a different, still random-ish order.
+
+        Returns full-block (P, C) arrays (inputs, imask, outputs, labels,
+        omask) with GLOBAL row ids, or None when the block is empty."""
         opt = self.opt
         lens = np.fromiter((len(s) for s in sentences), np.int64,
                            len(sentences))
@@ -207,7 +180,7 @@ class PairGenerator:
             keep = self.sampler.KeepMask(ids, opt.sample)
             ids, sent = ids[keep], sent[keep]
         if len(ids) == 0:
-            return []
+            return None
         # positions within (possibly filtered) sentences
         _, start_idx, rank, new_lens = np.unique(
             sent, return_index=True, return_inverse=True, return_counts=True)
@@ -226,7 +199,7 @@ class PairGenerator:
         contexts = np.concatenate(contexts_l).astype(np.int32)
         P = len(centers)
         if P == 0:
-            return []
+            return None
         K = opt.negative_num
         negs = self.sampler.SampleNegatives((P, K)).astype(np.int32)
         outputs_all = np.concatenate([centers[:, None], negs], axis=1)
@@ -235,23 +208,84 @@ class PairGenerator:
              (negs != centers[:, None]).astype(np.float32)], axis=1)
         labels_row = np.zeros(1 + K, np.float32)
         labels_row[0] = 1.0
-        batches = []
-        for s0 in range(0, P, batch_size):
-            chunk = slice(s0, min(s0 + batch_size, P))
-            n = chunk.stop - chunk.start
-            inputs = np.zeros((batch_size, 1), np.int32)
-            imask = np.zeros((batch_size, 1), np.float32)
-            outputs = np.zeros((batch_size, 1 + K), np.int32)
-            labels = np.zeros((batch_size, 1 + K), np.float32)
-            omask = np.zeros((batch_size, 1 + K), np.float32)
-            inputs[:n, 0] = contexts[chunk]
-            imask[:n, 0] = 1.0
-            outputs[:n] = outputs_all[chunk]
-            labels[:n] = labels_row
-            omask[:n] = omask_all[chunk]
-            batches.append(PairBatch(inputs, imask, outputs, labels, omask,
-                                     count=n))
-        return batches
+        return (contexts[:, None], np.ones((P, 1), np.float32),
+                outputs_all, np.broadcast_to(labels_row, (P, 1 + K)),
+                omask_all)
+
+    def _pairs_to_arrays(self, pairs):
+        """(input, output, label) tuple list -> full (P, C) arrays with
+        GLOBAL ids (the cbow/hs construction path)."""
+        opt = self.opt
+        P = len(pairs)
+        if P == 0:
+            return None
+        cin_max = (2 * opt.window_size) if opt.cbow else 1
+        if opt.hs:
+            cout_max = self.huffman.max_code_length
+        else:
+            cout_max = 1 + opt.negative_num
+        inputs = np.zeros((P, cin_max), np.int32)
+        imask = np.zeros((P, cin_max), np.float32)
+        outputs = np.zeros((P, cout_max), np.int32)
+        labels = np.zeros((P, cout_max), np.float32)
+        omask = np.zeros((P, cout_max), np.float32)
+        for i, (ins, outs, labs) in enumerate(pairs):
+            inputs[i, : len(ins)] = ins
+            imask[i, : len(ins)] = 1.0
+            outputs[i, : len(outs)] = outs
+            labels[i, : len(labs)] = labs
+            omask[i, : len(outs)] = 1.0
+        return inputs, imask, outputs, labels, omask
+
+    def _finalize_block(self, inputs, imask, outputs, labels, omask,
+                        word_count: int) -> DataBlock:
+        """Global-id (P, C) arrays -> a device-ready DataBlock: unique row
+        sets, ids remapped to block-local positions, pair axis padded to a
+        whole number of batches, batch count padded to a bucket (a fresh
+        scan length would recompile the block program), reshaped (B, P, C).
+        Runs inside the loader threads — the train loop's per-block host
+        cost is just jnp.asarray uploads."""
+        V = self.dict.Size()
+
+        def remap(ids):
+            """(row set, block-local ids). The row set is every id that
+            appears in a lane — masked lanes included: filtering them
+            would cost a full boolean-index copy, while the extra rows
+            they add round-trip a zero delta (a no-op add). When the set
+            covers most of the vocab, fetch every row and keep ids as-is
+            — the searchsorted remap costs more than the untouched rows.
+            Gated on the UNIQUE row count (O(n) bincount — ids are vocab
+            ids < V, so the nonzero bins ARE the sorted unique rows), not
+            raw lane count, so sparse blocks over huge vocabs keep the
+            sparse fetch."""
+            rows = np.nonzero(np.bincount(ids.ravel(), minlength=V)
+                              )[0].astype(np.int32)
+            if 2 * len(rows) >= V:
+                return np.arange(V, dtype=np.int32), ids.astype(np.int32)
+            return rows, np.searchsorted(rows, ids).astype(np.int32)
+
+        input_rows, loc_in = remap(inputs)
+        output_rows, loc_out = remap(outputs)
+        P = len(inputs)
+        bs = self.opt.pair_batch_size
+        nb = next_bucket(-(-P // bs), min_bucket=4)
+        Ppad = nb * bs
+
+        def pad(a, dtype):
+            out = np.zeros((Ppad,) + a.shape[1:], dtype)
+            out[:P] = a
+            return out.reshape(nb, bs, -1)
+
+        stacked = {
+            "inputs": pad(loc_in, np.int32),
+            "input_mask": pad(imask, np.float32),
+            "outputs": pad(loc_out, np.int32),
+            "labels": pad(labels, np.float32),
+            "output_mask": pad(omask, np.float32),
+        }
+        return DataBlock(input_rows=input_rows,
+                         output_rows=output_rows, word_count=word_count,
+                         stacked=stacked, pair_count=P)
 
     def make_block(self, sentences: List[np.ndarray],
                    word_count: int, rng_stream=None) -> DataBlock:
@@ -261,25 +295,15 @@ class PairGenerator:
         if rng_stream is not None:
             self.sampler.set_thread_stream(rng_stream)
         if not self.opt.cbow and not self.opt.hs:
-            batches = self._skipgram_neg_batches(sentences,
-                                                 self.opt.pair_batch_size)
+            arrays = self._skipgram_neg_arrays(sentences)
         else:
             pairs = []
             for ids in sentences:
                 pairs.extend(self.pairs_from_sentence(ids))
-            batches = self.batch_pairs(pairs, self.opt.pair_batch_size)
-        if batches:
-            input_rows = np.unique(np.concatenate(
-                [(b.inputs[b.input_mask > 0]) for b in batches]))
-            output_rows = np.unique(np.concatenate(
-                [(b.outputs[b.output_mask > 0]) for b in batches]))
-        else:
-            input_rows = np.empty(0, np.int32)
-            output_rows = np.empty(0, np.int32)
-        return DataBlock(batches=batches,
-                         input_rows=input_rows.astype(np.int32),
-                         output_rows=output_rows.astype(np.int32),
-                         word_count=word_count)
+            arrays = self._pairs_to_arrays(pairs)
+        if arrays is None:
+            return DataBlock(word_count=word_count)
+        return self._finalize_block(*arrays, word_count=word_count)
 
 
 class BlockQueue:

@@ -25,7 +25,6 @@ from multiverso_tpu.models.wordembedding.huffman import HuffmanEncoder
 from multiverso_tpu.models.wordembedding.model import (decayed_lr,
                                                        make_train_step)
 from multiverso_tpu.models.wordembedding.option import Option
-from multiverso_tpu.parallel.mesh import next_bucket
 from multiverso_tpu.models.wordembedding.sampler import Sampler
 from multiverso_tpu.utils.log import Log
 from multiverso_tpu.utils.timer import Timer
@@ -74,7 +73,13 @@ class DistributedWordEmbedding:
     # -- training -----------------------------------------------------------
 
     def train(self) -> float:
-        """Returns average pair loss of the run."""
+        """Returns average pair loss of the run.
+
+        Loss fetches lag one block behind the dispatches: forcing the
+        scanned program's scalar right away would serialize host prep with
+        the device work, so the loop keeps one result in flight and the
+        per-block log line reports the average over *completed* blocks."""
+        import collections
         opt = self.opt
         generator = PairGenerator(opt, self.dictionary, self.sampler,
                                   self.huffman)
@@ -86,6 +91,13 @@ class DistributedWordEmbedding:
         words_done = 0
         self.total_loss = 0.0
         self.total_pairs = 0
+        pending = collections.deque()
+
+        def harvest(force: bool = False) -> None:
+            while pending and (force or len(pending) >= 2):
+                loss, pairs = pending.popleft()
+                self.total_loss += float(loss)
+                self.total_pairs += pairs
 
         current = queue.pop()
         prefetch = None
@@ -95,13 +107,13 @@ class DistributedWordEmbedding:
                 next_block = queue.pop()
                 # host-plane prefetch only: the device plane's fetch is an
                 # async dispatch already (nothing to overlap by hand)
-                if (next_block is not None and next_block.batches
+                if (next_block is not None and next_block.pair_count
                         and not opt.device_plane):
                     prefetch = self.comm.request_parameter_async(
                         next_block.input_rows, next_block.output_rows)
             loss, pairs = self._train_block(current, step)
-            self.total_loss += loss
-            self.total_pairs += pairs
+            pending.append((loss, pairs))
+            harvest()
             words_done += current.word_count
             self.comm.add_word_count(current.word_count)
             rate = words_done / max(timer.elapse(), 1e-9)
@@ -110,13 +122,14 @@ class DistributedWordEmbedding:
                      self.total_loss / max(self.total_pairs, 1),
                      self._current_lr())
             if opt.is_pipeline:
-                if next_block is not None and next_block.batches \
+                if next_block is not None and next_block.pair_count \
                         and prefetch is not None:
                     next_block._prefetched = self.comm.wait_parameter(
                         prefetch)
                 current, prefetch = next_block, None
             else:
                 current = queue.pop()
+        harvest(force=True)
         loader.join()
         return self.total_loss / max(self.total_pairs, 1)
 
@@ -156,66 +169,36 @@ class DistributedWordEmbedding:
         return self._block_scan_cache[1]
 
     def _train_block(self, block: DataBlock, step) -> tuple:
-        if not block.batches:
+        """One block through the scanned program. Returns (loss, pairs)
+        where loss is a DEVICE scalar (the caller harvests lazily so the
+        dispatch overlaps the next block's prep)."""
+        if not block.pair_count:
             return 0.0, 0
         import jax.numpy as jnp
         pre = getattr(block, "_prefetched", None)
         if self.opt.device_plane:
             # rows gathered, trained, and pushed without leaving HBM;
-            # all batches ride one stacked upload + one scanned dispatch
+            # the loader threads prebuilt the remapped stacked tensors, so
+            # the block rides one upload + one scanned dispatch
             state, fetched = self.comm.request_parameter_device(
                 block.input_rows, block.output_rows)
-            bs = block.batches
-            inputs = np.searchsorted(
-                block.input_rows,
-                np.stack([b.inputs for b in bs])).astype(np.int32)
-            outputs = np.searchsorted(
-                block.output_rows,
-                np.stack([b.outputs for b in bs])).astype(np.int32)
-            imask = np.stack([b.input_mask for b in bs])
-            labels = np.stack([b.labels for b in bs])
-            omask = np.stack([b.output_mask for b in bs])
-            # pad the batch COUNT to a bucket: a fresh scan length would
-            # recompile the whole block program (~10s over the tunnel);
-            # all-zero-mask batches are exact no-ops for every update rule
-            pad = next_bucket(len(bs), min_bucket=4) - len(bs)
-            if pad:
-                z = lambda a: np.concatenate(
-                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-                inputs, outputs = z(inputs), z(outputs)
-                imask, labels, omask = z(imask), z(labels), z(omask)
-            state, loss_dev = self._block_scan_fn(step)(
-                state, jnp.asarray(inputs), jnp.asarray(imask),
-                jnp.asarray(outputs), jnp.asarray(labels),
-                jnp.asarray(omask), jnp.float32(self._current_lr()))
-            self.comm.add_delta_parameter_device(
-                state, fetched, block.input_rows, block.output_rows)
-            return float(loss_dev), sum(b.count for b in bs)
-        if pre is not None:
+        elif pre is not None:
             state, fetched = pre
         else:
             state, fetched = self.comm.request_parameter(block.input_rows,
                                                          block.output_rows)
-        # remap global row ids -> block-local indices
-        in_map = block.input_rows
-        out_map = block.output_rows
-        losses = []
-        pairs = 0
-        lr = jnp.float32(self._current_lr())
-        for batch in block.batches:
-            local_in = np.searchsorted(in_map, batch.inputs).astype(np.int32)
-            local_out = np.searchsorted(out_map, batch.outputs).astype(np.int32)
-            state, loss = step(state, jnp.asarray(local_in),
-                               jnp.asarray(batch.input_mask),
-                               jnp.asarray(local_out),
-                               jnp.asarray(batch.labels),
-                               jnp.asarray(batch.output_mask), lr)
-            losses.append(loss)   # device scalar: fetch ONCE per block —
-            pairs += batch.count  # a per-batch fetch is a sync round-trip
-        loss_sum = float(jnp.sum(jnp.stack(losses))) if losses else 0.0
-        self.comm.add_delta_parameter(state, fetched, block.input_rows,
-                                      block.output_rows)
-        return loss_sum, pairs
+        st = block.stacked
+        state, loss_dev = self._block_scan_fn(step)(
+            state, jnp.asarray(st["inputs"]), jnp.asarray(st["input_mask"]),
+            jnp.asarray(st["outputs"]), jnp.asarray(st["labels"]),
+            jnp.asarray(st["output_mask"]), jnp.float32(self._current_lr()))
+        if self.opt.device_plane:
+            self.comm.add_delta_parameter_device(
+                state, fetched, block.input_rows, block.output_rows)
+        else:
+            self.comm.add_delta_parameter(state, fetched, block.input_rows,
+                                          block.output_rows)
+        return loss_dev, block.pair_count
 
     # -- export (word2vec format) -------------------------------------------
 
@@ -260,6 +243,9 @@ def main(argv=None) -> int:
     import sys
     argv = argv if argv is not None else sys.argv[1:]
     opt = Option.parse_args(argv)
+    if opt.platform:
+        import jax
+        jax.config.update("jax_platforms", opt.platform)
     if not opt.train_file:
         Log.Error("usage: python -m multiverso_tpu.models.wordembedding."
                   "distributed -train_file corpus.txt [-size 100 ...]")

@@ -64,12 +64,14 @@ def make_train_step(use_adagrad: bool, eps: float = 1e-10):
         # backward
         hid_err = jnp.einsum("pc,pcd->pd", err, out_rows)  # (P, D)
         eo_contrib = err[:, :, None] * h[:, None, :]       # (P, Cout, D)
-        eo_grad = jnp.zeros_like(eo).at[outputs.reshape(-1)].add(
-            eo_contrib.reshape(-1, D))
         ie_contrib = (hid_err[:, None, :] * imask[:, :, None])  # (P, Cin, D)
-        ie_grad = jnp.zeros_like(ie).at[inputs.reshape(-1)].add(
-            ie_contrib.reshape(-1, D))
         if use_adagrad:
+            # adagrad needs the per-ROW summed gradient (g² accumulates at
+            # row granularity), so the dense grad matrices are inherent
+            eo_grad = jnp.zeros_like(eo).at[outputs.reshape(-1)].add(
+                eo_contrib.reshape(-1, D))
+            ie_grad = jnp.zeros_like(ie).at[inputs.reshape(-1)].add(
+                ie_contrib.reshape(-1, D))
             eo_g2 = state.eo_g2 + eo_grad * eo_grad
             ie_g2 = state.ie_g2 + ie_grad * ie_grad
             eo = eo + jnp.where(eo_g2 > eps,
@@ -77,8 +79,13 @@ def make_train_step(use_adagrad: bool, eps: float = 1e-10):
             ie = ie + jnp.where(ie_g2 > eps,
                                 lr * ie_grad / jnp.sqrt(ie_g2 + 1e-12), 0.0)
             return TrainState(ie, eo, ie_g2, eo_g2), loss
-        eo = eo + lr * eo_grad
-        ie = ie + lr * ie_grad
+        # plain SGD is additive per pair: scatter straight into the row
+        # matrices — no dense grad materialization, no full-matrix adds
+        # (those made each batch pay O(R·D) instead of O(P·C·D))
+        eo = eo.at[outputs.reshape(-1)].add(
+            (lr * eo_contrib).reshape(-1, D))
+        ie = ie.at[inputs.reshape(-1)].add(
+            (lr * ie_contrib).reshape(-1, D))
         return TrainState(ie, eo, None, None), loss
 
     return jax.jit(step, donate_argnums=(0,))

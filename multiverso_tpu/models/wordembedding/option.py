@@ -45,6 +45,10 @@ class Option:
     # device (communicator device plane, docs/DESIGN.md §4) — no host
     # round-trip per block. Single-process, single-worker path.
     device_plane: bool = False
+    # force a jax platform ("cpu"/"tpu"); "" = jax default. Applied by
+    # main() before the first backend touch (env JAX_PLATFORMS is not
+    # reliable under every plugin, e.g. tunneled TPU shims).
+    platform: str = ""
 
     _FLAGS = {
         "size": ("embedding_size", int),
@@ -71,6 +75,7 @@ class Option:
         "pair_batch": ("pair_batch_size", int),
         "seed": ("seed", int),
         "device_plane": ("device_plane", lambda v: bool(int(v))),
+        "platform": ("platform", str),
     }
 
     @classmethod
