@@ -468,6 +468,8 @@ def main() -> int:
         "value": round(tpu_sps),
         "unit": "samples/s",
         "vs_baseline": round(tpu_sps / cpu_sps, 2),
+        "vs_baseline_note": "bf16-matmul TPU run vs f32 numpy baseline "
+                            "(precision differs; loss parity asserted)",
         "platform": platform,
         "baseline_samples_per_sec": round(cpu_sps),
         "config": f"dense sigmoid LR, {LR_FEATURES} features, "
@@ -505,7 +507,8 @@ def main() -> int:
         out["matrix_table_numpy_baseline_Melem_s"] = round(base_me, 1)
         out["matrix_config"] = (f"{N_ROWS}x{N_COLS} f32, "
                                 f"{ROW_FRACTION:.0%} rows/op, "
-                                f"{ROUNDS} rounds")
+                                f"{ROUNDS} rounds cycling a "
+                                f"{STAGED_ROUNDS}-round staged pool")
 
     def fill_sparse(me):
         out["sparse_matrix_host_Melem_s"] = round(me, 1)

@@ -253,16 +253,18 @@ class PairGenerator:
             would cost a full boolean-index copy, while the extra rows
             they add round-trip a zero delta (a no-op add). When the set
             covers most of the vocab, fetch every row and keep ids as-is
-            — the searchsorted remap costs more than the untouched rows.
-            Gated on the UNIQUE row count (O(n) bincount — ids are vocab
-            ids < V, so the nonzero bins ARE the sorted unique rows), not
-            raw lane count, so sparse blocks over huge vocabs keep the
-            sparse fetch."""
-            rows = np.nonzero(np.bincount(ids.ravel(), minlength=V)
-                              )[0].astype(np.int32)
+            — the remap costs more than the untouched rows. Gated on the
+            UNIQUE row count, not raw lane count, so sparse blocks over
+            huge vocabs keep the sparse fetch. np.unique(return_inverse)
+            gives the sorted row set and the remapped ids in one pass
+            with no vocab-sized allocation (a bincount here would zero
+            O(V) per block — ruinous at word2vec-scale vocabularies)."""
+            shape = ids.shape
+            rows, inv = np.unique(ids, return_inverse=True)
             if 2 * len(rows) >= V:
                 return np.arange(V, dtype=np.int32), ids.astype(np.int32)
-            return rows, np.searchsorted(rows, ids).astype(np.int32)
+            return (rows.astype(np.int32),
+                    inv.reshape(shape).astype(np.int32))
 
         input_rows, loc_in = remap(inputs)
         output_rows, loc_out = remap(outputs)

@@ -29,6 +29,13 @@ class Sampler:
         # preparation runs in a pool (data.start_loader) and numpy
         # Generators are not thread-safe
         self._seed_seq = np.random.SeedSequence(seed)
+        # lazy per-thread streams (threads that never get
+        # set_thread_stream) come from a DEDICATED root so they cannot
+        # perturb spawn_stream's sequential counter — loader-managed
+        # streams stay reproducible no matter how many stray threads
+        # touch the sampler or in what order the OS schedules them
+        self._lazy_seq = np.random.SeedSequence(
+            entropy=seed, spawn_key=(0x6C617A79,))  # 'lazy'
         self._spawn_lock = threading.Lock()
         self._local = threading.local()
         probs = counts ** power
@@ -50,7 +57,7 @@ class Sampler:
         rng = getattr(self._local, "rng", None)
         if rng is None:
             with self._spawn_lock:
-                child = self._seed_seq.spawn(1)[0]
+                child = self._lazy_seq.spawn(1)[0]
             rng = np.random.default_rng(child)
             self._local.rng = rng
         return rng

@@ -196,7 +196,8 @@ class DCASGDUpdater(Updater):
         bak = aux["backup"][wid]
         # lr rides in traced (no retrace on change), so a zero can't raise
         # here — degrade the compensation to plain SGD instead of poisoning
-        # the table with inf/NaN (the native mirror CHECKs, store.cc)
+        # the table with inf/NaN (the native mirror applies the same
+        # degrade, store.cc DcasgdUpdaterC)
         lam_over_lr = jnp.where(lr > 0, lam / jnp.maximum(lr, 1e-30), 0.0)
         new = data - (delta + lam_over_lr * delta * delta * (data - bak))
         backup = aux["backup"].at[wid].set(new)

@@ -19,6 +19,11 @@ enum class MsgType : int {
   kRequestAdd = 2,
   kServerFinishTrain = 4,
   kRequestBarrier = 33,
+  // table persistence runs on the server thread so snapshots cannot race
+  // concurrent Adds (data[0] = URI bytes); >33 like the reference's
+  // control-plane range (message.h:13-24)
+  kStoreTable = 34,
+  kLoadTable = 35,
   kReplyGet = -1,
   kReplyAdd = -2,
   kDefault = 0,

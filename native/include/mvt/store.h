@@ -149,6 +149,7 @@ class ServerC : public Actor {
   void HandleGet(MessagePtr& msg);
   void HandleAdd(MessagePtr& msg);
   void HandleFinish(MessagePtr& msg);
+  void HandleStoreLoad(MessagePtr& msg, bool store);
   void DoGet(MessagePtr& msg);
   void DoAdd(MessagePtr& msg);
 

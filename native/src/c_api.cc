@@ -126,22 +126,30 @@ void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
                    static_cast<size_t>(num_col));
 }
 
-int MV_StoreTable(TableHandler handler, const char* uri) {
+// Store/Load ride the server mailbox (kStoreTable/kLoadTable) so the
+// snapshot is ordered against every APPLIED Add — no caller-side
+// quiescence needed and no data race. BSP caveat: in sync mode, Adds the
+// vector-clock protocol has parked for a future superstep (add_cache_)
+// are logically not-yet-applied and are excluded from the snapshot; a
+// checkpoint taken mid-superstep captures the last consistent state.
+static int store_load(TableHandler handler, const char* uri,
+                      mvt::MsgType type) {
   auto* ref = static_cast<TableRef*>(handler);
-  MV_Barrier();  // drain in-flight async adds before snapshotting
-  auto stream = mvt::StreamFactoryC::GetStream(uri, "wb");
-  if (stream == nullptr) return -1;
-  rt().server->table(ref->table_id)->Store(stream.get());
-  return 0;
+  auto msg = std::make_shared<mvt::Message>();
+  msg->type = type;
+  msg->table_id = ref->table_id;
+  msg->src_worker = tls_worker_id;
+  msg->data.emplace_back(uri, std::strlen(uri));
+  submit(msg, true);
+  return msg->failed ? -1 : 0;
+}
+
+int MV_StoreTable(TableHandler handler, const char* uri) {
+  return store_load(handler, uri, mvt::MsgType::kStoreTable);
 }
 
 int MV_LoadTable(TableHandler handler, const char* uri) {
-  auto* ref = static_cast<TableRef*>(handler);
-  MV_Barrier();
-  auto stream = mvt::StreamFactoryC::GetStream(uri, "rb");
-  if (stream == nullptr) return -1;
-  rt().server->table(ref->table_id)->Load(stream.get());
-  return 0;
+  return store_load(handler, uri, mvt::MsgType::kLoadTable);
 }
 
 static void do_get(TableHandler handler, float* data, int size,

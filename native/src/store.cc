@@ -54,9 +54,11 @@ void DcasgdUpdaterC::Update(size_t n, float* data, const float* delta,
   MVT_CHECK(opt.worker_id >= 0 &&
             (static_cast<size_t>(opt.worker_id) + 1) * size_ <=
                 backup_.size());
-  MVT_CHECK(opt.learning_rate > 0.0f);  // lam/lr below
   float* bak = backup_.data() + static_cast<size_t>(opt.worker_id) * size_;
-  const float lam_over_lr = opt.lambda / opt.learning_rate;
+  // lr <= 0 degrades the compensation to plain SGD instead of producing
+  // inf/NaN — mirrors the python DCASGDUpdater's jnp.where guard exactly
+  const float lam_over_lr =
+      opt.learning_rate > 0.0f ? opt.lambda / opt.learning_rate : 0.0f;
   for (size_t i = 0; i < n; ++i) {
     const float d = delta[i];
     float& w = data[offset + i];
@@ -185,6 +187,14 @@ ServerC::ServerC(int num_workers, bool sync)
   // must NOT touch the BSP clocks (unlike FinishTrain)
   RegisterHandler(MsgType::kRequestBarrier,
                   [](MessagePtr& m) { m->Reply(); });
+  // Store/Load run here on the server thread: the snapshot is ordered
+  // against every applied Add, so callers need no quiescence. In sync
+  // mode, clock-parked Adds (add_cache_) are not yet applied and are
+  // deliberately excluded — the snapshot is the last consistent state.
+  RegisterHandler(MsgType::kStoreTable,
+                  [this](MessagePtr& m) { HandleStoreLoad(m, /*store=*/true); });
+  RegisterHandler(MsgType::kLoadTable,
+                  [this](MessagePtr& m) { HandleStoreLoad(m, /*store=*/false); });
 }
 
 int ServerC::RegisterTable(std::unique_ptr<TableC> table) {
@@ -275,6 +285,19 @@ void ServerC::HandleGet(MessagePtr& msg) {
       --num_waited_add_[add_msg->src_worker];
     }
   }
+}
+
+void ServerC::HandleStoreLoad(MessagePtr& msg, bool store) {
+  std::string uri(msg->data[0].As<char>(), msg->data[0].size());
+  auto stream = StreamFactoryC::GetStream(uri, store ? "wb" : "rb");
+  if (stream == nullptr) {
+    msg->failed = true;
+  } else if (store) {
+    store_[msg->table_id]->Store(stream.get());
+  } else {
+    store_[msg->table_id]->Load(stream.get());
+  }
+  msg->Reply();
 }
 
 void ServerC::HandleFinish(MessagePtr& msg) {
