@@ -1,0 +1,266 @@
+"""Serve the native C ABI from the TPU runtime.
+
+The reference's ``src/c_api.cpp:1-93`` wraps its *real* runtime, so every
+foreign binding (Lua FFI ``binding/lua/init.lua:16-27``, C# P/Invoke, raw C)
+reaches the actual parameter server. The TPU equivalent is this bridge: it
+installs an ``MV_BackendVTable`` (native/include/mvt/c_api.h) into
+``libmultiverso_tpu.so``, after which every ``MV_*`` table verb any native
+caller in this process invokes routes to the SAME mesh-backed tables the
+python surface uses — TPU/HBM storage, jit'd updaters, BSP sync included.
+Without an installed bridge the library serves its self-contained native
+CPU store (the fallback world for pure-native deployments).
+
+Usage (embedding host process)::
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.binding import native_bridge
+    mv.MV_Init(["-num_workers=2"])
+    bridge = native_bridge.install()     # native callers now reach the mesh
+    ...  # load Lua/C#/C code in-process; it calls MV_* as usual
+    bridge.uninstall()
+    mv.MV_ShutDown()
+
+The bridge may also be installed *before* any world exists; the first
+native ``MV_Init`` then brings up the python world (flags forwarded) and
+the matching native ``MV_ShutDown`` tears it down.
+
+Threading: callbacks arrive on arbitrary native threads; ctypes enters the
+GIL per call, and the table engine serializes state behind its actor
+mailbox, so no extra locking is needed here. Each call runs under
+``Zoo.worker_context(worker_id)`` with the caller thread's bound worker id
+(MV_SetThreadWorkerId), preserving per-worker updater state (AdaGrad/
+DCASGD) and BSP clock attribution across the ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import traceback
+from typing import Dict, Optional
+
+import numpy as np
+
+from multiverso_tpu.utils.log import Log
+
+
+# named callback types: the single source of truth for the vtable layout
+# (field order below and callback construction in install() both use these)
+INIT_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                           ctypes.POINTER(ctypes.c_char_p))
+VOID_FN = ctypes.CFUNCTYPE(ctypes.c_int)
+NEW_TABLE_FN = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_int64,
+                                ctypes.c_int64, ctypes.c_int32)
+GET_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64,
+                          ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+                          ctypes.c_int32)
+ADD_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64,
+                          ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+                          ctypes.c_int32, ctypes.c_int32)
+URI_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64, ctypes.c_char_p)
+
+
+class MV_BackendVTable(ctypes.Structure):
+    """Mirror of the C struct (native/include/mvt/c_api.h)."""
+
+    _fields_ = [
+        ("init", INIT_FN),
+        ("shutdown", VOID_FN),
+        ("barrier", VOID_FN),
+        ("num_workers", VOID_FN),
+        ("new_table", NEW_TABLE_FN),
+        ("get", GET_FN),
+        ("add", ADD_FN),
+        ("store", URI_FN),
+        ("load", URI_FN),
+    ]
+
+
+class _Entry:
+    __slots__ = ("worker", "server", "rows", "cols", "is_array")
+
+    def __init__(self, worker, server, rows: int, cols: int, is_array: bool):
+        self.worker = worker
+        self.server = server
+        self.rows = rows
+        self.cols = cols
+        self.is_array = is_array
+
+
+class NativeBridge:
+    """Holds the installed vtable (and the callback objects alive)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._tables: Dict[int, _Entry] = {}
+        self._tables_lock = threading.Lock()  # id allocation only
+        self._owns_world = False
+        self._vtable: Optional[MV_BackendVTable] = None
+
+    # -- callback bodies (exceptions must not cross the FFI) ----------------
+
+    def _guard(self, fn, *args, err=-1):
+        try:
+            return fn(*args)
+        except Exception:  # noqa: BLE001 - FFI boundary
+            Log.Error("native_bridge: %s", traceback.format_exc())
+            return err
+
+    def _init(self, argc, argv) -> int:
+        from multiverso_tpu.zoo import Zoo
+        import multiverso_tpu as core
+        if Zoo.Get().started:
+            return 0  # embedding host already owns the world
+        args = []
+        if argc and argv:
+            args = [argv[i].decode() for i in range(1, argc[0])
+                    if argv[i] is not None]
+        core.MV_Init(args)
+        self._owns_world = True
+        return 0
+
+    def _shutdown(self) -> int:
+        import multiverso_tpu as core
+        if self._owns_world:
+            core.MV_ShutDown()
+            self._owns_world = False
+        self._tables.clear()
+        return 0
+
+    def _barrier(self) -> int:
+        # the native ABI's MV_Barrier is a drain ping (c_api.cc: happens-
+        # before for submitted ops, callable from any single thread) — NOT
+        # the python surface's worker-thread-collective MV_Barrier, which
+        # would deadlock a lone native caller in a multi-worker world
+        from multiverso_tpu.zoo import Zoo
+        Zoo.Get().DrainServer()
+        return 0
+
+    def _num_workers(self) -> int:
+        import multiverso_tpu as core
+        return core.MV_NumWorkers()
+
+    def _new_table(self, rows: int, cols: int, is_array: int) -> int:
+        import multiverso_tpu as core
+        from multiverso_tpu.zoo import Zoo
+        from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+        if is_array:  # MV_NewArrayTable; a 1xN MATRIX keeps row verbs
+            worker = core.MV_CreateTable(ArrayTableOption(size=int(cols)))
+        else:
+            worker = core.MV_CreateTable(
+                MatrixTableOption(num_rows=int(rows), num_cols=int(cols)))
+        server = Zoo.Get().server_tables[worker.table_id]
+        # MV_CreateTable releases the GIL internally (device placement),
+        # so concurrent creations need the id allocation locked
+        with self._tables_lock:
+            bid = len(self._tables)
+            self._tables[bid] = _Entry(worker, server, int(rows), int(cols),
+                                       bool(is_array))
+        return bid
+
+    def _ids(self, row_ids, n_rows) -> Optional[np.ndarray]:
+        if not row_ids or n_rows == 0:
+            return None
+        return np.ctypeslib.as_array(row_ids, shape=(n_rows,)).copy()
+
+    def _get(self, table, row_ids, n_rows, out, n_floats, worker_id) -> int:
+        from multiverso_tpu.zoo import Zoo
+        entry = self._tables[table]
+        ids = self._ids(row_ids, n_rows)
+        with Zoo.Get().worker_context(worker_id):
+            if ids is None:
+                result = entry.worker.Get()
+            else:
+                result = entry.worker.GetRows(ids.astype(np.int32))
+        flat = np.ascontiguousarray(result, np.float32).reshape(-1)
+        if flat.size != n_floats:
+            raise ValueError(f"get size mismatch: table has {flat.size} "
+                             f"floats, caller buffer {n_floats}")
+        ctypes.memmove(out, flat.ctypes.data, flat.size * 4)
+        return 0
+
+    def _add(self, table, row_ids, n_rows, data, n_floats, is_async,
+             worker_id) -> int:
+        from multiverso_tpu.zoo import Zoo
+        entry = self._tables[table]
+        ids = self._ids(row_ids, n_rows)
+        # copy: an async caller may reuse its buffer the moment we return
+        values = np.ctypeslib.as_array(data, shape=(int(n_floats),)).copy()
+        with Zoo.Get().worker_context(worker_id):
+            if ids is None:
+                if values.size != entry.rows * entry.cols:
+                    raise ValueError("add size mismatch")
+                if not entry.is_array:
+                    values = values.reshape(entry.rows, entry.cols)
+                if is_async:
+                    entry.worker.AddFireForget(values)
+                else:
+                    entry.worker.Add(values)
+            else:
+                values = values.reshape(len(ids), entry.cols)
+                ids = ids.astype(np.int32)
+                if is_async:
+                    entry.worker.AddFireForget(values, row_ids=ids)
+                else:
+                    entry.worker.AddRows(ids, values)
+        return 0
+
+    def _store_load(self, table, uri: bytes, store: bool) -> int:
+        from multiverso_tpu.utils.io import StreamFactory
+        from multiverso_tpu.zoo import Zoo
+        entry = self._tables[table]
+        Zoo.Get().DrainServer()  # order against submitted adds (native parity)
+        with StreamFactory.GetStream(uri.decode(), "wb" if store else "rb") as s:
+            if store:
+                entry.server.Store(s)
+            else:
+                entry.server.Load(s)
+        return 0
+
+    # -- install / uninstall ------------------------------------------------
+
+    def install(self) -> "NativeBridge":
+        g = self._guard
+        self._vtable = MV_BackendVTable(
+            init=INIT_FN(lambda argc, argv: g(self._init, argc, argv)),
+            shutdown=VOID_FN(lambda: g(self._shutdown)),
+            barrier=VOID_FN(lambda: g(self._barrier)),
+            num_workers=VOID_FN(lambda: g(self._num_workers, err=1)),
+            new_table=NEW_TABLE_FN(
+                lambda r, c, a: g(self._new_table, r, c, a)),
+            get=GET_FN(lambda t, ids, n, out, nf, w:
+                       g(self._get, t, ids, n, out, nf, w)),
+            add=ADD_FN(lambda t, ids, n, d, nf, a, w:
+                       g(self._add, t, ids, n, d, nf, a, w)),
+            store=URI_FN(lambda t, uri: g(self._store_load, t, uri, True)),
+            load=URI_FN(lambda t, uri: g(self._store_load, t, uri, False)),
+        )
+        self._lib.MV_RegisterBackend.restype = ctypes.c_int
+        self._lib.MV_RegisterBackend.argtypes = [
+            ctypes.POINTER(MV_BackendVTable)]
+        rc = self._lib.MV_RegisterBackend(ctypes.byref(self._vtable))
+        if rc != 0:
+            raise RuntimeError("MV_RegisterBackend failed (world live?)")
+        return self
+
+    def uninstall(self) -> None:
+        if self._vtable is None:
+            return
+        rc = self._lib.MV_RegisterBackend(None)
+        if rc != 0:
+            raise RuntimeError("cannot uninstall: native world still live")
+        self._vtable = None
+        self._tables.clear()
+
+
+def install(lib: Optional[ctypes.CDLL] = None) -> NativeBridge:
+    """Install the TPU backend into the native library (build/load it on
+    demand). Returns the bridge; keep it alive while native code runs."""
+    if lib is None:
+        from multiverso_tpu import native as native_mod
+        lib = native_mod.lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no toolchain?)")
+    return NativeBridge(lib).install()

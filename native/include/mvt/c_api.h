@@ -13,6 +13,42 @@ extern "C" {
 
 typedef void* TableHandler;
 
+/* -- TPU backend hook -------------------------------------------------------
+ * The reference's c_api.cpp wraps its real runtime (src/c_api.cpp:1-93); the
+ * TPU equivalent is this registration hook: the embedding host runtime (the
+ * python framework, via multiverso_tpu.binding.native_bridge) installs a
+ * vtable and every MV_* table verb below routes to it — so C, Lua (FFI) and
+ * C# (P/Invoke) callers in the process reach the SAME mesh-backed tables the
+ * python surface uses, TPU storage included. Without a registered backend
+ * the self-contained native CPU store serves (single-process world).
+ *
+ * All functions return 0 on success, nonzero on failure. row_ids == NULL
+ * means whole-table. worker_id is the caller thread's bound worker
+ * (MV_SetThreadWorkerId). Callbacks may be invoked concurrently from any
+ * native thread. */
+typedef struct MV_BackendVTable {
+  int (*init)(int* argc, char** argv);
+  int (*shutdown)(void);
+  int (*barrier)(void);
+  int (*num_workers)(void);
+  /* returns table id >= 0, or < 0 on failure. is_array distinguishes
+   * MV_NewArrayTable (1-D semantics) from a genuine 1-row matrix. */
+  int64_t (*new_table)(int64_t rows, int64_t cols, int32_t is_array);
+  int (*get)(int64_t table, const int32_t* row_ids, int32_t n_rows,
+             float* out, int64_t n_floats, int32_t worker_id);
+  int (*add)(int64_t table, const int32_t* row_ids, int32_t n_rows,
+             const float* data, int64_t n_floats, int32_t is_async,
+             int32_t worker_id);
+  int (*store)(int64_t table, const char* uri);
+  int (*load)(int64_t table, const char* uri);
+} MV_BackendVTable;
+
+/* Install (or, with NULL, remove) the backend. Must be called while no
+ * native world is live (before MV_Init / after MV_ShutDown). Returns 0 on
+ * success. The vtable is copied. */
+int MV_RegisterBackend(const MV_BackendVTable* vtable);
+int MV_HasBackend(void);
+
 void MV_Init(int* argc, char* argv[]);
 void MV_ShutDown();
 void MV_Barrier();

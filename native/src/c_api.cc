@@ -20,6 +20,10 @@ struct Runtime {
   std::unique_ptr<mvt::ServerC> server;
   int num_workers = 1;
   std::mutex mu;
+  // registered TPU backend (c_api.h MV_BackendVTable); by-value copy
+  MV_BackendVTable backend{};
+  bool has_backend = false;
+  bool backend_live = false;  // backend.init ran (world up through backend)
 };
 
 Runtime& rt() {
@@ -30,15 +34,28 @@ Runtime& rt() {
 thread_local int tls_worker_id = 0;
 
 struct TableRef {
-  int table_id;
+  int table_id;            // CPU-store id, or
+  int64_t backend_id = -1; // backend table id when routed
   size_t rows, cols;
 };
+
+bool routed() { return rt().has_backend && rt().backend_live; }
 
 void submit(mvt::MessagePtr msg, bool wait) {
   mvt::Waiter waiter(1);
   if (wait) msg->waiter = &waiter;
   rt().server->Receive(msg);
   if (wait) waiter.Wait();
+}
+
+// routed-path add; returns true when the backend handled it
+bool backend_add(TableRef* ref, const int* row_ids, int n_rows,
+                 const float* data, int n_floats, bool is_async) {
+  if (ref->backend_id < 0) return false;
+  MVT_CHECK(rt().backend.add(ref->backend_id, row_ids, n_rows, data,
+                             static_cast<int64_t>(n_floats),
+                             is_async ? 1 : 0, tls_worker_id) == 0);
+  return true;
 }
 
 mvt::MessagePtr make_add(TableRef* ref, const int* row_ids, int n_rows,
@@ -60,7 +77,34 @@ mvt::MessagePtr make_add(TableRef* ref, const int* row_ids, int n_rows,
 
 extern "C" {
 
+int MV_RegisterBackend(const MV_BackendVTable* vtable) {
+  std::lock_guard<std::mutex> lk(rt().mu);
+  if (rt().server != nullptr || rt().backend_live) {
+    mvt::LogError("MV_RegisterBackend while a world is live");
+    return -1;
+  }
+  if (vtable == nullptr) {
+    rt().has_backend = false;
+    return 0;
+  }
+  rt().backend = *vtable;
+  rt().has_backend = true;
+  return 0;
+}
+
+int MV_HasBackend() { return rt().has_backend ? 1 : 0; }
+
 void MV_Init(int* argc, char* argv[]) {
+  {
+    std::lock_guard<std::mutex> lk(rt().mu);
+    if (rt().has_backend) {
+      MVT_CHECK(!rt().backend_live);
+      MVT_CHECK(rt().backend.init(argc, argv) == 0);
+      rt().backend_live = true;
+      rt().num_workers = rt().backend.num_workers();
+      return;
+    }
+  }
   using mvt::config::Define;
   Define("sync", false);
   Define("num_workers", 1);
@@ -76,6 +120,11 @@ void MV_Init(int* argc, char* argv[]) {
 
 void MV_ShutDown() {
   std::lock_guard<std::mutex> lk(rt().mu);
+  if (rt().backend_live) {
+    rt().backend.shutdown();
+    rt().backend_live = false;
+    return;
+  }
   if (rt().server == nullptr) return;
   // drain BSP caches (reference Zoo::FinishTrain, zoo.cpp:152-162)
   for (int w = 0; w < rt().num_workers; ++w) {
@@ -93,6 +142,10 @@ void MV_ShutDown() {
 }
 
 void MV_Barrier() {
+  if (routed()) {
+    MVT_CHECK(rt().backend.barrier() == 0);
+    return;
+  }
   // single-process world: in-flight messages drain through the mailbox; a
   // ping round-trip gives the happens-before callers expect (it must not
   // use FinishTrain, which would advance BSP clocks mid-training)
@@ -102,28 +155,37 @@ void MV_Barrier() {
   submit(msg, true);
 }
 
-int MV_NumWorkers() { return rt().num_workers; }
+int MV_NumWorkers() {
+  return routed() ? rt().backend.num_workers() : rt().num_workers;
+}
 int MV_WorkerId() { return tls_worker_id; }
 int MV_ServerId() { return 0; }
 void MV_SetThreadWorkerId(int worker_id) { tls_worker_id = worker_id; }
 
 // -- tables -----------------------------------------------------------------
 
-static TableRef* new_table(size_t rows, size_t cols) {
+static TableRef* new_table(size_t rows, size_t cols, bool is_array) {
+  if (routed()) {
+    int64_t id = rt().backend.new_table(static_cast<int64_t>(rows),
+                                        static_cast<int64_t>(cols),
+                                        is_array ? 1 : 0);
+    MVT_CHECK(id >= 0);
+    return new TableRef{-1, id, rows, cols};
+  }
   MVT_CHECK(rt().server != nullptr);
   auto table = std::make_unique<mvt::TableC>(
       rows, cols, mvt::config::GetString("updater_type"), rt().num_workers);
   int id = rt().server->RegisterTable(std::move(table));
-  return new TableRef{id, rows, cols};
+  return new TableRef{id, -1, rows, cols};
 }
 
 void MV_NewArrayTable(int size, TableHandler* out) {
-  *out = new_table(1, static_cast<size_t>(size));
+  *out = new_table(1, static_cast<size_t>(size), /*is_array=*/true);
 }
 
 void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
   *out = new_table(static_cast<size_t>(num_row),
-                   static_cast<size_t>(num_col));
+                   static_cast<size_t>(num_col), /*is_array=*/false);
 }
 
 // Store/Load ride the server mailbox (kStoreTable/kLoadTable) so the
@@ -135,6 +197,11 @@ void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
 static int store_load(TableHandler handler, const char* uri,
                       mvt::MsgType type) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (ref->backend_id >= 0) {
+    return type == mvt::MsgType::kStoreTable
+               ? rt().backend.store(ref->backend_id, uri)
+               : rt().backend.load(ref->backend_id, uri);
+  }
   auto msg = std::make_shared<mvt::Message>();
   msg->type = type;
   msg->table_id = ref->table_id;
@@ -155,6 +222,12 @@ int MV_LoadTable(TableHandler handler, const char* uri) {
 static void do_get(TableHandler handler, float* data, int size,
                    const int* row_ids, int n_rows) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (ref->backend_id >= 0) {
+    MVT_CHECK(rt().backend.get(ref->backend_id, row_ids, n_rows, data,
+                               static_cast<int64_t>(size),
+                               tls_worker_id) == 0);
+    return;
+  }
   auto msg = std::make_shared<mvt::Message>();
   msg->type = mvt::MsgType::kRequestGet;
   msg->table_id = ref->table_id;
@@ -174,11 +247,13 @@ void MV_GetArrayTable(TableHandler handler, float* data, int size) {
 
 void MV_AddArrayTable(TableHandler handler, float* data, int size) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, nullptr, 0, data, size, false)) return;
   submit(make_add(ref, nullptr, 0, data, size), true);
 }
 
 void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, nullptr, 0, data, size, true)) return;
   submit(make_add(ref, nullptr, 0, data, size), false);
 }
 
@@ -188,11 +263,13 @@ void MV_GetMatrixTableAll(TableHandler handler, float* data, int size) {
 
 void MV_AddMatrixTableAll(TableHandler handler, float* data, int size) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, nullptr, 0, data, size, false)) return;
   submit(make_add(ref, nullptr, 0, data, size), true);
 }
 
 void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, nullptr, 0, data, size, true)) return;
   submit(make_add(ref, nullptr, 0, data, size), false);
 }
 
@@ -204,12 +281,14 @@ void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
 void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
                              int row_ids[], int row_ids_n) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, row_ids, row_ids_n, data, size, false)) return;
   submit(make_add(ref, row_ids, row_ids_n, data, size), true);
 }
 
 void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
                                   int row_ids[], int row_ids_n) {
   auto* ref = static_cast<TableRef*>(handler);
+  if (backend_add(ref, row_ids, row_ids_n, data, size, true)) return;
   submit(make_add(ref, row_ids, row_ids_n, data, size), false);
 }
 
