@@ -366,7 +366,8 @@ def bench_we_app(np, rng, tmpdir="/tmp/mvt_bench_we"):
 
 
 def bench_matrix_table(np, rng):
-    """-> (device_Melem_s, host_Melem_s, numpy_Melem_s)."""
+    """-> (device_Melem_s, device_dense_Melem_s, host_Melem_s,
+    numpy_Melem_s)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -415,6 +416,25 @@ def bench_matrix_table(np, rng):
         device_secs = min(device_secs, time.perf_counter() - t0)
     server.state = state
 
+    # dense variant: contiguous id blocks (reference test_matrix_perf's
+    # get-all phases / WE identity-remap blocks) — rides the kernels'
+    # coalesced multi-row-DMA branch instead of per-row DMAs
+    ids_dense = np.stack([
+        (np.arange(k) + int(b)).astype(np.int32)
+        for b in rng.integers(0, N_ROWS - k, STAGED_ROUNDS)])
+    padded_dn = jax.device_put(np.stack([server.pad_ids(r)
+                                         for r in ids_dense]))
+    state = jax.tree.map(jnp.copy, server.state)
+    state, ys = run_rounds(state, padded_dn, deltas_d)
+    float(ys[-1])
+    dense_secs = float("inf")
+    for _ in range(3):
+        state = jax.tree.map(jnp.copy, server.state)
+        t0 = time.perf_counter()
+        state, ys = run_rounds(state, padded_dn, deltas_d)
+        float(ys[-1])
+        dense_secs = min(dense_secs, time.perf_counter() - t0)
+
     # correctness (reference CHECKs every element, test_matrix_perf.cpp:84-110)
     # — accumulate only the contributions landing on the verified row set
     check_ids = ids_all[-1]
@@ -453,8 +473,8 @@ def bench_matrix_table(np, rng):
     numpy_secs = (time.perf_counter() - t0) * (ROUNDS / (HOST_ROUNDS * 2))
 
     elems = 2 * ROUNDS * k * N_COLS
-    return (elems / device_secs / 1e6, elems / host_secs / 1e6,
-            elems / numpy_secs / 1e6)
+    return (elems / device_secs / 1e6, elems / dense_secs / 1e6,
+            elems / host_secs / 1e6, elems / numpy_secs / 1e6)
 
 
 def main() -> int:
@@ -501,14 +521,16 @@ def main() -> int:
         out["we_app_words_per_sec"] = round(wps)
 
     def fill_matrix(res):
-        dev_me, host_me, base_me = res
+        dev_me, dense_me, host_me, base_me = res
         out["matrix_table_device_Melem_s"] = round(dev_me, 1)
+        out["matrix_table_device_dense_Melem_s"] = round(dense_me, 1)
         out["matrix_table_host_Melem_s"] = round(host_me, 1)
         out["matrix_table_numpy_baseline_Melem_s"] = round(base_me, 1)
         out["matrix_config"] = (f"{N_ROWS}x{N_COLS} f32, "
                                 f"{ROW_FRACTION:.0%} rows/op, "
                                 f"{ROUNDS} rounds cycling a "
-                                f"{STAGED_ROUNDS}-round staged pool")
+                                f"{STAGED_ROUNDS}-round staged pool; dense = "
+                                f"contiguous id blocks (coalesced DMA path)")
 
     def fill_sparse(me):
         out["sparse_matrix_host_Melem_s"] = round(me, 1)

@@ -1,12 +1,15 @@
 """Backend dispatch for the row gather/scatter/update table ops.
 
 ``use_pallas`` is governed by the ``use_pallas`` flag:
-``auto`` (default) — Pallas on TPU, XLA elsewhere; ``on`` — Pallas
-everywhere (interpreter mode off-TPU; used by tests); ``off`` — XLA.
+``auto`` (default) — reads via XLA's native gather everywhere, writes via
+the coalesced Pallas DMA kernels on TPU (the measured-fastest split: TPU
+vector loads gather random 512B rows at ~100 GB/s while XLA scatter
+crawls at ~6 GB/s, so each half rides its fast lane); ``on`` — Pallas for
+every verb incl. the fused single-kernel RMW (interpreter mode off-TPU;
+used by tests); ``off`` — XLA only.
 
 The XLA fallback relies on jit'd gather + ``.at[].set`` — on a CPU test
-mesh that is both correct and fast enough; on TPU the Pallas kernels avoid
-materializing gather/scatter HLO over the whole shard.
+mesh that is both correct and fast enough.
 
 Row DMAs slice HBM along the lane dim, so Pallas needs the row byte-width
 tile-aligned (128 lanes for 4-byte dtypes). The table layer pads its
@@ -74,10 +77,23 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _forced_on(data) -> bool:
+    """``use_pallas=on`` (test mode): force the Pallas kernel for verbs
+    whose default path is XLA, so tests keep covering the kernels."""
+    return (str(GetFlag("use_pallas")).lower() == "on"
+            and _pallas_eligible(data))
+
+
 def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
     """rows[i] = data[ids[i]]; all ids must be in range (caller maps
-    out-of-shard lanes to the trash row)."""
-    if use_pallas(data):
+    out-of-shard lanes to the trash row).
+
+    Reads ride XLA's native gather on every backend: measured on v5e it
+    runs at ~100 GB/s on RANDOM 512-byte rows — 5x the per-row-DMA Pallas
+    kernel and faster even than its coalesced contiguous branch (vector
+    loads beat DMA descriptors for reads). ``use_pallas=on`` still forces
+    the Pallas kernel so tests cover it."""
+    if _forced_on(data):
         from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
         return pallas_gather_rows(data, ids, interpret=_interpret())
     return jnp.take(data, ids, axis=0)
@@ -85,7 +101,12 @@ def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
 
 def scatter_set_rows(data: jax.Array, ids: jax.Array,
                      rows: jax.Array) -> jax.Array:
-    """data[ids[i]] = rows[i]; duplicates only on the trash row."""
+    """data[ids[i]] = rows[i]; duplicates only on the trash row.
+
+    Writes are the mirror image of reads on TPU: XLA's scatter measured
+    ~3-6 GB/s (it serializes), while the Pallas row-DMA kernel does
+    ~25 GB/s random and 60-200 GB/s on coalesced contiguous runs — so
+    writes keep the Pallas path wherever it is eligible."""
     if use_pallas(data):
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         return pallas_scatter_set_rows(data, ids, rows, interpret=_interpret())
@@ -94,18 +115,26 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
 
 def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
                 combine) -> jax.Array:
-    """data[ids[i]] = combine(data[ids[i]], deltas[i]) — the fused
-    read-modify-write Add for aux-free elementwise updaters. ``combine``
-    must satisfy combine(rows, 0) == rows (see pallas_rows contract) and be
+    """data[ids[i]] = combine(data[ids[i]], deltas[i]) — the server-side
+    Add for aux-free elementwise updaters. ``combine`` must satisfy
+    combine(rows, 0) == rows (see pallas_rows contract) and be
     identity-stable (one object per table) so the jit cache holds.
 
-    On the XLA path this is gather + combine + scatter (XLA fuses the
-    elementwise into the scatter operand); on TPU it is one Pallas kernel
-    doing row-DMA in / vector op / row-DMA out.
-    """
-    if use_pallas(data):
+    Default TPU path is the HYBRID: XLA vector-gather for the read half
+    (~100 GB/s random — see gather_rows), combine fused elementwise, and
+    the coalesced Pallas scatter for the write half. Measured ~1.5x over
+    the all-DMA fused kernel on random row sets (250us vs 365us for 10k
+    512B rows) and comparable on contiguous sets (both coalesce).
+    ``use_pallas=on`` forces the fused single-kernel RMW so tests cover
+    it; the XLA fallback is gather + combine + scatter."""
+    if _forced_on(data):
         from multiverso_tpu.ops.pallas_rows import pallas_update_rows
         return pallas_update_rows(data, ids, deltas, combine,
                                   interpret=_interpret())
+    if use_pallas(data):
+        from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
+        rows = jnp.take(data, ids, axis=0)
+        return pallas_scatter_set_rows(data, ids, combine(rows, deltas),
+                                       interpret=_interpret())
     rows = jnp.take(data, ids, axis=0)
     return data.at[ids].set(combine(rows, deltas))

@@ -68,6 +68,50 @@ class TestPallasKernels:
         # untouched rows intact
         np.testing.assert_array_equal(np.asarray(out)[[0, 1, 3]], 1.0)
 
+    def test_coalesced_contiguous_chunks(self):
+        """Chunks whose ids are strictly consecutive take the single
+        multi-row-DMA branch (pallas_rows._contig); this drives full-chunk
+        contiguous id sets through all three kernels and checks they match
+        the per-row semantics exactly."""
+        from multiverso_tpu.ops.pallas_rows import (CHUNK, pallas_gather_rows,
+                                                    pallas_scatter_set_rows,
+                                                    pallas_update_rows)
+        rng = np.random.default_rng(3)
+        rows_n = 4 * CHUNK
+        data = rng.standard_normal((rows_n, 8)).astype(np.float32)
+        # chunk 0: contiguous run; chunk 1: shuffled (per-row branch)
+        contig = np.arange(CHUNK, dtype=np.int32) + 17
+        scattered = rng.choice(rows_n, CHUNK, replace=False).astype(np.int32)
+        rng.shuffle(scattered)
+        # drop duplicates between the halves so update stays race-free
+        seen = set(contig.tolist())
+        scattered = np.array([i for i in scattered if i not in seen],
+                             np.int32)[:CHUNK]
+        while len(scattered) < CHUNK:   # refill to a full chunk
+            cand = int(rng.integers(0, rows_n))
+            if cand not in seen and cand not in scattered:
+                scattered = np.append(scattered, np.int32(cand))
+        ids = np.concatenate([contig, scattered]).astype(np.int32)
+
+        got = pallas_gather_rows(jnp.asarray(data), jnp.asarray(ids),
+                                 interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), data[ids])
+
+        new_rows = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        out = pallas_scatter_set_rows(jnp.asarray(data), jnp.asarray(ids),
+                                      jnp.asarray(new_rows), interpret=True)
+        expect = data.copy()
+        expect[ids] = new_rows
+        np.testing.assert_array_equal(np.asarray(out), expect)
+
+        deltas = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        out = pallas_update_rows(jnp.asarray(data), jnp.asarray(ids),
+                                 jnp.asarray(deltas),
+                                 combine=lambda r, d: r + d, interpret=True)
+        expect = data.copy()
+        expect[ids] += deltas
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
     def test_scatter_preserves_untouched(self):
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         data = np.arange(40, dtype=np.float32).reshape(8, 5)
