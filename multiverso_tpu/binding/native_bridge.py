@@ -58,7 +58,8 @@ GET_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64,
 ADD_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64,
                           ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
                           ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
-                          ctypes.c_int32, ctypes.c_int32)
+                          ctypes.c_int32, ctypes.c_int32,
+                          ctypes.POINTER(ctypes.c_float))
 URI_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int64, ctypes.c_char_p)
 
 
@@ -182,12 +183,20 @@ class NativeBridge:
         return 0
 
     def _add(self, table, row_ids, n_rows, data, n_floats, is_async,
-             worker_id) -> int:
+             worker_id, add_opt) -> int:
+        from multiverso_tpu.updaters.base import AddOption
         from multiverso_tpu.zoo import Zoo
         entry = self._tables[table]
         ids = self._ids(row_ids, n_rows)
         # copy: an async caller may reuse its buffer the moment we return
         values = np.ctypeslib.as_array(data, shape=(int(n_floats),)).copy()
+        # {momentum, lr, rho, lambda} from MV_SetThreadAddOption; the
+        # c_api contract says never NULL — surface a violation loudly
+        if not add_opt:
+            raise ValueError("add_opt must not be NULL (c_api.h contract)")
+        opt = AddOption(worker_id=int(worker_id), momentum=add_opt[0],
+                        learning_rate=add_opt[1], rho=add_opt[2],
+                        lambda_=add_opt[3])
         with Zoo.Get().worker_context(worker_id):
             if ids is None:
                 if values.size != entry.rows * entry.cols:
@@ -195,16 +204,17 @@ class NativeBridge:
                 if not entry.is_array:
                     values = values.reshape(entry.rows, entry.cols)
                 if is_async:
-                    entry.worker.AddFireForget(values)
+                    entry.worker.AddFireForget(values, option=opt)
                 else:
-                    entry.worker.Add(values)
+                    entry.worker.Add(values, option=opt)
             else:
                 values = values.reshape(len(ids), entry.cols)
                 ids = ids.astype(np.int32)
                 if is_async:
-                    entry.worker.AddFireForget(values, row_ids=ids)
+                    entry.worker.AddFireForget(values, row_ids=ids,
+                                               option=opt)
                 else:
-                    entry.worker.AddRows(ids, values)
+                    entry.worker.AddRows(ids, values, option=opt)
         return 0
 
     def _store_load(self, table, uri: bytes, store: bool) -> int:
@@ -232,8 +242,8 @@ class NativeBridge:
                 lambda r, c, a: g(self._new_table, r, c, a)),
             get=GET_FN(lambda t, ids, n, out, nf, w:
                        g(self._get, t, ids, n, out, nf, w)),
-            add=ADD_FN(lambda t, ids, n, d, nf, a, w:
-                       g(self._add, t, ids, n, d, nf, a, w)),
+            add=ADD_FN(lambda t, ids, n, d, nf, a, w, o:
+                       g(self._add, t, ids, n, d, nf, a, w, o)),
             store=URI_FN(lambda t, uri: g(self._store_load, t, uri, True)),
             load=URI_FN(lambda t, uri: g(self._store_load, t, uri, False)),
         )

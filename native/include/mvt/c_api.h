@@ -36,9 +36,11 @@ typedef struct MV_BackendVTable {
   int64_t (*new_table)(int64_t rows, int64_t cols, int32_t is_array);
   int (*get)(int64_t table, const int32_t* row_ids, int32_t n_rows,
              float* out, int64_t n_floats, int32_t worker_id);
+  /* add_opt = {momentum, learning_rate, rho, lambda} (the caller
+   * thread's MV_SetThreadAddOption values; never NULL) */
   int (*add)(int64_t table, const int32_t* row_ids, int32_t n_rows,
              const float* data, int64_t n_floats, int32_t is_async,
-             int32_t worker_id);
+             int32_t worker_id, const float* add_opt);
   int (*store)(int64_t table, const char* uri);
   int (*load)(int64_t table, const char* uri);
 } MV_BackendVTable;
@@ -76,6 +78,14 @@ void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
 
 // Worker identity for multi-threaded native clients (thread-local).
 void MV_SetThreadWorkerId(int worker_id);
+
+/* Per-Add updater parameters for this thread's subsequent Adds
+ * (thread-local; reference AddOption fields, updater.h:10-70 — the
+ * reference rode these inside each message; the C ABI sets them once per
+ * thread instead). Defaults: momentum 0, learning_rate 0.01, rho 0.1,
+ * lambda 0.1. */
+void MV_SetThreadAddOption(float momentum, float learning_rate, float rho,
+                           float lambda);
 
 /* Table persistence for native clients (extension over the reference C
  * ABI, which has none; semantics = the Serializable contract,

@@ -32,6 +32,7 @@ Runtime& rt() {
 }
 
 thread_local int tls_worker_id = 0;
+thread_local mvt::AddOptionC tls_add_option;
 
 struct TableRef {
   int table_id;            // CPU-store id, or
@@ -52,9 +53,11 @@ void submit(mvt::MessagePtr msg, bool wait) {
 bool backend_add(TableRef* ref, const int* row_ids, int n_rows,
                  const float* data, int n_floats, bool is_async) {
   if (ref->backend_id < 0) return false;
+  const float opt[4] = {tls_add_option.momentum, tls_add_option.learning_rate,
+                        tls_add_option.rho, tls_add_option.lambda};
   MVT_CHECK(rt().backend.add(ref->backend_id, row_ids, n_rows, data,
                              static_cast<int64_t>(n_floats),
-                             is_async ? 1 : 0, tls_worker_id) == 0);
+                             is_async ? 1 : 0, tls_worker_id, opt) == 0);
   return true;
 }
 
@@ -67,7 +70,7 @@ mvt::MessagePtr make_add(TableRef* ref, const int* row_ids, int n_rows,
   msg->data.emplace_back(row_ids,
                          static_cast<size_t>(n_rows) * sizeof(int));
   msg->data.emplace_back(data, static_cast<size_t>(n_floats) * sizeof(float));
-  mvt::AddOptionC opt;
+  mvt::AddOptionC opt = tls_add_option;
   opt.worker_id = tls_worker_id;
   msg->data.emplace_back(&opt, sizeof(opt));
   return msg;
@@ -161,6 +164,14 @@ int MV_NumWorkers() {
 int MV_WorkerId() { return tls_worker_id; }
 int MV_ServerId() { return 0; }
 void MV_SetThreadWorkerId(int worker_id) { tls_worker_id = worker_id; }
+
+void MV_SetThreadAddOption(float momentum, float learning_rate, float rho,
+                           float lambda) {
+  tls_add_option.momentum = momentum;
+  tls_add_option.learning_rate = learning_rate;
+  tls_add_option.rho = rho;
+  tls_add_option.lambda = lambda;
+}
 
 // -- tables -----------------------------------------------------------------
 

@@ -1,0 +1,170 @@
+"""Native <-> Python runtime parity oracle.
+
+The framework ships two runtimes claiming identical updater semantics: the
+python/JAX mesh tables (multiverso_tpu/updaters/base.py) and the native
+CPU store serving foreign bindings (native/src/store.cc). The reference
+had ONE implementation (src/updater/updater.cpp:21-57); having two means
+drift is possible — this file makes drift a test failure.
+
+For every updater, the same seeded random verb walk (row adds with
+per-step worker ids and per-step AddOption hyperparameters, interleaved
+whole-table reads) runs through BOTH runtimes:
+
+* native: ctypes over libmultiverso_tpu.so — MV_Init with
+  ``-updater_type``, MV_SetThreadWorkerId + MV_SetThreadAddOption before
+  each Add (the C ABI's thread-local equivalent of the option blob the
+  reference rode inside each message), MV_AddMatrixTableByRows,
+  MV_GetMatrixTableAll;
+* python: MV_CreateTable(MatrixTableOption(updater_type=...)) +
+  AddRows(..., AddOption(...)).
+
+Every interleaved Get must match element-wise (f32 tolerance): one walk,
+two runtimes, zero drift.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+R, C, W = 23, 6, 3
+STEPS = 24
+CHECK_EVERY = 6
+
+
+@pytest.fixture(scope="module")
+def capi():
+    result = subprocess.run(["make", "-C", NATIVE_DIR, "-j4"],
+                            capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    lib = ctypes.CDLL(os.path.join(NATIVE_DIR, "libmultiverso_tpu.so"))
+    lib.MV_SetThreadAddOption.argtypes = [ctypes.c_float] * 4
+    return lib
+
+
+def walk_ops(seed):
+    """The shared verb schedule: (worker_id, ids, deltas, opt_floats)."""
+    rng = np.random.default_rng(seed)
+    for step in range(STEPS):
+        wid = int(rng.integers(0, W))
+        k = int(rng.integers(1, R))
+        ids = rng.choice(R, k, replace=False).astype(np.int32)
+        deltas = (rng.standard_normal((k, C)) * 0.5).astype(np.float32)
+        opt = (float(rng.uniform(0.1, 0.9)),     # momentum
+               float(rng.uniform(0.05, 0.5)),    # learning_rate
+               float(rng.uniform(0.05, 0.5)),    # rho
+               float(rng.uniform(0.05, 0.5)))    # lambda
+        yield step, wid, ids, deltas, opt
+
+
+def run_native(capi, updater, seed):
+    """-> list of whole-table snapshots at the CHECK_EVERY marks."""
+    argc = ctypes.c_int(3)
+    argv = (ctypes.c_char_p * 3)(
+        b"prog", f"-updater_type={updater}".encode(),
+        f"-num_workers={W}".encode())
+    capi.MV_Init(ctypes.byref(argc), argv)
+    snaps = []
+    try:
+        handle = ctypes.c_void_p()
+        capi.MV_NewMatrixTable(R, C, ctypes.byref(handle))
+        fptr = ctypes.POINTER(ctypes.c_float)
+        iptr = ctypes.POINTER(ctypes.c_int)
+        buf = np.zeros((R, C), np.float32)
+        for step, wid, ids, deltas, opt in walk_ops(seed):
+            capi.MV_SetThreadWorkerId(wid)
+            capi.MV_SetThreadAddOption(*opt)
+            capi.MV_AddMatrixTableByRows(
+                handle, deltas.ctypes.data_as(fptr), deltas.size,
+                ids.ctypes.data_as(iptr), len(ids))
+            if (step + 1) % CHECK_EVERY == 0:
+                capi.MV_GetMatrixTableAll(
+                    handle, buf.ctypes.data_as(fptr), R * C)
+                snaps.append(buf.copy())
+    finally:
+        capi.MV_ShutDown()
+    return snaps
+
+
+def run_python(updater, seed):
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.updaters import AddOption
+    mv.MV_Init([f"-num_workers={W}"])
+    snaps = []
+    try:
+        table = mv.MV_CreateTable(MatrixTableOption(
+            num_rows=R, num_cols=C, updater_type=updater))
+        for step, wid, ids, deltas, opt in walk_ops(seed):
+            m, lr, rho, lam = opt
+            table.AddRows(ids, deltas, AddOption(
+                worker_id=wid, momentum=m, learning_rate=lr, rho=rho,
+                lambda_=lam))
+            if (step + 1) % CHECK_EVERY == 0:
+                snaps.append(np.asarray(table.Get()).copy())
+    finally:
+        mv.MV_ShutDown()
+    return snaps
+
+
+@pytest.mark.parametrize("updater", ["default", "sgd", "momentum",
+                                     "adagrad", "dcasgd"])
+@pytest.mark.parametrize("seed", [11, 12])
+def test_native_python_drift(capi, updater, seed):
+    native_snaps = run_native(capi, updater, seed)
+    python_snaps = run_python(updater, seed)
+    assert len(native_snaps) == len(python_snaps) == STEPS // CHECK_EVERY
+    for i, (n, p) in enumerate(zip(native_snaps, python_snaps)):
+        np.testing.assert_allclose(
+            n, p, rtol=2e-4, atol=2e-5,
+            err_msg=f"updater={updater} drifted at checkpoint {i}")
+
+
+def test_dcasgd_zero_lr_degrade_parity(capi):
+    """Both runtimes degrade lr<=0 DCASGD to plain SGD (ADVICE round-1
+    alignment) — drive it through both, not just unit-level."""
+    argc = ctypes.c_int(2)
+    argv = (ctypes.c_char_p * 2)(b"prog", b"-updater_type=dcasgd")
+    capi.MV_Init(ctypes.byref(argc), argv)
+    try:
+        handle = ctypes.c_void_p()
+        capi.MV_NewMatrixTable(4, 3, ctypes.byref(handle))
+        fptr = ctypes.POINTER(ctypes.c_float)
+        iptr = ctypes.POINTER(ctypes.c_int)
+        # thread identity is caller-managed TLS: a previous world's worker
+        # id (up to W-1) would be out of range in this 1-worker world
+        capi.MV_SetThreadWorkerId(0)
+        capi.MV_SetThreadAddOption(0.0, 0.0, 0.1, 0.1)
+        deltas = np.full((2, 3), 0.5, np.float32)
+        ids = np.array([0, 2], np.int32)
+        capi.MV_AddMatrixTableByRows(handle, deltas.ctypes.data_as(fptr), 6,
+                                     ids.ctypes.data_as(iptr), 2)
+        out = np.zeros((4, 3), np.float32)
+        capi.MV_GetMatrixTableAll(handle, out.ctypes.data_as(fptr), 12)
+        capi.MV_SetThreadAddOption(0.0, 0.01, 0.1, 0.1)  # restore defaults
+    finally:
+        capi.MV_ShutDown()
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[[0, 2]], -0.5)
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.updaters import AddOption
+    mv.MV_Init([])
+    try:
+        table = mv.MV_CreateTable(MatrixTableOption(
+            num_rows=4, num_cols=3, updater_type="dcasgd"))
+        table.AddRows(ids, deltas, AddOption(learning_rate=0.0))
+        py = np.asarray(table.Get())
+    finally:
+        mv.MV_ShutDown()
+    np.testing.assert_allclose(py, out, rtol=1e-6)
