@@ -344,14 +344,20 @@ class MatrixServerTable(ServerTable):
                                        option.as_jnp())
 
     def ProcessGet(self, option: GetOption,
-                   row_ids: Optional[np.ndarray] = None):
+                   row_ids: Optional[np.ndarray] = None,
+                   _union: Optional[np.ndarray] = None):
+        """``_union``: a subclass that already knows every process's id set
+        of this collective Get (SparseMatrixTable computes all ranks' stale
+        sets for its lockstep bits) passes the precomputed union so the
+        id sets don't ride a second host collective."""
         if row_ids is None:
             data = self.updater.access(self.state["data"], self.state["aux"],
                                        None)
             return self._from_storage(self._zoo.mesh_ctx.fetch(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
-        union = multihost.union_collective_ids(ids)
+        union = (_union if _union is not None
+                 else multihost.union_collective_ids(ids))
         if union is not None:
             # each process may request different rows of this collective
             # Get: gather the union with one identical program everywhere,

@@ -16,6 +16,18 @@ TPU design: the freshness bits are host-side control-plane state (a numpy
 bool matrix) — deciding *which* rows to ship is host logic; only the row
 data itself lives in HBM and moves via the jit'd gather/scatter of the
 parent class.
+
+Multi-process design (reference parity: the dirty-row protocol is
+inherently multi-worker-multi-node, sparse_matrix_table.cpp:200-259):
+the bit matrix is REPLICATED per process and keyed by *global* worker id
+``rank * num_workers + local_wid`` — every (process, worker thread) pair
+is a distinct physical consumer that must see each update once. Lockstep
+holds because every table op is collective (the parent's contract):
+Adds/Gets allgather their (worker_id, row_ids) parts, and every process
+applies every part's freshness transition in rank order — the same
+global event stream a single shared server would see, so the replicas
+can never diverge. The data gather itself rides the parent's union
+collective (one identical device program everywhere).
 """
 
 from __future__ import annotations
@@ -48,60 +60,107 @@ class SparseMatrixServerTable(MatrixServerTable):
                  initializer=None):
         super().__init__(num_rows, num_cols, dtype, zoo, updater_type,
                          initializer)
-        # Per-worker freshness is host control-plane state keyed by the
-        # per-process worker-id space; in a multi-process job the bit
-        # matrices (and the dynamic stale sets shipped per Get) would
-        # diverge across hosts, breaking the collective contract — use
-        # MatrixTable or the device plane there (documented limitation).
         from multiverso_tpu.parallel import multihost
-        CHECK(multihost.process_count() <= 1,
-              "SparseMatrixTable host-plane is single-process")
+        self._procs = max(1, multihost.process_count())
+        self._rank = multihost.process_index() if self._procs > 1 else 0
+        self._workers_per_proc = zoo.num_workers
+        if self._procs > 1:
+            # the gwid mapping for EVERY rank is computed from the local
+            # flag — mismatched -num_workers would silently diverge the
+            # replicated bits, so agreement is checked once at creation
+            counts = multihost.host_allgather_objects(zoo.num_workers)
+            CHECK(all(c == counts[0] for c in counts),
+                  f"-num_workers diverges across processes: {counts}")
         # all-fresh at start (reference ctor sets true,
-        # sparse_matrix_table.cpp:184-196)
-        self.up_to_date = np.ones((zoo.num_workers, num_rows), dtype=bool)
+        # sparse_matrix_table.cpp:184-196); one row per GLOBAL worker —
+        # see module docstring (multi-process design)
+        self.up_to_date = np.ones((self._procs * zoo.num_workers, num_rows),
+                                  dtype=bool)
 
-    def _update_add_state(self, worker_id: int,
-                          row_ids: Optional[np.ndarray]) -> None:
-        """reference UpdateAddState (sparse_matrix_table.cpp:200-223)."""
+    def _gwid(self, rank: int, worker_id: int) -> Optional[int]:
+        """Global worker id, or None for out-of-range/-1 ids — a
+        system-level push with no owning worker (reference UpdateAddState
+        tolerates these: no keeper, everyone goes stale)."""
+        if not 0 <= worker_id < self._workers_per_proc:
+            return None
+        return rank * self._workers_per_proc + worker_id
+
+    def _mark_stale(self, keeper: Optional[int],
+                    row_ids: Optional[np.ndarray]) -> None:
+        """reference UpdateAddState (sparse_matrix_table.cpp:200-223):
+        mark ``row_ids`` (None = all) stale for every global worker except
+        ``keeper`` (the physical worker whose own push this was)."""
         mask = np.ones(self.up_to_date.shape[0], dtype=bool)
-        if 0 <= worker_id < self.up_to_date.shape[0]:
-            mask[worker_id] = False
+        if keeper is not None:
+            mask[keeper] = False
         if row_ids is None:
             self.up_to_date[mask, :] = False
         else:
             cols = np.asarray(row_ids, np.int64).ravel()
             self.up_to_date[np.ix_(mask, cols)] = False
 
-    def _update_get_state(self, worker_id: int,
+    def _update_get_state(self, gwid: int,
                           row_ids: Optional[np.ndarray]) -> np.ndarray:
         """reference UpdateGetState (sparse_matrix_table.cpp:226-259):
-        returns the row ids to ship and re-marks them fresh."""
-        if worker_id == -1:
+        returns the row ids to ship and re-marks them fresh. ``gwid`` is a
+        global worker id (or -1 = fetch everything)."""
+        if gwid == -1:
             return np.arange(self.num_rows, dtype=np.int32)
         if row_ids is None:
-            stale = np.nonzero(~self.up_to_date[worker_id])[0]
+            stale = np.nonzero(~self.up_to_date[gwid])[0]
         else:
             ids = np.asarray(row_ids, np.int64).ravel()
-            stale = ids[~self.up_to_date[worker_id, ids]]
+            stale = ids[~self.up_to_date[gwid, ids]]
         if stale.size == 0:
             # all fresh -> still ship row 0 (sparse_matrix_table.cpp:255-257)
             return np.zeros(1, dtype=np.int32)
-        self.up_to_date[worker_id, stale] = True
+        self.up_to_date[gwid, stale] = True
         return stale.astype(np.int32)
+
+    def _allgather_parts(self, part):
+        """Every process's (worker_id, row_ids) of this collective op, in
+        rank order — identical on every process (lockstep transitions)."""
+        if self._procs <= 1:
+            return [part]
+        from multiverso_tpu.parallel import multihost
+        return multihost.host_allgather_objects(part)
 
     def ProcessAdd(self, values, option: AddOption, row_ids=None) -> None:
         # apply (and validate) the data first; only then mark rows stale —
-        # a rejected add must not desynchronize the freshness bits
+        # a rejected add must not desynchronize the freshness bits.
+        # Multi-process note: the parent's collective merge CHECKs that the
+        # AddOption (worker_id included) agrees across processes, so one
+        # collective Add is attributed to the same LOCAL worker id
+        # everywhere; the per-rank parts still map to distinct GLOBAL
+        # keepers (rank * W + wid) and each keeper stays fresh only for
+        # the rows its own process pushed.
         super().ProcessAdd(values, option, row_ids)
-        self._update_add_state(option.worker_id, row_ids)
+        ids = None if row_ids is None else np.asarray(row_ids, np.int64)
+        for rank, (wid, part_ids) in enumerate(
+                self._allgather_parts((option.worker_id, ids))):
+            self._mark_stale(self._gwid(rank, wid), part_ids)
 
     def ProcessGet(self, option: GetOption,
                    row_ids=None) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (row_ids, rows) — the server decides which rows move."""
         worker_id = option.worker_id if option is not None else -1
-        out_ids = self._update_get_state(worker_id, row_ids)
+        ids = None if row_ids is None else np.asarray(row_ids, np.int64)
+        out_ids = None
+        part_outs = []
+        for rank, (wid, part_ids) in enumerate(
+                self._allgather_parts((worker_id, ids))):
+            gwid = self._gwid(rank, wid)
+            part_out = self._update_get_state(-1 if gwid is None else gwid,
+                                              part_ids)
+            part_outs.append(part_out)
+            if rank == self._rank:
+                out_ids = part_out
+        # every rank's stale set is already known here — hand the parent
+        # the precomputed union so the ids don't ride a second collective
+        union = (np.unique(np.concatenate(part_outs)).astype(np.int32)
+                 if self._procs > 1 else None)
         rows = super().ProcessGet(GetOption(worker_id=worker_id),
-                                  row_ids=out_ids)
+                                  row_ids=out_ids, _union=union)
         return out_ids, rows
 
 

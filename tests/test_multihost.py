@@ -178,6 +178,75 @@ class TestTwoProcessSync:
         run_two_process(_SYNC_CHILD, tmp_path, expect="SYNC OK")
 
 
+_SPARSE_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import SparseMatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+t = mv.MV_CreateTable(SparseMatrixTableOption(num_rows=16, num_cols=3))
+
+# collective Add, divergent row sets: rank0 pushes rows [1,3] (+1), rank1
+# pushes [5,7] (+2). Freshness oracle (one shared server, global workers
+# gwid=rank): each pusher keeps its OWN rows fresh, the peer's rows stale.
+my_ids = np.array([1, 3] if rank == 0 else [5, 7], np.int32)
+t.AddRows(my_ids, np.full((2, 3), float(rank + 1), np.float32))
+
+ids, rows = t.Get()
+expect_ids = [5, 7] if rank == 0 else [1, 3]
+expect_val = 2.0 if rank == 0 else 1.0
+assert ids.tolist() == expect_ids, (rank, ids)
+assert np.allclose(rows, expect_val), (rank, rows)
+
+# everything fresh now -> protocol still ships row 0
+ids, rows = t.Get()
+assert ids.tolist() == [0] and np.allclose(rows, 0.0), (rank, ids, rows)
+
+# second divergent Add: rank0 re-pushes row 5, rank1 pushes row 9
+t.AddRows(np.array([5] if rank == 0 else [9], np.int32),
+          np.full((1, 3), float(rank + 1), np.float32))
+ids, rows = t.Get()
+if rank == 0:
+    assert ids.tolist() == [9] and np.allclose(rows, 2.0), (ids, rows)
+else:
+    assert ids.tolist() == [5] and np.allclose(rows, 3.0), (ids, rows)
+
+# row-set-restricted Get: only the stale subset of the requested ids ships
+t.AddRows(np.array([2] if rank == 0 else [12], np.int32),
+          np.full((1, 3), 1.0, np.float32))
+ids, rows = t.GetRows(np.array([2, 3, 12], np.int32))
+expect_ids = [12] if rank == 0 else [2]
+assert ids.tolist() == expect_ids, (rank, ids)
+
+# whole-table collective Add marks everything stale for everyone (each
+# keeper is un-marked only by its own part); both fetch all 16 rows
+t.Add(np.ones((16, 3), np.float32))
+ids, rows = t.Get()
+assert len(ids) == 16, (rank, ids)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} SPARSE OK", flush=True)
+'''
+
+
+class TestTwoProcessSparse:
+    def test_dirty_row_protocol_across_processes(self, tmp_path):
+        """The per-worker dirty-row protocol holds across jax.distributed
+        processes (reference sparse_matrix_table.cpp:200-259 is inherently
+        multi-node): freshness bits are replicated per process, keyed by
+        global worker id, and kept in lockstep by applying every process's
+        allgathered (worker, rows) parts in rank order — each interleaved
+        Get ships exactly the single-shared-server oracle's stale set."""
+        run_two_process(_SPARSE_CHILD, tmp_path, expect="SPARSE OK")
+
+
 _LR_CHILD = r'''
 import os, sys
 rank, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]

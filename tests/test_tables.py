@@ -523,6 +523,23 @@ class TestSparseMatrixTable:
         finally:
             mv.MV_ShutDown()
 
+    def test_ownerless_add_marks_everyone_stale(self):
+        """An Add with worker_id=-1 (a system-level push with no owning
+        worker — reference UpdateAddState tolerates out-of-range ids) has
+        no keeper: every worker sees the rows stale."""
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = self._make(mv)
+            table.AddRows([3, 6], np.ones((2, 3), np.float32),
+                          AddOption(worker_id=-1))
+            for w in (0, 1):
+                ids, rows = table.Get(GetOption(worker_id=w))
+                assert sorted(ids.tolist()) == [3, 6], (w, ids)
+                np.testing.assert_allclose(rows, 1.0)
+        finally:
+            mv.MV_ShutDown()
+
     def test_get_rows_subset(self):
         import multiverso_tpu as mv
         mv.MV_Init(["-num_workers=2"])
