@@ -3,9 +3,10 @@
 Behavioral equivalent of reference include/multiverso/multiverso.h:9-64 /
 src/multiverso.cpp: init/shutdown/barrier, rank & size, worker/server id
 maps, table creation (+ implicit barrier), programmatic flags, and
-``MV_Aggregate`` allreduce. ``MV_NetBind``/``MV_NetConnect`` (explicit ZMQ
-endpoints, multiverso.h:54-63) have no TPU meaning — mesh/ICI wiring is
-fixed by hardware — and raise with an explanatory error.
+``MV_Aggregate`` allreduce. ``MV_NetBind``/``MV_NetConnect`` (explicit
+endpoints, multiverso.h:54-63 — the reference's MPI-free ZMQ deployment
+path) map to launcher-free ``jax.distributed`` bring-up: the declarations
+feed the next MV_Init, rank 0's endpoint being the coordinator.
 """
 
 from __future__ import annotations
@@ -35,6 +36,11 @@ def MV_ShutDown(finalize_net: bool = True) -> None:
     Zoo._reset_for_tests()
     from multiverso_tpu.utils.configure import ResetFlagsToDefaults
     ResetFlagsToDefaults()
+    # forget MV_NetBind/MV_NetConnect declarations: a retry after a failed
+    # explicit bring-up must be able to run single-process (jax.distributed
+    # itself, once up, stays up — process-level state)
+    from multiverso_tpu.parallel import multihost
+    multihost.net_reset()
 
 
 def MV_Barrier() -> None:
@@ -93,16 +99,24 @@ def MV_Aggregate(data: np.ndarray) -> np.ndarray:
     return Zoo.Get().Aggregate(data)
 
 
-def MV_NetBind(rank: int, endpoint: str) -> None:  # pragma: no cover - parity stub
-    raise NotImplementedError(
-        "MV_NetBind is a ZMQ-deployment hook (reference multiverso.h:54-63); "
-        "TPU meshes are wired by hardware/jax.distributed, nothing to bind")
+def MV_NetBind(rank: int, endpoint: str) -> int:
+    """Declare this process's rank + endpoint for launcher-free bring-up
+    (reference MV_NetBind, multiverso.h:55 / zmq_net.h:64-81: the
+    MPI-free ZMQ deployment path). TPU mapping: the declarations feed
+    ``jax.distributed`` at the next MV_Init — rank 0's endpoint is the
+    coordinator the world rendezvouses on. Call before MV_Init; 0 on
+    success, -1 on error (reference return convention)."""
+    from multiverso_tpu.parallel import multihost
+    return multihost.net_bind(rank, endpoint)
 
 
-def MV_NetConnect(ranks, endpoints) -> None:  # pragma: no cover - parity stub
-    raise NotImplementedError(
-        "MV_NetConnect is a ZMQ-deployment hook (reference multiverso.h:54-63); "
-        "TPU meshes are wired by hardware/jax.distributed, nothing to connect")
+def MV_NetConnect(ranks, endpoints) -> int:
+    """Declare the full world as parallel (ranks, endpoints) lists
+    (reference MV_NetConnect, multiverso.h:56 / zmq_net.h:83-110).
+    Requires a prior MV_NetBind; the next MV_Init wires jax.distributed
+    from this world. 0 on success, -1 on error."""
+    from multiverso_tpu.parallel import multihost
+    return multihost.net_connect(ranks, endpoints)
 
 
 def MV_SaveCheckpoint(uri: str) -> int:

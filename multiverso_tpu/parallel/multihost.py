@@ -62,6 +62,76 @@ MV_DEFINE_int("dist_size", -1, "total process count (jax.distributed)")
 
 _initialized = False
 
+# Explicit-endpoint bring-up state (MV_NetBind / MV_NetConnect): the
+# launcher-free deployment path. The reference's ZMQ transport let a
+# process declare its own (rank, endpoint) and the full world without MPI
+# (zmq_net.h:64-110); the TPU equivalent wires the same two declarations
+# into jax.distributed — rank 0's endpoint IS the coordinator.
+_net_rank: Optional[int] = None
+_net_endpoint: Optional[str] = None
+_net_world: Optional[dict] = None  # rank -> endpoint
+
+
+def net_bind(rank: int, endpoint: str) -> int:
+    """Declare THIS process's rank and endpoint (reference
+    ZMQNetWrapper::Bind, zmq_net.h:64-81). Must precede MV_Init. For
+    rank 0 the endpoint is the coordinator address the whole world
+    rendezvouses on (net_connect cross-checks its rank-0 entry against
+    it); other ranks' endpoints are identity records, matching the
+    reference where every rank binds its own recv socket."""
+    global _net_rank, _net_endpoint
+    if _initialized:
+        Log.Error("MV_NetBind after the distributed runtime is up")
+        return -1
+    if rank < 0 or not endpoint:
+        return -1
+    _net_rank = int(rank)
+    _net_endpoint = str(endpoint)
+    return 0
+
+
+def net_connect(ranks, endpoints) -> int:
+    """Declare the full world as parallel (ranks, endpoints) lists
+    (reference ZMQNetWrapper::Connect, zmq_net.h:83-110). Requires a prior
+    net_bind; this process's bound rank must appear in ``ranks``. The
+    next MV_Init brings up jax.distributed from this wiring."""
+    global _net_world
+    if _initialized:
+        Log.Error("MV_NetConnect after the distributed runtime is up")
+        return -1
+    if _net_rank is None:
+        Log.Error("MV_NetConnect before MV_NetBind")
+        return -1
+    ranks = [int(r) for r in ranks]
+    endpoints = [str(e) for e in endpoints]
+    if len(ranks) != len(endpoints) or not ranks:
+        return -1
+    if sorted(ranks) != list(range(len(ranks))):
+        # jax.distributed numbers processes 0..n-1; gaps or duplicates
+        # would crash or hang the rendezvous later — reject at declaration
+        Log.Error("MV_NetConnect ranks must be exactly 0..n-1, got %s",
+                  ranks)
+        return -1
+    world = dict(zip(ranks, endpoints))
+    if _net_rank not in world:
+        Log.Error("MV_NetConnect world must contain the bound rank")
+        return -1
+    if _net_rank == 0 and world[0] != _net_endpoint:
+        # rank 0's bind endpoint IS the coordinator it will listen on; a
+        # mismatching connect entry would make the world rendezvous on an
+        # address nothing binds
+        Log.Error("rank 0 bind endpoint %s != connect entry %s",
+                  _net_endpoint, world[0])
+        return -1
+    _net_world = world
+    return 0
+
+
+def net_reset() -> None:
+    """Forget explicit wiring (tests / MV_ShutDown symmetry)."""
+    global _net_rank, _net_endpoint, _net_world
+    _net_rank = _net_endpoint = _net_world = None
+
 
 def _env_says_multiprocess() -> bool:
     """TPU-pod/cluster env autodetection (mirrors what
@@ -91,6 +161,11 @@ def maybe_initialize() -> bool:
     rank = int(GetFlag("dist_rank"))
     size = int(GetFlag("dist_size"))
     explicit = bool(coordinator) and rank >= 0 and size > 0
+    if not explicit and _net_world is not None:
+        # MV_NetBind/MV_NetConnect wiring: rank 0's endpoint coordinates
+        coordinator, rank, size = (_net_world[0], _net_rank,
+                                   len(_net_world))
+        explicit = True
     if not explicit and mode != "on" and not _env_says_multiprocess():
         return False
     if _initialized:
@@ -186,34 +261,46 @@ def host_allgather_objects(obj) -> list:
     return [pickle.loads(b) for b in blobs]
 
 
-def merge_collective_add(option, *arrays) -> tuple:
+def merge_collective_add(option, *arrays, with_parts: bool = False):
     """Merge every process's payload of one collective row/key Add:
     allgathers ``(arrays..., option)``, CHECKs the option agrees on every
     process (divergent option scalars — worker_id, lr, momentum — would
     feed different jit'd updates into the same globally-sharded state and
     silently corrupt it), and returns per-position concatenations in
-    process order. Identity single-process."""
+    process order. Identity single-process.
+
+    ``with_parts``: also return the per-rank first arrays (the id sets),
+    in rank order — SparseMatrixTable derives its per-keeper freshness
+    transitions from them without a second host collective."""
     if process_count() <= 1:
-        return arrays
+        return (arrays, [arrays[0]]) if with_parts else arrays
     parts = host_allgather_objects((arrays, option))
     opts = [p[1] for p in parts]
     CHECK(all(o == opts[0] for o in opts),
           f"collective Add options diverge across processes: {opts}")
-    return tuple(np.concatenate([p[0][i] for p in parts])
-                 for i in range(len(arrays)))
+    merged = tuple(np.concatenate([p[0][i] for p in parts])
+                   for i in range(len(arrays)))
+    if with_parts:
+        return merged, [p[0][0] for p in parts]
+    return merged
 
 
-def sum_collective_add(option, values: np.ndarray) -> np.ndarray:
+def sum_collective_add(option, values: np.ndarray,
+                       with_parts: bool = False):
     """Sum every process's delta of one collective whole-table Add (same
     option agreement CHECK as merge_collective_add). Identity
-    single-process."""
+    single-process. ``with_parts``: also return the per-rank id sets —
+    ``None`` per rank (a whole-table push)."""
     if process_count() <= 1:
-        return values
+        return (values, [None]) if with_parts else values
     parts = host_allgather_objects((values, option))
     opts = [p[1] for p in parts]
     CHECK(all(o == opts[0] for o in opts),
           f"collective Add options diverge across processes: {opts}")
-    return np.sum([p[0] for p in parts], axis=0).astype(values.dtype)
+    summed = np.sum([p[0] for p in parts], axis=0).astype(values.dtype)
+    if with_parts:
+        return summed, [None] * len(parts)
+    return summed
 
 
 def union_collective_ids(ids: np.ndarray) -> Optional[np.ndarray]:

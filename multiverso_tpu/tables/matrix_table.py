@@ -316,6 +316,12 @@ class MatrixServerTable(ServerTable):
 
     # -- server verbs -------------------------------------------------------
 
+    def _note_add_parts(self, option: AddOption, parts) -> None:
+        """Hook: every rank's id set (None = whole table) of the applied
+        collective Add, in rank order — fires AFTER the data update so a
+        rejected add cannot desynchronize subclass bookkeeping.
+        SparseMatrixTable overrides this for its freshness bits."""
+
     def ProcessAdd(self, values: np.ndarray, option: AddOption,
                    row_ids: Optional[np.ndarray] = None) -> None:
         if row_ids is None:
@@ -323,10 +329,12 @@ class MatrixServerTable(ServerTable):
                                                             self.num_cols)
             # multihost: sum the per-process deltas of this collective Add
             # (reference semantics — every worker's Add accumulates)
-            values = multihost.sum_collective_add(option, values)
+            values, parts = multihost.sum_collective_add(option, values,
+                                                         with_parts=True)
             delta = self._zoo.mesh_ctx.place(self._to_storage(values),
                                              self._sharding)
             self.state = self._update_full(self.state, delta, option.as_jnp())
+            self._note_add_parts(option, parts)
             return
         ids = np.asarray(row_ids, np.int32).ravel()
         deltas = np.asarray(values, self.dtype).reshape(len(ids), self.num_cols)
@@ -335,13 +343,16 @@ class MatrixServerTable(ServerTable):
         # collective Add — each process may push different rows; after the
         # merge all processes issue identical device programs over
         # identical data (identity single-process)
-        ids, deltas = multihost.merge_collective_add(option, ids, deltas)
+        (ids, deltas), parts = multihost.merge_collective_add(
+            option, ids, deltas, with_parts=True)
+        self._check_ids(ids)  # every rank's part validated on every replica
         ids, deltas = self._combine_duplicates(ids, deltas)
         # ship exact-size arrays; pad to the bucket on device (_pad_row_batch)
         padded_ids, padded_deltas = _pad_row_batch(
             jnp.asarray(ids), jnp.asarray(deltas), next_bucket(len(ids)))
         self.state = self._update_rows(self.state, padded_ids, padded_deltas,
                                        option.as_jnp())
+        self._note_add_parts(option, parts)
 
     def ProcessGet(self, option: GetOption,
                    row_ids: Optional[np.ndarray] = None,

@@ -110,6 +110,9 @@ class SparseMatrixServerTable(MatrixServerTable):
             stale = np.nonzero(~self.up_to_date[gwid])[0]
         else:
             ids = np.asarray(row_ids, np.int64).ravel()
+            # validate BEFORE touching the bits: a rejected Get must not
+            # mark rows fresh (negative ids would silently wrap)
+            self._check_ids(ids)
             stale = ids[~self.up_to_date[gwid, ids]]
         if stale.size == 0:
             # all fresh -> still ship row 0 (sparse_matrix_table.cpp:255-257)
@@ -125,20 +128,18 @@ class SparseMatrixServerTable(MatrixServerTable):
         from multiverso_tpu.parallel import multihost
         return multihost.host_allgather_objects(part)
 
-    def ProcessAdd(self, values, option: AddOption, row_ids=None) -> None:
-        # apply (and validate) the data first; only then mark rows stale —
-        # a rejected add must not desynchronize the freshness bits.
-        # Multi-process note: the parent's collective merge CHECKs that the
-        # AddOption (worker_id included) agrees across processes, so one
-        # collective Add is attributed to the same LOCAL worker id
-        # everywhere; the per-rank parts still map to distinct GLOBAL
-        # keepers (rank * W + wid) and each keeper stays fresh only for
-        # the rows its own process pushed.
-        super().ProcessAdd(values, option, row_ids)
-        ids = None if row_ids is None else np.asarray(row_ids, np.int64)
-        for rank, (wid, part_ids) in enumerate(
-                self._allgather_parts((option.worker_id, ids))):
-            self._mark_stale(self._gwid(rank, wid), part_ids)
+    def _note_add_parts(self, option: AddOption, parts) -> None:
+        """Parent hook: fires after the collective Add applied, with every
+        rank's id set (already allgathered by the parent's merge — no
+        second collective here). The parent's merge CHECKs the AddOption
+        (worker_id included) agrees across processes, so one collective
+        Add is attributed to the same LOCAL worker id everywhere; the
+        per-rank parts still map to distinct GLOBAL keepers (rank*W + wid)
+        and each keeper stays fresh only for the rows its own process
+        pushed (a rejected add never reaches this hook, so the bits can't
+        desynchronize)."""
+        for rank, part_ids in enumerate(parts):
+            self._mark_stale(self._gwid(rank, option.worker_id), part_ids)
 
     def ProcessGet(self, option: GetOption,
                    row_ids=None) -> Tuple[np.ndarray, np.ndarray]:

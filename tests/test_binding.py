@@ -95,16 +95,32 @@ class TestSharedTableManagers:
             mvt.MV_ShutDown()
 
 
-class TestNetStubs:
-    def test_net_bind_connect_are_documented_stubs(self):
-        """MV_NetBind/MV_NetConnect exist for API parity and explain why
-        they cannot apply (TPU meshes are wired by hardware, not sockets —
-        reference multiverso.h:54-64)."""
+class TestNetBindConnect:
+    """MV_NetBind/MV_NetConnect: the launcher-free bring-up path
+    (reference zmq_net.h:64-110 MPI-free deployment) — declarations feed
+    jax.distributed at the next MV_Init. Single-process tier checks the
+    declaration contract; the 2-process wiring is driven end-to-end in
+    test_multihost.py::TestTwoProcessNetBind."""
+
+    def teardown_method(self):
+        from multiverso_tpu.parallel import multihost
+        multihost.net_reset()
+
+    def test_declaration_contract(self):
         import multiverso_tpu as mv
-        with pytest.raises(NotImplementedError):
-            mv.MV_NetBind(0, "tcp://0.0.0.0:5555")
-        with pytest.raises(NotImplementedError):
-            mv.MV_NetConnect([0], ["tcp://127.0.0.1:5555"])
+        # connect before bind is an error
+        assert mv.MV_NetConnect([0], ["127.0.0.1:5555"]) == -1
+        assert mv.MV_NetBind(0, "127.0.0.1:5555") == 0
+        # world must include this rank and rank 0
+        assert mv.MV_NetConnect([1], ["127.0.0.1:6666"]) == -1
+        assert mv.MV_NetConnect([0, 1], ["127.0.0.1:5555"]) == -1  # ragged
+        assert mv.MV_NetConnect(
+            [0, 1], ["127.0.0.1:5555", "127.0.0.1:6666"]) == 0
+
+    def test_bad_bind_rejected(self):
+        import multiverso_tpu as mv
+        assert mv.MV_NetBind(-1, "127.0.0.1:5555") == -1
+        assert mv.MV_NetBind(0, "") == -1
 
 
 class TestParamManager:

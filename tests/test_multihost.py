@@ -178,6 +178,42 @@ class TestTwoProcessSync:
         run_two_process(_SYNC_CHILD, tmp_path, expect="SYNC OK")
 
 
+_NETBIND_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+
+# launcher-free bring-up: the world is declared through the two reference
+# net verbs ONLY (no -dist_* flags, no env) — rank 0's endpoint is the
+# coordinator jax.distributed rendezvouses on
+endpoints = [f"127.0.0.1:{port}", f"127.0.0.1:{int(port) + 1}"]
+assert mv.MV_NetBind(rank, endpoints[rank]) == 0
+assert mv.MV_NetConnect([0, 1], endpoints) == 0
+mv.MV_Init([])
+assert mv.MV_Size() == 2 and mv.MV_Rank() == rank
+
+from multiverso_tpu.tables import ArrayTableOption
+arr = mv.MV_CreateTable(ArrayTableOption(size=8))
+arr.Add(np.full(8, float(rank + 1), np.float32))
+assert np.allclose(arr.Get(), 3.0)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} NETBIND OK", flush=True)
+'''
+
+
+class TestTwoProcessNetBind:
+    def test_world_wired_through_net_verbs_only(self, tmp_path):
+        """MV_NetBind + MV_NetConnect alone bring up the 2-process world
+        (reference MPI-free ZMQ deployment, zmq_net.h:64-110)."""
+        run_two_process(_NETBIND_CHILD, tmp_path, expect="NETBIND OK")
+
+
 _SPARSE_CHILD = r'''
 import os, sys
 rank, port = int(sys.argv[1]), sys.argv[2]
