@@ -79,14 +79,22 @@ def net_bind(rank: int, endpoint: str) -> int:
     rendezvouses on (net_connect cross-checks its rank-0 entry against
     it); other ranks' endpoints are identity records, matching the
     reference where every rank binds its own recv socket."""
-    global _net_rank, _net_endpoint
+    global _net_rank, _net_endpoint, _net_world
     if _initialized:
         Log.Error("MV_NetBind after the distributed runtime is up")
         return -1
+    try:
+        rank, endpoint = int(rank), str(endpoint)
+    except (TypeError, ValueError):
+        return -1
     if rank < 0 or not endpoint:
         return -1
-    _net_rank = int(rank)
-    _net_endpoint = str(endpoint)
+    _net_rank = rank
+    _net_endpoint = endpoint
+    # re-binding invalidates a previously declared world: its validation
+    # (rank membership, rank-0 endpoint cross-check) was against the old
+    # identity — require a fresh MV_NetConnect
+    _net_world = None
     return 0
 
 
@@ -102,8 +110,11 @@ def net_connect(ranks, endpoints) -> int:
     if _net_rank is None:
         Log.Error("MV_NetConnect before MV_NetBind")
         return -1
-    ranks = [int(r) for r in ranks]
-    endpoints = [str(e) for e in endpoints]
+    try:
+        ranks = [int(r) for r in ranks]
+        endpoints = [str(e) for e in endpoints]
+    except (TypeError, ValueError):
+        return -1  # malformed declarations return -1 like every other error
     if len(ranks) != len(endpoints) or not ranks:
         return -1
     if sorted(ranks) != list(range(len(ranks))):

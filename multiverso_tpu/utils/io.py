@@ -8,6 +8,13 @@ an optional HDFS backend behind a build flag (src/io/hdfs_stream.cpp); here
 ``file`` (and scheme-less paths) are implemented and other schemes raise a
 clear error unless a backend is registered — the same extension seam.
 
+Remote schemes (``hdfs://``, ``gs://``/``gcs://``, ``s3://``, ``az://``,
+and fsspec's in-process ``memory://`` fake used by tests) are served by
+an fsspec-backed backend gated EXACTLY like the reference's HDFS build
+flag (``MULTIVERSO_USE_HDFS``, io.cpp:14-17): off by default, enabled by
+the ``-use_remote_io=true`` flag or ``MULTIVERSO_USE_REMOTE_IO=1`` env —
+an ungated remote scheme stays a loud error, never a silent fallback.
+
 Checkpoint Store/Load of server tables (reference table_interface.h:61-70)
 rides on this layer; the TPU build additionally offers orbax-style sharded
 checkpoints in the table layer itself.
@@ -19,6 +26,8 @@ import io as _pyio
 import os
 import struct
 from typing import Callable, Dict, Optional
+
+from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_bool
 
 
 class URI:
@@ -107,6 +116,31 @@ def _open_local(uri: URI, mode: str) -> Stream:
 
 _scheme_backends["file"] = _open_local
 
+# fsspec-served remote schemes (reference src/io/hdfs_stream.cpp scope +
+# the modern object stores; "memory" is fsspec's in-process fake, the
+# test double for the checkpoint path)
+REMOTE_SCHEMES = ("hdfs", "gs", "gcs", "s3", "az", "abfs", "memory")
+
+
+MV_DEFINE_bool("use_remote_io", False,
+               "serve hdfs://, gs://, s3://... via fsspec "
+               "(reference MULTIVERSO_USE_HDFS gate)")
+
+
+def _remote_io_enabled() -> bool:
+    """The MULTIVERSO_USE_HDFS-equivalent gate (reference io.cpp:14-17):
+    a runtime flag/env instead of a compile-time define."""
+    if os.environ.get("MULTIVERSO_USE_REMOTE_IO", "") == "1":
+        return True
+    return bool(GetFlag("use_remote_io"))
+
+
+def _open_fsspec(uri: URI, mode: str) -> Stream:
+    import fsspec
+    pymode = _MODE_MAP.get(mode, mode)
+    fileobj = fsspec.open(uri.uri, pymode).open()
+    return Stream(fileobj, uri.name())
+
 
 class StreamFactory:
     """Scheme dispatch (reference src/io/io.cpp:8-24)."""
@@ -116,6 +150,15 @@ class StreamFactory:
         if isinstance(uri, str):
             uri = URI(uri)
         backend = _scheme_backends.get(uri.scheme)
+        if backend is None and uri.scheme in REMOTE_SCHEMES:
+            if _remote_io_enabled():
+                backend = _open_fsspec
+            else:
+                raise NotImplementedError(
+                    f"remote scheme {uri.scheme!r} is gated off — enable "
+                    f"with -use_remote_io=true or MULTIVERSO_USE_REMOTE_IO=1 "
+                    f"(the reference gates hdfs the same way: "
+                    f"MULTIVERSO_USE_HDFS, io.cpp:14-17)")
         if backend is None:
             raise NotImplementedError(
                 f"no stream backend registered for scheme {uri.scheme!r} "

@@ -121,6 +121,19 @@ class TestNetBindConnect:
         import multiverso_tpu as mv
         assert mv.MV_NetBind(-1, "127.0.0.1:5555") == -1
         assert mv.MV_NetBind(0, "") == -1
+        assert mv.MV_NetBind("x", "127.0.0.1:5555") == -1
+        assert mv.MV_NetConnect([0, "x"], ["a", "b"]) == -1  # malformed -> -1
+
+    def test_rebind_invalidates_world(self):
+        """Re-declaring identity after a validated world requires a fresh
+        connect — the old validation was against the old identity."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.parallel import multihost
+        assert mv.MV_NetBind(0, "127.0.0.1:5555") == 0
+        assert mv.MV_NetConnect(
+            [0, 1], ["127.0.0.1:5555", "127.0.0.1:6666"]) == 0
+        assert mv.MV_NetBind(7, "127.0.0.1:7777") == 0
+        assert multihost._net_world is None
 
 
 class TestParamManager:

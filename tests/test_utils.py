@@ -233,9 +233,32 @@ class TestIO:
             assert s.ReadStr() == "hello"
             assert s.Read(2) == b"\x01\x02"
 
-    def test_unknown_scheme(self):
-        with pytest.raises(NotImplementedError):
+    def test_remote_scheme_gated_off_by_default(self):
+        """hdfs:// (and other remote schemes) stay a loud error until the
+        MULTIVERSO_USE_HDFS-equivalent gate opens (reference io.cpp:14-17
+        gates the hdfs backend behind a build flag)."""
+        with pytest.raises(NotImplementedError, match="gated off"):
             StreamFactory.GetStream("hdfs://h/p", "r")
+
+    def test_truly_unknown_scheme(self):
+        with pytest.raises(NotImplementedError, match="no stream backend"):
+            StreamFactory.GetStream("zzz://h/p", "r")
+
+    def test_remote_stream_roundtrip_memory_backend(self):
+        """With the gate open, remote schemes are served by fsspec; the
+        in-process memory:// filesystem is the fake backend (same code
+        path gs://, hdfs://, s3:// take)."""
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        SetCMDFlag("use_remote_io", True)
+        try:
+            with StreamFactory.GetStream("memory://bucket/s.bin", "w") as s:
+                s.WriteInt(99)
+                s.WriteStr("remote")
+            with StreamFactory.GetStream("memory://bucket/s.bin", "r") as s:
+                assert s.ReadInt() == 99
+                assert s.ReadStr() == "remote"
+        finally:
+            SetCMDFlag("use_remote_io", False)
 
     def test_text_reader(self, tmp_path):
         path = str(tmp_path / "t.txt")
