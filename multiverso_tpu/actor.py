@@ -63,22 +63,28 @@ class Actor:
         """Push into the mailbox (reference actor.h:45-47)."""
         self.mailbox.Push(msg)
 
+    def _dispatch(self, msg: Message) -> None:
+        """Route one message through its handler; failures reply to the
+        caller's Wait() instead of killing the loop. Shared by the main
+        loop and engines that drain extra messages (pipeline windows)."""
+        handler = self._handlers.get(msg.msg_type)
+        if handler is None:
+            Log.Error("actor %s: unhandled message type %s", self.name,
+                      msg.msg_type)
+            return
+        try:
+            handler(msg)
+        except Exception as exc:  # surface, don't kill the loop silently
+            Log.Error("actor %s: handler for %s raised: %r", self.name,
+                      msg.msg_type, exc)
+            # route through the normal reply path so the error reaches
+            # the caller's Wait() and re-raises there
+            msg.reply(exc)
+
     def _main(self) -> None:
         self._started.set()
         while True:
             ok, msg = self.mailbox.Pop()
             if not ok:
                 break
-            handler = self._handlers.get(msg.msg_type)
-            if handler is None:
-                Log.Error("actor %s: unhandled message type %s", self.name,
-                          msg.msg_type)
-                continue
-            try:
-                handler(msg)
-            except Exception as exc:  # surface, don't kill the loop silently
-                Log.Error("actor %s: handler for %s raised: %r", self.name,
-                          msg.msg_type, exc)
-                # route through the normal reply path so the error reaches
-                # the caller's Wait() and re-raises there
-                msg.reply(exc)
+            self._dispatch(msg)

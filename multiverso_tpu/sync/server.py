@@ -87,7 +87,7 @@ class Server(Actor):
     def __init__(self):
         super().__init__(actor_names.kServer)
         self.store_: List = []  # ServerTable list (reference server.h:24)
-        self.RegisterHandler(MsgType.Request_Get, self.ProcessGet)
+        self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self.ProcessAdd)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
         # barrier ping: replies once the mailbox drained up to this point —
@@ -99,6 +99,58 @@ class Server(Actor):
         table_id = len(self.store_)
         self.store_.append(server_table)
         return table_id
+
+    #: how many queued messages one Get drains into its pipeline window.
+    #: Each pipelined Get hides one device->host copy RTT; the window stays
+    #: modest so Adds interleaved behind it are not starved for long.
+    GET_PIPELINE_WINDOW = 16
+
+    def _get_entry(self, msg: Message) -> None:
+        """Request_Get handler, async engine: RTT pipelining. Drains a
+        window of already-queued messages and runs every Get's dispatch
+        phase (device program + async host copy, ProcessGetAsync) before
+        finalizing any — N queued Gets overlap their device->host copies
+        instead of paying one RTT each. Processing stays in pop order
+        (Adds apply between dispatches, so a Get queued after an Add still
+        sees it — device dataflow orders them). SyncServer overrides this
+        with its unbatched clocked path: the BSP defer/drain protocol
+        must see messages strictly one at a time."""
+        batch = [msg]
+        while len(batch) < self.GET_PIPELINE_WINDOW:
+            ok, nxt = self.mailbox.TryPop()
+            if not ok:
+                break
+            batch.append(nxt)
+        pending = []  # (msg, finalize) in pop order
+        for m in batch:
+            if m.msg_type is MsgType.Request_Get:
+                with monitor_region("SERVER_PROCESS_GET"):
+                    try:
+                        table = self.store_[m.table_id]
+                        finalize = table.ProcessGetAsync(**m.payload)
+                        if finalize is None:
+                            self.ProcessGet(m)
+                        else:
+                            pending.append((m, finalize))
+                    except Exception as exc:
+                        # failures (bad table id included) reply to THIS
+                        # message only — an escape here would abandon every
+                        # pending finalize and hang their waiters
+                        Log.Error("table ProcessGet dispatch failed: %r",
+                                  exc)
+                        m.reply(exc)
+            else:
+                # non-Get drained into the window: its normal handler
+                # (Add / barrier / finish) runs in order, with the actor's
+                # standard error routing
+                self._dispatch(m)
+        for m, finalize in pending:
+            try:
+                m.reply(finalize())
+            except Exception as exc:
+                Log.Error("table %d Get finalize failed: %r",
+                          m.table_id, exc)
+                m.reply(exc)
 
     def ProcessGet(self, msg: Message) -> None:
         with monitor_region("SERVER_PROCESS_GET"):
@@ -169,6 +221,11 @@ class SyncServer(Server):
                 super().ProcessGet(get_msg)
                 CHECK(not self._get_clocks.Update(get_msg.src),
                       "drained Get must not complete a round")
+
+    def _get_entry(self, msg: Message) -> None:
+        # no pipelining window under BSP: the vector-clock protocol's
+        # defer/drain decisions depend on strict one-at-a-time processing
+        self.ProcessGet(msg)
 
     def ProcessGet(self, msg: Message) -> None:
         worker = msg.src

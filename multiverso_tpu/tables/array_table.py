@@ -109,6 +109,14 @@ class ArrayServer(ServerTable):
         out = self._access(self.state, None)
         return self._zoo.mesh_ctx.fetch(out)[: self.size]
 
+    def ProcessGetAsync(self, option: GetOption = None):
+        if multihost.process_count() > 1:
+            return None  # multihost fetch is a collective — keep sync path
+        out = self._access(self.state, None)  # jit'd: output is a fresh
+        # buffer, never the live (donatable) state array
+        out.copy_to_host_async()
+        return lambda: np.asarray(out)[: self.size]
+
     def raw(self) -> jax.Array:
         """The live sharded device array (padded)."""
         return self.state["data"]

@@ -55,6 +55,18 @@ class ServerTable:
     def ProcessGet(self, **payload) -> Any:
         raise NotImplementedError
 
+    def ProcessGetAsync(self, **payload):
+        """Two-phase Get for RTT pipelining: dispatch the device program
+        AND start the device->host copy, return a zero-arg finalize
+        callable producing the result — or None when this table (or this
+        payload) can't split the phases, in which case the engine falls
+        back to the blocking ProcessGet. The async Server engine drains a
+        window of queued Gets through the dispatch phase first, so their
+        host copies overlap instead of serializing one RTT per Get (the
+        reference's C++ server was memcpy-bound, not RTT-bound; a remote
+        accelerator makes the copy the cost to hide)."""
+        return None
+
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:
         raise NotImplementedError

@@ -387,6 +387,33 @@ class MatrixServerTable(ServerTable):
         # cross the (slow) host<->device link
         return self._zoo.mesh_ctx.fetch(rows[: len(ids)])
 
+    def ProcessGetAsync(self, option: GetOption = None, row_ids=None):
+        """Two-phase Get (base-class contract, tables/base.py): dispatch
+        the gather + start the device->host copy now, fetch in finalize —
+        the engine overlaps a window of these so queued host Gets pay one
+        pipelined RTT instead of one each."""
+        if multihost.process_count() > 1:
+            return None  # collective fetch/union — keep the sync path
+        if row_ids is None:
+            data = self.updater.access(self.state["data"], self.state["aux"],
+                                       None)
+            if data is self.state["data"]:
+                # identity access returns the LIVE state buffer; an Add
+                # drained later in the same pipeline window donates it
+                # (donate_argnums) — finalize would read a deleted array.
+                # Snapshot to a fresh buffer before the async copy.
+                data = jnp.copy(data)
+            data.copy_to_host_async()
+            return lambda: self._from_storage(np.asarray(data))
+        ids = np.asarray(row_ids, np.int32).ravel()
+        self._check_ids(ids)
+        padded_ids = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
+        rows = self._gather_rows(self.state["data"], self.state["aux"],
+                                 padded_ids)
+        sliced = rows[: len(ids)]
+        sliced.copy_to_host_async()
+        return lambda: np.asarray(sliced)
+
     # -- eager device plane (public) ----------------------------------------
     # device_gather_rows / device_update_rows above are the TRACEABLE hooks
     # (scan them into a jit'd step — bench.py, examples/device_plane.py);

@@ -141,6 +141,11 @@ class SparseMatrixServerTable(MatrixServerTable):
         for rank, part_ids in enumerate(parts):
             self._mark_stale(self._gwid(rank, option.worker_id), part_ids)
 
+    def ProcessGetAsync(self, option: GetOption = None, row_ids=None):
+        # a sparse Get MUTATES freshness state and returns (ids, rows) —
+        # the inherited matrix fast path would bypass the dirty protocol
+        return None
+
     def ProcessGet(self, option: GetOption,
                    row_ids=None) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (row_ids, rows) — the server decides which rows move."""
