@@ -20,7 +20,11 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libmultiverso_tpu.so")
+# installed wheels carry the library as package data right here (built by
+# setup.py); source checkouts build it in the repo's native/ dir
+_PKG_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "libmultiverso_tpu.so")
+_REPO_LIB_PATH = os.path.join(_NATIVE_DIR, "libmultiverso_tpu.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -37,6 +41,17 @@ def _build() -> bool:
         return False
 
 
+def _try_load(path: str) -> Optional[ctypes.CDLL]:
+    """Load + signature-check one candidate; None on any failure
+    (AttributeError = stale .so missing a newer symbol)."""
+    try:
+        handle = ctypes.CDLL(path)
+        _configure_signatures(handle)
+        return handle
+    except (OSError, AttributeError):
+        return None
+
+
 def lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, or None when unavailable."""
     global _lib, _tried
@@ -44,22 +59,18 @@ def lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
-        try:
-            handle = ctypes.CDLL(_LIB_PATH)
-            _configure_signatures(handle)
-        except (OSError, AttributeError):
-            # AttributeError = stale prebuilt .so missing a newer symbol:
-            # rebuild once, then degrade to pure python (module contract)
-            if not _build():
-                return None
-            try:
-                handle = ctypes.CDLL(_LIB_PATH)
-                _configure_signatures(handle)
-            except (OSError, AttributeError):
-                return None
-        _lib = handle
+        # wheel package-data first, source-tree build second
+        for path in (_PKG_LIB_PATH, _REPO_LIB_PATH):
+            if os.path.exists(path):
+                _lib = _try_load(path)
+                if _lib is not None:
+                    return _lib
+        # missing everywhere, or every existing candidate was stale:
+        # rebuild the SOURCE-TREE library (the package-data .so is an
+        # immutable wheel artifact — recovery must not retry it) and load
+        # that; otherwise degrade to pure python (module contract)
+        if _build():
+            _lib = _try_load(_REPO_LIB_PATH)
         return _lib
 
 
