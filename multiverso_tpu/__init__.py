@@ -39,6 +39,7 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_Aggregate,
     MV_NetBind,
     MV_NetConnect,
+    MV_NetFinalize,
     MV_SaveCheckpoint,
     MV_LoadCheckpoint,
     MV_StartProfiler,

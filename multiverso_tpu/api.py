@@ -119,6 +119,16 @@ def MV_NetConnect(ranks, endpoints) -> int:
     return multihost.net_connect(ranks, endpoints)
 
 
+def MV_NetFinalize() -> None:
+    """Tear down the explicit net layer (reference MV_NetFinalize,
+    multiverso.h:65 / src/multiverso.cpp:66-68 finalizes the transport):
+    forgets MV_NetBind/MV_NetConnect declarations and shuts down
+    ``jax.distributed`` if this runtime brought it up. Call after
+    MV_ShutDown when the process is done with distributed work."""
+    from multiverso_tpu.parallel import multihost
+    multihost.net_finalize()
+
+
 def MV_SaveCheckpoint(uri: str) -> int:
     """Store every registered server table (+ updater aux state) to ``uri``
     (framework-level driver over the per-table Serializable contract,

@@ -44,8 +44,10 @@ class MVModelParamManager:
         table ids align across processes like the reference)."""
         self._get = get_params
         self._set = set_params
-        init = np.asarray(self._get(), np.float32)
         if table is None:
+            # only the own-table path needs the initial flatten (shared
+            # tables were initialized by their creator)
+            init = np.asarray(self._get(), np.float32)
             self.tbh = mv.ArrayTableHandler(init.size, init_value=init)
         else:
             self.tbh = table
@@ -79,13 +81,13 @@ def _unflatten(vec: np.ndarray, shapes: List[Tuple[int, ...]]) -> List[np.ndarra
 class JaxParamManager(MVModelParamManager):
     """Sync a jax pytree of parameters (flax ``params``, haiku params, …)."""
 
-    def __init__(self, params):
+    def __init__(self, params, table=None):
         import jax
         self._treedef = jax.tree.structure(params)
         leaves = jax.tree.leaves(params)
         self._shapes = [tuple(np.shape(l)) for l in leaves]
         self._current = [np.asarray(l, np.float32) for l in leaves]
-        super().__init__(self._get_flat, self._set_flat)
+        super().__init__(self._get_flat, self._set_flat, table=table)
 
     def _get_flat(self) -> np.ndarray:
         return _flatten(self._current)

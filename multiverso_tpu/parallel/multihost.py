@@ -61,6 +61,7 @@ MV_DEFINE_int("dist_rank", -1, "this process index (jax.distributed)")
 MV_DEFINE_int("dist_size", -1, "total process count (jax.distributed)")
 
 _initialized = False
+_owns_runtime = False   # True only when WE called jax.distributed.initialize
 
 # Explicit-endpoint bring-up state (MV_NetBind / MV_NetConnect): the
 # launcher-free deployment path. The reference's ZMQ transport let a
@@ -144,6 +145,27 @@ def net_reset() -> None:
     _net_rank = _net_endpoint = _net_world = None
 
 
+def net_finalize() -> None:
+    """MV_NetFinalize: forget declarations AND shut down jax.distributed
+    when THIS runtime initialized it (reference finalizes its transport,
+    src/multiverso.cpp:66-68). A runtime the user brought up themselves
+    (maybe_initialize merely adopted it) is left alone — finalizing it
+    would kill their coordinator under them. Safe to call repeatedly; a
+    shutdown failure (e.g. live computations) logs and leaves the
+    runtime up."""
+    global _initialized, _owns_runtime
+    net_reset()
+    if not _initialized or not _owns_runtime:
+        return
+    import jax
+    try:
+        jax.distributed.shutdown()
+        _initialized = False
+        _owns_runtime = False
+    except Exception as exc:  # pragma: no cover - runtime-state specific
+        Log.Error("net_finalize: jax.distributed.shutdown failed: %r", exc)
+
+
 def _env_says_multiprocess() -> bool:
     """TPU-pod/cluster env autodetection (mirrors what
     jax.distributed.initialize() itself can infer)."""
@@ -164,7 +186,7 @@ def maybe_initialize() -> bool:
     ``jax.distributed.initialize()`` refuses once backends exist, so this
     function deliberately avoids jax calls (process_count etc.) on the
     decide-to-init path."""
-    global _initialized
+    global _initialized, _owns_runtime
     mode = str(GetFlag("multihost")).lower()
     if mode == "off":
         return False
@@ -189,6 +211,7 @@ def maybe_initialize() -> bool:
         else:
             jax.distributed.initialize()
         _initialized = True
+        _owns_runtime = True
         Log.Info("multihost: jax.distributed up — process %d of %d",
                  jax.process_index(), jax.process_count())
         return True

@@ -4,8 +4,13 @@ Behavioral equivalent of reference include/multiverso/util/quantization_util.h:
 ``SparseFilter`` (quantization_util.h:95-137) compresses a row of deltas into
 (index, value) pairs when more than half the entries are below a threshold
 ("zero"), prefixing a flag word so the receiver knows whether the payload is
-dense or sparse; ``OneBitsFilter`` is an empty stub in the reference
-(quantization_util.h:160-161) and is likewise a documented stub here.
+dense or sparse; ``OneBitsFilter`` is an EMPTY stub in the reference
+(quantization_util.h:160-161) — here it is implemented for real, from the
+published algorithm its name refers to (1-bit SGD with error feedback,
+Seide et al., Interspeech 2014, the DMTK-era companion technique): signs
+pack to 1 bit/element, reconstruction uses the per-call positive/negative
+means, and the quantization error feeds back into the next call so the
+cumulative applied delta tracks the cumulative true delta.
 
 TPU mapping: the "wire" this saves is the host<->HBM transfer and the
 scatter width on the Add path of sparse tables. ``compress`` runs on host
@@ -54,11 +59,43 @@ class SparseFilter:
 
 
 class OneBitsFilter:
-    """1-bit quantization — an empty stub in the reference
-    (quantization_util.h:160-161); kept as a documented stub for parity."""
+    """1-bit delta quantization with error feedback (see module docstring;
+    the reference declares this filter but ships an empty body —
+    quantization_util.h:160-161).
 
-    def compress(self, dense):  # pragma: no cover - parity stub
-        raise NotImplementedError("OneBitsFilter is a stub in the reference too")
+    Stateful per sender-table pair: the residual (what quantization lost)
+    is added to the NEXT delta before quantizing, so repeated pushes
+    converge to the true cumulative update — the property that makes
+    1-bit SGD train to parity. Wire cost: 1 bit/element + two f32 means
+    (~32x smaller than dense f32 rows).
+    """
 
-    def decompress(self, *args):  # pragma: no cover - parity stub
-        raise NotImplementedError("OneBitsFilter is a stub in the reference too")
+    def __init__(self):
+        self._residual: np.ndarray | None = None
+
+    def compress(self, dense: np.ndarray
+                 ) -> Tuple[np.ndarray, float, float]:
+        """-> (packed sign bits, positive mean, negative mean)."""
+        flat = np.asarray(dense, np.float32).ravel()
+        if self._residual is None:
+            self._residual = np.zeros_like(flat)
+        if flat.size != self._residual.size:
+            raise ValueError(
+                f"OneBitsFilter is per-tensor stateful: got {flat.size} "
+                f"elements, residual holds {self._residual.size}")
+        x = flat + self._residual
+        pos = x >= 0.0
+        pos_mean = float(x[pos].mean()) if pos.any() else 0.0
+        neg_mean = float(x[~pos].mean()) if (~pos).any() else 0.0
+        recon = np.where(pos, np.float32(pos_mean), np.float32(neg_mean))
+        self._residual = x - recon   # error feedback
+        return np.packbits(pos), pos_mean, neg_mean
+
+    def decompress(self, bits: np.ndarray, pos_mean: float, neg_mean: float,
+                   size: int, dtype=np.float32) -> np.ndarray:
+        unpacked = np.unpackbits(np.asarray(bits, np.uint8))
+        if unpacked.size < size:
+            raise ValueError(f"packed payload holds {unpacked.size} bits, "
+                             f"caller asked for {size}")
+        pos = unpacked[:size].astype(bool)
+        return np.where(pos, dtype(pos_mean), dtype(neg_mean))

@@ -203,6 +203,7 @@ arr.Add(np.full(8, float(rank + 1), np.float32))
 assert np.allclose(arr.Get(), 3.0)
 mv.MV_Barrier()
 mv.MV_ShutDown()
+mv.MV_NetFinalize()   # reference MV_NetFinalize: transport torn down
 print(f"child {rank} NETBIND OK", flush=True)
 '''
 

@@ -270,6 +270,49 @@ class TestIO:
             assert r.GetLine() is None
 
 
+class TestOneBitsFilter:
+    def test_wire_size_and_roundtrip(self):
+        from multiverso_tpu.utils.quantization import OneBitsFilter
+        rng = np.random.default_rng(0)
+        f = OneBitsFilter()
+        dense = rng.standard_normal(1024).astype(np.float32)
+        bits, pm, nm = f.compress(dense)
+        assert bits.nbytes == 1024 // 8  # 1 bit/element
+        recon = f.decompress(bits, pm, nm, 1024)
+        # signs survive exactly; magnitudes collapse to the two means
+        np.testing.assert_array_equal(recon >= 0, dense >= 0)
+        assert set(np.unique(recon)) <= {np.float32(pm), np.float32(nm)}
+
+    def test_error_feedback_converges(self):
+        """The 1-bit SGD property: the residual feeds the next call, so
+        the CUMULATIVE reconstructed delta tracks the cumulative true
+        delta (plain per-call quantization would drift unboundedly)."""
+        from multiverso_tpu.utils.quantization import OneBitsFilter
+        rng = np.random.default_rng(1)
+        f = OneBitsFilter()
+        true_sum = np.zeros(256, np.float32)
+        recon_sum = np.zeros(256, np.float32)
+        for _ in range(200):
+            d = rng.standard_normal(256).astype(np.float32) * 0.1
+            true_sum += d
+            bits, pm, nm = f.compress(d)
+            recon_sum += f.decompress(bits, pm, nm, 256)
+        # residual is bounded by one step's quantization error, so the
+        # averaged-per-step gap shrinks as steps accumulate
+        gap = np.abs(recon_sum - true_sum).max()
+        assert gap < 1.0, gap  # 200 steps of ~0.1-scale deltas; no drift
+        # and the final residual equals exactly the outstanding gap
+        np.testing.assert_allclose(recon_sum + f._residual, true_sum,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_shape_change_rejected(self):
+        from multiverso_tpu.utils.quantization import OneBitsFilter
+        f = OneBitsFilter()
+        f.compress(np.ones(16, np.float32))
+        with pytest.raises(ValueError):
+            f.compress(np.ones(8, np.float32))
+
+
 class TestQuantization:
     def test_sparse_roundtrip(self):
         f = SparseFilter(clip=0.0)
