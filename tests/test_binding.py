@@ -148,6 +148,57 @@ class TestParamManager:
         np.testing.assert_allclose(np.asarray(merged["w"]), 1.5)
         np.testing.assert_allclose(np.asarray(merged["b"]), 0.0)
 
+    def test_jax_manager_shared_table_two_workers(self):
+        """The flax ASGD pattern (examples/flax_asgd.py): two worker
+        threads share ONE table through JaxParamManager(table=) +
+        SyncCallback; every worker's deltas land on the shared table and
+        each final pull bounds between its own contribution and the
+        server total (ASGD: only the server state is deterministic)."""
+        import multiverso_tpu as mvc
+        import multiverso_tpu.binding as mv
+        from multiverso_tpu.binding.param_manager import (JaxParamManager,
+                                                          SyncCallback)
+        import threading
+        mv.init(args=["-num_workers=2"])
+        try:
+            init = np.zeros(6, np.float32)  # flat size of the (2,3) pytree
+            shared = mv.ArrayTableHandler(init.size, init_value=init)
+            finals = {}
+
+            def worker(wid):
+                with mvc.MV_WorkerContext(wid):
+                    mgr = JaxParamManager({"w": np.zeros((2, 3), np.float32)},
+                                          table=shared)
+                    cb = SyncCallback(mgr, freq=2)
+                    params = mgr.params()
+                    for _ in range(4):  # 4 batches -> 2 syncs via callback
+                        params = {"w": params["w"] + (wid + 1)}
+                        mgr.update(params)
+                        cb.on_batch_end()
+                        params = mgr.params()
+                    cb.on_train_end()
+                    finals[wid] = np.asarray(mgr.params()["w"]).copy()
+
+            ts = [threading.Thread(target=worker, args=(w,)) for w in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert all(not t.is_alive() for t in ts)
+            # both workers pushed 4 increments each: +1*4 and +2*4 = +12
+            server = np.asarray(shared.get()).reshape(2, 3)
+            np.testing.assert_allclose(server, 12.0)
+            for wid in (0, 1):
+                # each worker's final pull holds its own full contribution
+                # plus whatever subset of the peer's had landed by then
+                # (ASGD: the last puller sees everything, the first may
+                # not — only the server total is deterministic)
+                own = 4.0 * (wid + 1)
+                assert np.all(finals[wid] >= own - 1e-5), (wid, finals[wid])
+                assert np.all(finals[wid] <= 12.0 + 1e-5), (wid, finals[wid])
+        finally:
+            mv.shutdown()
+
     def test_torch_param_manager_sync(self, binding):
         torch = pytest.importorskip("torch")
         model = torch.nn.Linear(4, 2)
