@@ -19,12 +19,17 @@ class Waiter {
   }
 
   void Notify() {
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      --num_;
-      if (num_ > 0) return;
-    }
-    cv_.notify_all();
+    // notify UNDER the mutex: waiters commonly destroy the Waiter the
+    // moment Wait() returns (stack waiters in submit/do_get paths). With
+    // the unlock-then-notify idiom a waiter can acquire the mutex, see
+    // num_<=0, return and destroy this object while the notifier is
+    // still entering notify_all on the (now dead) condvar — a
+    // use-after-destroy TSAN catches. Holding the mutex across the
+    // notify means the waiter can't re-acquire it (and thus can't
+    // destroy) until the notifier is completely done with both members.
+    std::lock_guard<std::mutex> lk(mu_);
+    --num_;
+    if (num_ <= 0) cv_.notify_all();
   }
 
   void Reset(int num_wait) {
