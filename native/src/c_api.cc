@@ -16,6 +16,12 @@
 
 namespace {
 
+struct TableRef {
+  int table_id;            // CPU-store id, or
+  int64_t backend_id = -1; // backend table id when routed
+  size_t rows, cols;
+};
+
 struct Runtime {
   std::unique_ptr<mvt::ServerC> server;
   int num_workers = 1;
@@ -24,6 +30,10 @@ struct Runtime {
   MV_BackendVTable backend{};
   bool has_backend = false;
   bool backend_live = false;  // backend.init ran (world up through backend)
+  // handle registry: the C ABI hands out opaque TableRef*; the world owns
+  // them and frees them at shutdown (the reference's c_api leaks its
+  // handles — no free verb exists in the ABI)
+  std::vector<std::unique_ptr<TableRef>> table_refs;
 };
 
 Runtime& rt() {
@@ -33,12 +43,6 @@ Runtime& rt() {
 
 thread_local int tls_worker_id = 0;
 thread_local mvt::AddOptionC tls_add_option;
-
-struct TableRef {
-  int table_id;            // CPU-store id, or
-  int64_t backend_id = -1; // backend table id when routed
-  size_t rows, cols;
-};
 
 bool routed() { return rt().has_backend && rt().backend_live; }
 
@@ -126,6 +130,7 @@ void MV_ShutDown() {
   if (rt().backend_live) {
     rt().backend.shutdown();
     rt().backend_live = false;
+    rt().table_refs.clear();
     return;
   }
   if (rt().server == nullptr) return;
@@ -141,6 +146,7 @@ void MV_ShutDown() {
   }
   rt().server->Stop();
   rt().server.reset();
+  rt().table_refs.clear();
   mvt::config::ResetToDefaults();
 }
 
@@ -181,13 +187,17 @@ static TableRef* new_table(size_t rows, size_t cols, bool is_array) {
                                         static_cast<int64_t>(cols),
                                         is_array ? 1 : 0);
     MVT_CHECK(id >= 0);
-    return new TableRef{-1, id, rows, cols};
+    rt().table_refs.push_back(
+        std::make_unique<TableRef>(TableRef{-1, id, rows, cols}));
+    return rt().table_refs.back().get();
   }
   MVT_CHECK(rt().server != nullptr);
   auto table = std::make_unique<mvt::TableC>(
       rows, cols, mvt::config::GetString("updater_type"), rt().num_workers);
   int id = rt().server->RegisterTable(std::move(table));
-  return new TableRef{id, -1, rows, cols};
+  rt().table_refs.push_back(
+      std::make_unique<TableRef>(TableRef{id, -1, rows, cols}));
+  return rt().table_refs.back().get();
 }
 
 void MV_NewArrayTable(int size, TableHandler* out) {

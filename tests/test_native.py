@@ -35,7 +35,23 @@ class TestSelftestBinary:
         assert "ALL NATIVE TESTS OK" in result.stdout
 
 
-class TestThreadSanitizer:
+class TestSanitizers:
+    def test_selftest_runs_clean_under_asan(self, native_build):
+        """AddressSanitizer + UBSan sibling: heap/stack violations, leaks
+        (the handle registry), and UB must stay at zero."""
+        build = subprocess.run(["make", "-C", native_build,
+                                "mvt_selftest_asan"],
+                               capture_output=True, text=True, timeout=300)
+        err = build.stderr.lower()
+        if build.returncode != 0 and ("sanitize" in err or "asan" in err):
+            pytest.skip(f"toolchain lacks ASan: {build.stderr[-200:]}")
+        assert build.returncode == 0, build.stderr[-2000:]
+        result = subprocess.run(
+            [os.path.join(native_build, "mvt_selftest_asan")],
+            capture_output=True, text=True, timeout=240)
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "ALL NATIVE TESTS OK" in result.stdout
+
     def test_selftest_runs_clean_under_tsan(self, native_build):
         """The whole native runtime (actors, mt_queue, BSP protocol, C API
         worker threads) under ThreadSanitizer — the reference shipped no
