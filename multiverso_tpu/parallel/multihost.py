@@ -55,6 +55,15 @@ from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_int,
 from multiverso_tpu.utils.log import CHECK, Log
 
 MV_DEFINE_string("multihost", "auto", "multi-process init: auto / on / off")
+# reference ZMQ deployment flags (zmq_net.h:20-21), kept for flag parity:
+# a machine file maps line N -> rank N endpoints; on TPU it feeds the same
+# explicit jax.distributed wiring MV_NetBind/MV_NetConnect use
+MV_DEFINE_string("machine_file", "",
+                 "hosts file, one endpoint per line = rank order "
+                 "(reference ZMQ -machine_file; feeds net wiring)")
+MV_DEFINE_int("port", 55555,
+              "default port when a machine-file line has none "
+              "(reference ZMQ -port)")
 MV_DEFINE_string("dist_coordinator", "",
                  "coordinator address host:port (jax.distributed)")
 MV_DEFINE_int("dist_rank", -1, "this process index (jax.distributed)")
@@ -166,6 +175,65 @@ def net_finalize() -> None:
         Log.Error("net_finalize: jax.distributed.shutdown failed: %r", exc)
 
 
+def _split_endpoint(ep: str):
+    """host[:port] -> (host, port_or_None); IPv6 uses [addr]:port."""
+    if ep.startswith("["):
+        host, _, rest = ep[1:].partition("]")
+        return host, (rest[1:] if rest.startswith(":") else None)
+    host, sep, port = ep.rpartition(":")
+    if sep and port.isdigit() and ":" not in host:
+        return host, port
+    return ep, None  # no port (or a bare IPv6 literal)
+
+
+def _parse_machine_file(path: str) -> list:
+    """Hosts file -> rank-ordered endpoint list (reference
+    ParseMachineFile, zmq_net.h:236-258): one host[:port] per line
+    (IPv6 as [addr]:port), blanks/comments skipped, the ``-port`` flag
+    filling missing ports. Missing/empty files are loud errors — a
+    misconfigured cluster must never silently run single-process."""
+    default_port = int(GetFlag("port"))
+    CHECK(os.path.exists(path), f"-machine_file not found: {path!r}")
+    endpoints = []
+    with open(path) as f:
+        for line in f:
+            ep = line.strip()
+            if not ep or ep.startswith("#"):
+                continue
+            host, port = _split_endpoint(ep)
+            if port is None:
+                port = default_port
+            endpoints.append(f"[{host}]:{port}" if ":" in host
+                             else f"{host}:{port}")
+    CHECK(endpoints, f"-machine_file {path!r} lists no endpoints")
+    return endpoints
+
+
+def _match_local_rank(endpoints: list):
+    """This host's rank = the unique machine-file line resolving to a
+    local address (reference net_util local-IP matching). None when no
+    line — or more than one — matches (same-host multi-process needs an
+    explicit -dist_rank, exactly as ambiguous for the reference)."""
+    import socket
+    local = {"127.0.0.1", "::1"}
+    try:
+        local.update(info[4][0] for info in socket.getaddrinfo(
+            socket.gethostname(), None))
+    except OSError:
+        pass
+    matches = []
+    for i, ep in enumerate(endpoints):
+        host = _split_endpoint(ep)[0]
+        try:
+            addrs = {info[4][0]
+                     for info in socket.getaddrinfo(host, None)}
+        except OSError:
+            continue
+        if addrs & local or host == socket.gethostname():
+            matches.append(i)
+    return matches[0] if len(matches) == 1 else None
+
+
 def _env_says_multiprocess() -> bool:
     """TPU-pod/cluster env autodetection (mirrors what
     jax.distributed.initialize() itself can infer)."""
@@ -199,6 +267,20 @@ def maybe_initialize() -> bool:
         coordinator, rank, size = (_net_world[0], _net_rank,
                                    len(_net_world))
         explicit = True
+    if _initialized:
+        return True
+    if not explicit and str(GetFlag("machine_file")):
+        # reference ZMQ deployment: line N of the hosts file is rank N
+        # (zmq_net.h ParseMachineFile); rank comes from -dist_rank or by
+        # matching this host's addresses like the reference's net_util
+        endpoints = _parse_machine_file(str(GetFlag("machine_file")))
+        if endpoints:
+            mf_rank = rank if rank >= 0 else _match_local_rank(endpoints)
+            CHECK(mf_rank is not None and 0 <= mf_rank < len(endpoints),
+                  f"-machine_file: cannot infer this process's rank (give "
+                  f"-dist_rank); endpoints={endpoints}")
+            coordinator, rank, size = endpoints[0], mf_rank, len(endpoints)
+            explicit = True
     if not explicit and mode != "on" and not _env_says_multiprocess():
         return False
     if _initialized:

@@ -215,6 +215,75 @@ class TestTwoProcessNetBind:
         run_two_process(_NETBIND_CHILD, tmp_path, expect="NETBIND OK")
 
 
+_MACHINE_FILE_CHILD = r'''
+import os, sys
+rank, port, mf = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption
+
+# world from the hosts file (reference ZMQ -machine_file, line N = rank N);
+# same-host processes disambiguate identity with -dist_rank exactly like
+# the reference's ambiguous local-IP match would require
+mv.MV_Init([f"-machine_file={mf}", f"-dist_rank={rank}"])
+assert mv.MV_Size() == 2 and mv.MV_Rank() == rank
+arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+arr.Add(np.full(4, float(rank + 1), np.float32))
+assert np.allclose(arr.Get(), 3.0)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} MACHINEFILE OK", flush=True)
+'''
+
+
+class TestMachineFile:
+    def test_parse_and_port_fill(self, tmp_path):
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        mf = tmp_path / "hosts"
+        mf.write_text("# cluster\nhost-a:7000\n\nhost-b\n")
+        from multiverso_tpu.utils.configure import GetFlag
+        saved = GetFlag("port")
+        SetCMDFlag("port", 6000)
+        try:
+            assert multihost._parse_machine_file(str(mf)) == [
+                "host-a:7000", "host-b:6000"]
+            # IPv6: bracketed keeps its port, bare literal gets bracketed
+            mf.write_text("[::1]:7000\nfe80::abcd\n")
+            assert multihost._parse_machine_file(str(mf)) == [
+                "[::1]:7000", "[fe80::abcd]:6000"]
+            # empty / missing files fail loudly (never silent 1-process)
+            mf.write_text("# only comments\n")
+            with pytest.raises(Exception):
+                multihost._parse_machine_file(str(mf))
+            with pytest.raises(Exception):
+                multihost._parse_machine_file(str(mf) + ".nope")
+        finally:
+            SetCMDFlag("port", saved)
+
+    def test_local_rank_match(self, tmp_path):
+        from multiverso_tpu.parallel import multihost
+        # unique local line -> matched; two local lines -> ambiguous (None)
+        assert multihost._match_local_rank(
+            ["10.255.255.1:7000", "127.0.0.1:7001"]) == 1
+        assert multihost._match_local_rank(
+            ["127.0.0.1:7000", "127.0.0.1:7001"]) is None
+
+    def test_two_process_world_from_machine_file(self, tmp_path):
+        mf = tmp_path / "hosts"
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        mf.write_text(f"127.0.0.1:{port}\n127.0.0.1:{port + 1}\n")
+        run_two_process(_MACHINE_FILE_CHILD, tmp_path, str(mf),
+                        expect="MACHINEFILE OK")
+
+
 _SPARSE_CHILD = r'''
 import os, sys
 rank, port = int(sys.argv[1]), sys.argv[2]
