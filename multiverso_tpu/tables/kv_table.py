@@ -224,6 +224,58 @@ class KVServerTable(ServerTable):
         out[slots < 0] = 0  # absent keys read as default-constructed (0)
         return out
 
+    # -- device plane (matrix_table device_* counterpart) -------------------
+    # A mesh-resident worker resolves its key batch ONCE on host
+    # (device_slots — dynamic key sets are control-plane logic) and scans
+    # the traceable gather / scatter-add over the sharded values array
+    # inside its own training step, so KV rounds fuse into the caller's
+    # XLA program and values never leave HBM. Bypasses the engine: no
+    # collective merge and no single-writer arbitration — single process,
+    # one device-plane writer (the same contract as the matrix device
+    # plane). Resolve with create=True BEFORE taking device_values():
+    # growth at resolve time replaces the backing array.
+
+    def _check_device_plane(self) -> None:
+        CHECK(multihost.process_count() <= 1,
+              "KV device plane is single-process (no collective merge)")
+        CHECK(not self._host_backed,
+              "64-bit KV tables are host-resident (no device plane)")
+
+    def device_slots(self, keys, create: bool = False) -> np.ndarray:
+        """keys -> bucket-padded slot vector (pad/absent lanes -> the
+        trash slot; on gather the caller masks them, on scatter their
+        deltas must be zero — exactly ProcessAdd's own padding rule)."""
+        self._check_device_plane()
+        keys = np.asarray(keys, np.int64).ravel()
+        return self._pad_slots(self._slots_for(keys, create=create))
+
+    def device_values(self) -> jax.Array:
+        """The live sharded values array (hand it through your scan
+        carry; write it back with device_set_values). Host-plane Adds
+        DONATE this buffer (the jit'd scatter-add is in-place) — a
+        reference held across an interleaved engine Add is a deleted
+        array; take it fresh after any host-plane write."""
+        self._check_device_plane()
+        return self._values
+
+    def device_set_values(self, values: jax.Array) -> None:
+        self._check_device_plane()
+        CHECK(values.shape == (self.capacity,),
+              f"values shape {values.shape} != capacity {self.capacity}")
+        CHECK(values.dtype == self.dtype,
+              f"values dtype {values.dtype} != table dtype {self.dtype} "
+              f"(a drifted carry dtype would corrupt Store/Load and Gets)")
+        self._values = values
+
+    def device_gather_slots(self, values, padded_slots):
+        """Traceable: values[slots] (mask trash lanes yourself)."""
+        return values[padded_slots]
+
+    def device_scatter_add_slots(self, values, padded_slots, padded_deltas):
+        """Traceable: values.at[slots].add(deltas) — duplicates
+        accumulate; pad-lane deltas must be zero."""
+        return values.at[padded_slots].add(padded_deltas)
+
     @property
     def size(self) -> int:
         return len(self._index)
@@ -283,3 +335,8 @@ class KVWorkerTable(WorkerTable):
     def raw(self) -> Dict[int, float]:
         """Local cache of last-fetched values (reference kv_table.h:40)."""
         return self._cache
+
+    def server(self) -> KVServerTable:
+        """The co-located server half — device-plane access (same contract
+        as MatrixWorkerTable.server())."""
+        return self._zoo.server_tables[self.table_id]

@@ -487,6 +487,76 @@ class TestKVTable:
         np.testing.assert_allclose(table.Get([3, 9]), [1.5, 2.5])
 
 
+class TestKVDevicePlane:
+    """KV device plane (kv_table.py device_*): resolve keys once on host,
+    trace gather/scatter-add over the sharded values array inside a
+    scanned step — the matrix device plane's KV counterpart."""
+
+    def test_traced_rounds_match_host_plane(self, mv_env):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        table = mv_env.MV_CreateTable(KVTableOption())
+        server = table.server()
+        keys = np.array([5, 9, 9, 17, 10**12], np.int64)
+        slots = server.device_slots(keys, create=True)  # resolve + pad
+        deltas = np.zeros(len(slots), np.float32)
+        deltas[: len(keys)] = [1.0, 2.0, 3.0, 4.0, 5.0]  # pad lanes: zero
+
+        @jax.jit
+        def rounds(values, slots, deltas):
+            def body(values, _):
+                values = server.device_scatter_add_slots(values, slots,
+                                                         deltas)
+                got = server.device_gather_slots(values, slots)
+                return values, got[0]
+            return lax.scan(body, values, jnp.arange(3))
+
+        values, ys = rounds(server.device_values(), jnp.asarray(slots),
+                            jnp.asarray(deltas))
+        server.device_set_values(values)
+        # duplicates accumulated (key 9: 2+3 per round), 3 rounds total,
+        # and the HOST plane sees the device writes
+        np.testing.assert_allclose(table.Get(np.array([5, 9, 17, 10**12])),
+                                   [3.0, 15.0, 12.0, 15.0])
+        np.testing.assert_allclose(np.asarray(ys), [1.0, 2.0, 3.0])
+
+    def test_absent_keys_and_growth_order(self, mv_env):
+        import jax.numpy as jnp
+        table = mv_env.MV_CreateTable(KVTableOption(init_capacity=8))
+        server = table.server()
+        # create=False: absent keys pad to the trash slot (masked reads)
+        slots = server.device_slots(np.array([42], np.int64), create=False)
+        assert slots[0] == server.capacity - 1
+        # growth happens AT RESOLVE time: resolve first, then take values
+        many = np.arange(100, dtype=np.int64)
+        slots = server.device_slots(many, create=True)
+        values = server.device_values()
+        assert values.shape[0] == server.capacity >= 100
+        deltas = np.zeros(len(slots), np.float32)
+        deltas[:100] = 1.0
+        values = server.device_scatter_add_slots(
+            values, jnp.asarray(slots), jnp.asarray(deltas))
+        server.device_set_values(values)
+        np.testing.assert_allclose(table.Get(many), 1.0)
+
+    def test_host_backed_dtype_rejected(self, mv_env):
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(KVTableOption(dtype=np.int64))
+        with pytest.raises(FatalError):
+            table.server().device_slots(np.array([1], np.int64))
+
+    def test_drifted_writeback_dtype_rejected(self, mv_env):
+        import jax.numpy as jnp
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(KVTableOption())
+        server = table.server()
+        server.device_slots(np.array([1], np.int64), create=True)
+        bad = server.device_values().astype(jnp.bfloat16)
+        with pytest.raises(FatalError):
+            server.device_set_values(bad)  # would corrupt Store/Load
+
+
 class TestSparseMatrixTable:
     def _make(self, mv, workers=2):
         return mv.MV_CreateTable(
