@@ -73,17 +73,10 @@ class ArrayServer(ServerTable):
                 a, self._per_leaf_sharding(a, ctx)), aux),
         }
 
-        def _update(state, delta, opt):
-            new_data, new_aux = self.updater.update(state["data"], state["aux"],
-                                                    delta, opt)
-            return {"data": new_data, "aux": new_aux}
-
-        self._update = jax.jit(_update, donate_argnums=(0,))
-
-        def _access(state, opt):
-            return self.updater.access(state["data"], state["aux"], opt)
-
-        self._access = jax.jit(_access)
+        # the engine's jitted programs ARE the device-plane bodies —
+        # one source of truth for the updater call convention
+        self._update = jax.jit(self.device_update, donate_argnums=(0,))
+        self._access = jax.jit(self.device_access)
 
     def _per_leaf_sharding(self, leaf, ctx):
         """data-shaped leaves shard like data; (num_workers, ...) leaves shard
@@ -120,6 +113,56 @@ class ArrayServer(ServerTable):
     def raw(self) -> jax.Array:
         """The live sharded device array (padded)."""
         return self.state["data"]
+
+    # -- device plane (matrix/kv device_* counterpart) ----------------------
+    # Traceable whole-table verbs for mesh-resident workers: scan them over
+    # the state dict in your own step (PS rounds fuse into one XLA
+    # program). Same contract as the other device planes: single process,
+    # one writer, `state` handed through the scan carry and written back.
+
+    def _check_device_plane(self) -> None:
+        CHECK(multihost.process_count() <= 1,
+              "Array device plane is single-process (no collective merge)")
+
+    def device_state(self):
+        """The live {'data','aux'} pytree (scan carry; write back with
+        device_set_state). Host-plane Adds donate these buffers — re-take
+        after any interleaved engine Add."""
+        self._check_device_plane()
+        return self.state
+
+    def device_set_state(self, state) -> None:
+        self._check_device_plane()
+        CHECK(state["data"].shape == (self.padded,)
+              and state["data"].dtype == self.dtype,
+              "device_set_state: data leaf shape/dtype mismatch")
+        # the aux carry must not drift either (structure + leaf
+        # shape/dtype): drifted aux would corrupt the next host-plane
+        # update's trace and the checkpoint's serialized state
+        old_aux = self.state["aux"]
+        CHECK(jax.tree.structure(state["aux"])
+              == jax.tree.structure(old_aux),
+              "device_set_state: aux tree structure drifted")
+        for new_leaf, old_leaf in zip(jax.tree.leaves(state["aux"]),
+                                      jax.tree.leaves(old_aux)):
+            CHECK(new_leaf.shape == old_leaf.shape
+                  and new_leaf.dtype == old_leaf.dtype,
+                  f"device_set_state: aux leaf drifted "
+                  f"({old_leaf.shape}/{old_leaf.dtype} -> "
+                  f"{new_leaf.shape}/{new_leaf.dtype})")
+        self.state = state
+
+    def device_update(self, state, padded_delta, opt):
+        """Traceable: one whole-table Add through the table's updater
+        (delta must be padded to ``self.padded``; opt = AddOption.as_jnp())."""
+        new_data, new_aux = self.updater.update(state["data"], state["aux"],
+                                                padded_delta, opt)
+        return {"data": new_data, "aux": new_aux}
+
+    def device_access(self, state, opt=None):
+        """Traceable: the whole table through the updater's access hook
+        (slice [: size] yourself if you need the logical view)."""
+        return self.updater.access(state["data"], state["aux"], opt)
 
     # -- checkpoint (reference array_table.cpp:145-154) ---------------------
 
@@ -187,6 +230,11 @@ class ArrayWorker(WorkerTable):
         training loops that push every minibatch and never wait)."""
         self.AddAsync({"values": np.asarray(delta, self.dtype)}, option,
                       track=False)
+
+    def server(self) -> ArrayServer:
+        """The co-located server half — device-plane access (same
+        contract as MatrixWorkerTable.server())."""
+        return self._zoo.server_tables[self.table_id]
 
     def Partition(self, num_servers: Optional[int] = None) -> List[Tuple[int, int]]:
         """Pure sharding math, unit-testable without a server

@@ -705,3 +705,67 @@ class TestErrorPropagation:
             assert outcome == {"a": "ok", "b": "raised"}
         finally:
             mv.MV_ShutDown()
+
+
+class TestArrayDevicePlane:
+    """Array device plane (array_table.py device_*): whole-table updater
+    rounds scanned into the caller's XLA program."""
+
+    def test_traced_sgd_rounds_match_host_plane(self, mv_env):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=10,
+                                                       updater_type="sgd"))
+        server = table.server()
+        delta = np.zeros(server.padded, np.float32)
+        delta[:10] = 0.5
+        opt = AddOption().as_jnp()
+
+        @jax.jit
+        def rounds(state, delta):
+            def body(state, _):
+                state = server.device_update(state, delta, opt)
+                return state, server.device_access(state)[0]
+            return lax.scan(body, state, jnp.arange(4))
+
+        state, ys = rounds(server.device_state(), jnp.asarray(delta))
+        server.device_set_state(state)
+        # sgd: data -= delta, 4 rounds; host plane sees the device writes
+        np.testing.assert_allclose(table.Get(), -2.0)
+        np.testing.assert_allclose(np.asarray(ys), [-0.5, -1.0, -1.5, -2.0])
+
+    def test_adagrad_aux_rides_the_carry(self):
+        import jax
+        import jax.numpy as jnp
+        import multiverso_tpu as mv
+        mv.MV_Init(["-num_workers=2"])
+        try:
+            table = mv.MV_CreateTable(ArrayTableOption(
+                size=8, updater_type="adagrad"))
+            server = table.server()
+            delta = np.full(server.padded, 0.2, np.float32)
+            opt = AddOption(worker_id=1, learning_rate=0.1,
+                            rho=0.3).as_jnp()
+            state = server.device_state()
+            state = jax.jit(server.device_update)(state, jnp.asarray(delta),
+                                                  opt)
+            server.device_set_state(state)
+            got = table.Get()
+            assert np.all(np.isfinite(got)) and np.all(got != 0)
+            # per-worker hist updated for worker 1 only
+            hist = np.asarray(server.aux_to_logical(state["aux"]["hist"]))
+            assert hist.shape[0] == 2
+            assert np.all(hist[1] > 0) and np.all(hist[0] == 0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_bad_writeback_rejected(self, mv_env):
+        import jax.numpy as jnp
+        from multiverso_tpu.utils.log import FatalError
+        table = mv_env.MV_CreateTable(ArrayTableOption(size=8))
+        server = table.server()
+        state = dict(server.device_state())
+        state["data"] = state["data"].astype(jnp.bfloat16)
+        with pytest.raises(FatalError):
+            server.device_set_state(state)
