@@ -218,15 +218,38 @@ class NativeBridge:
         return 0
 
     def _store_load(self, table, uri: bytes, store: bool) -> int:
-        from multiverso_tpu.utils.io import StreamFactory
+        import io as _io
+        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.utils.io import Stream, StreamFactory
+        from multiverso_tpu.utils.waiter import Waiter
         from multiverso_tpu.zoo import Zoo
         entry = self._tables[table]
-        Zoo.Get().DrainServer()  # order against submitted adds (native parity)
-        with StreamFactory.GetStream(uri.decode(), "wb" if store else "rb") as s:
-            if store:
-                entry.server.Store(s)
-            else:
-                entry.server.Load(s)
+        name = uri.decode()
+
+        # The snapshot/restore rides the engine mailbox (native
+        # kStoreTable/kLoadTable parity) so it is ordered against every
+        # applied Add — a drain + caller-thread access could race Adds
+        # pushed after the drain. But the URI IO itself (possibly slow
+        # remote storage) stays on THIS thread: only the in-memory
+        # serialize/deserialize occupies the engine.
+        def submit(fn):
+            waiter = Waiter(1)
+            msg = Message(msg_type=MsgType.Request_StoreLoad,
+                          payload={"fn": fn}, waiter=waiter)
+            Zoo.Get().SendToServer(msg)
+            waiter.Wait()
+            if isinstance(msg.result, Exception):
+                raise msg.result
+
+        if store:
+            buf = _io.BytesIO()
+            submit(lambda: entry.server.Store(Stream(buf, name)))
+            with StreamFactory.GetStream(name, "wb") as s:
+                s.Write(buf.getbuffer())  # zero-copy view of the snapshot
+        else:
+            with StreamFactory.GetStream(name, "rb") as s:
+                raw = s.Read(-1)  # read-all
+            submit(lambda: entry.server.Load(Stream(_io.BytesIO(raw), name)))
         return 0
 
     # -- install / uninstall ------------------------------------------------
@@ -237,7 +260,9 @@ class NativeBridge:
             init=INIT_FN(lambda argc, argv: g(self._init, argc, argv)),
             shutdown=VOID_FN(lambda: g(self._shutdown)),
             barrier=VOID_FN(lambda: g(self._barrier)),
-            num_workers=VOID_FN(lambda: g(self._num_workers, err=1)),
+            # error sentinel is NEGATIVE: err=1 would be indistinguishable
+            # from a genuine 1-worker world (the C side MVT_CHECKs > 0)
+            num_workers=VOID_FN(lambda: g(self._num_workers, err=-1)),
             new_table=NEW_TABLE_FN(
                 lambda r, c, a: g(self._new_table, r, c, a)),
             get=GET_FN(lambda t, ids, n, out, nf, w:

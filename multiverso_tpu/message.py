@@ -29,6 +29,11 @@ class MsgType(enum.IntEnum):
     Request_Add = 2
     Request_Barrier = 33
     Request_Register = 34
+    # table persistence rides the server mailbox so snapshots are ordered
+    # against every applied Add (native kStoreTable/kLoadTable = 34/35;
+    # 34 is taken here by Request_Register, so one shared type carries
+    # both directions in its payload)
+    Request_StoreLoad = 35
     Reply_Get = -1
     Reply_Add = -2
     Reply_Barrier = -33

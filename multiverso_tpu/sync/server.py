@@ -94,6 +94,10 @@ class Server(Actor):
         # must NOT touch the BSP clocks, unlike FinishTrain (native
         # ServerC registers the same handler, native/src/store.cc)
         self.RegisterHandler(MsgType.Request_Barrier, lambda m: m.reply(None))
+        # table persistence on the engine thread: the snapshot/restore in
+        # payload["fn"] cannot race applied Adds (native kStoreTable/
+        # kLoadTable parity, native/src/store.cc HandleStoreLoad)
+        self.RegisterHandler(MsgType.Request_StoreLoad, self._store_load_entry)
 
     def RegisterTable(self, server_table) -> int:
         table_id = len(self.store_)
@@ -181,6 +185,13 @@ class Server(Actor):
 
     def ProcessFinishTrain(self, msg: Message) -> None:
         msg.reply(None)
+
+    def _store_load_entry(self, msg: Message) -> None:
+        try:
+            msg.reply(msg.payload["fn"]())
+        except Exception as exc:
+            Log.Error("table store/load failed: %r", exc)
+            msg.reply(exc)
 
     @staticmethod
     def GetServer(num_workers: int) -> "Server":

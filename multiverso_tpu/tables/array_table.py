@@ -35,7 +35,8 @@ import numpy as np
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import pad_to_multiple, partition_offsets
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
-from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
+from multiverso_tpu.updaters.base import (AddOption, CreateUpdater, GetOption,
+                                          Updater)
 from multiverso_tpu.utils.log import CHECK
 
 
@@ -77,6 +78,7 @@ class ArrayServer(ServerTable):
         # one source of truth for the updater call convention
         self._update = jax.jit(self.device_update, donate_argnums=(0,))
         self._access = jax.jit(self.device_access)
+        self._has_access = type(self.updater).access is not Updater.access
 
     def _per_leaf_sharding(self, leaf, ctx):
         """data-shaped leaves shard like data; (num_workers, ...) leaves shard
@@ -105,8 +107,14 @@ class ArrayServer(ServerTable):
     def ProcessGetAsync(self, option: GetOption = None):
         if multihost.process_count() > 1:
             return None  # multihost fetch is a collective — keep sync path
-        out = self._access(self.state, None)  # jit'd: output is a fresh
-        # buffer, never the live (donatable) state array
+        out = self._access(self.state, None)
+        if not self._has_access:
+            # identity access: XLA may alias the jit output to the live
+            # state buffer; an Add drained later in the same pipeline
+            # window donates that buffer (donate_argnums) and the pending
+            # finalize would read a deleted array. Snapshot first — same
+            # guard as MatrixServerTable.ProcessGetAsync.
+            out = jnp.copy(out)
         out.copy_to_host_async()
         return lambda: np.asarray(out)[: self.size]
 

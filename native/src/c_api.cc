@@ -24,12 +24,17 @@ struct TableRef {
 
 struct Runtime {
   std::unique_ptr<mvt::ServerC> server;
-  int num_workers = 1;
+  // atomic for the same contract-violation tolerance as the flags below:
+  // MV_NumWorkers may race an MV_Init that is mid-write
+  std::atomic<int> num_workers{1};
   std::mutex mu;
-  // registered TPU backend (c_api.h MV_BackendVTable); by-value copy
+  // registered TPU backend (c_api.h MV_BackendVTable); by-value copy.
+  // The flags are atomic so the lock-free routed() fast path reads a
+  // defined value even if a caller violates the no-live-world contract
+  // and races MV_RegisterBackend/MV_Init (degrades UB to a clean check).
   MV_BackendVTable backend{};
-  bool has_backend = false;
-  bool backend_live = false;  // backend.init ran (world up through backend)
+  std::atomic<bool> has_backend{false};
+  std::atomic<bool> backend_live{false};  // backend.init ran
   // handle registry: the C ABI hands out opaque TableRef*; the world owns
   // them and frees them at shutdown (the reference's c_api leaks its
   // handles — no free verb exists in the ABI)
@@ -44,7 +49,10 @@ Runtime& rt() {
 thread_local int tls_worker_id = 0;
 thread_local mvt::AddOptionC tls_add_option;
 
-bool routed() { return rt().has_backend && rt().backend_live; }
+bool routed() {
+  return rt().has_backend.load(std::memory_order_acquire) &&
+         rt().backend_live.load(std::memory_order_acquire);
+}
 
 void submit(mvt::MessagePtr msg, bool wait) {
   mvt::Waiter waiter(1);
@@ -107,8 +115,11 @@ void MV_Init(int* argc, char* argv[]) {
     if (rt().has_backend) {
       MVT_CHECK(!rt().backend_live);
       MVT_CHECK(rt().backend.init(argc, argv) == 0);
-      rt().backend_live = true;
       rt().num_workers = rt().backend.num_workers();
+      // the callback reports failure as a negative sentinel — a silent
+      // bad world size would mis-shard every later collective
+      MVT_CHECK(rt().num_workers > 0);
+      rt().backend_live.store(true, std::memory_order_release);
       return;
     }
   }
@@ -165,7 +176,10 @@ void MV_Barrier() {
 }
 
 int MV_NumWorkers() {
-  return routed() ? rt().backend.num_workers() : rt().num_workers;
+  if (!routed()) return rt().num_workers;
+  int n = rt().backend.num_workers();
+  MVT_CHECK(n > 0);  // negative = callback error sentinel
+  return n;
 }
 int MV_WorkerId() { return tls_worker_id; }
 int MV_ServerId() { return 0; }
