@@ -125,10 +125,12 @@ class Communicator:
                                  ) -> Tuple[TrainState, dict]:
         """Device-plane fetch: gather the block's rows straight out of the
         sharded stores (docs/DESIGN.md §4) — the TrainState AND the
-        originals kept for the delta push stay in HBM. Single-process,
-        single-writer path: the caller owns the tables while training
-        (the app's block loop is sequential; reference omp-thread sharing
-        is the host plane's job)."""
+        originals kept for the delta push stay in HBM. Single-writer per
+        process: the caller owns the tables while training (the app's
+        block loop is sequential; reference omp-thread sharing is the
+        host plane's job). Multi-process the verbs are collective — the
+        same lockstep block-loop contract the host-plane tables already
+        impose on this app — and per-process row sets merge on device."""
         rows = {}
         train = {}
         for name, table, ids in self._row_specs(input_rows, output_rows):

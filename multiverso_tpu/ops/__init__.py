@@ -7,9 +7,9 @@ kernels: Pallas row gather / scatter on TPU (one DMA per requested row,
 no full-table traffic), with an XLA fallback for CPU test meshes.
 """
 
-from multiverso_tpu.ops.rows import (gather_rows, padded_cols,
+from multiverso_tpu.ops.rows import (dedup_rows, gather_rows, padded_cols,
                                      scatter_set_rows, update_rows,
                                      use_pallas)
 
-__all__ = ["gather_rows", "padded_cols", "scatter_set_rows", "update_rows",
-           "use_pallas"]
+__all__ = ["dedup_rows", "gather_rows", "padded_cols", "scatter_set_rows",
+           "update_rows", "use_pallas"]

@@ -84,6 +84,35 @@ def _forced_on(data) -> bool:
             and _pallas_eligible(data))
 
 
+def dedup_rows(ids: jax.Array, deltas: jax.Array):
+    """Traced duplicate combine: sum the deltas of equal ids into ONE
+    surviving lane; the other duplicate lanes become pad lanes (id -1,
+    zero delta). Pad lanes in (-1, zero-delta form) pass through.
+
+    This is the on-device equivalent of the host-side ``np.add.at``
+    pre-combine the table layer applies before scatter (scatter-set order
+    on duplicates is undefined — matrix_table.py module docstring), with
+    identical semantics: duplicates combine by SUM before the updater
+    runs. It is what makes merged multi-process device-plane batches
+    safe for every updater without a host round-trip.
+
+    Cost: one argsort over the id bucket + a segment-sum over the delta
+    payload — O(n log n + n·cols), fully fused into the caller's program.
+    """
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    sids = jnp.take(ids, order)
+    sdeltas = jnp.take(deltas, order, axis=0)
+    head = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sids[1:] != sids[:-1]])
+    seg = jnp.cumsum(head) - 1          # segment index per sorted lane
+    out_deltas = jax.ops.segment_sum(sdeltas, seg, num_segments=n)
+    # every lane of a segment writes the same id value, so the scatter's
+    # undefined duplicate order is harmless; unused segments stay -1 (pad)
+    out_ids = jnp.full((n,), -1, ids.dtype).at[seg].set(sids)
+    return out_ids, out_deltas
+
+
 def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
     """rows[i] = data[ids[i]]; all ids must be in range (caller maps
     out-of-shard lanes to the trash row).

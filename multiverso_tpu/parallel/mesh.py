@@ -98,6 +98,62 @@ def next_bucket(n: int, min_bucket: int = 8) -> int:
     return b
 
 
+def local_device_count(mesh: Mesh) -> int:
+    """Devices of ``mesh`` owned by THIS process (>=1)."""
+    import jax as _jax
+    pid = _jax.process_index()
+    return max(1, sum(1 for d in mesh.devices.flat
+                      if d.process_index == pid))
+
+
+def parts_bucket(n: int, local_dev: int) -> int:
+    """Per-process bucket for a batch-sharded parts array: the next_bucket
+    rung rounded up to a multiple of this process's device count, so the
+    global (nproc * bucket) batch always shards evenly over the mesh."""
+    return pad_to_multiple(next_bucket(n), local_dev)
+
+
+def place_parts(mesh: Mesh, local, nproc: int) -> jax.Array:
+    """THIS process's local block -> a batch-sharded GLOBAL array whose
+    axis 0 stacks every process's block in process order (global shape
+    ``(nproc * local.shape[0], ...)``, sharded P(SERVER_AXIS) on axis 0).
+
+    The one placement primitive behind every table's multi-process
+    device-plane verbs. Host arrays ride
+    ``make_array_from_process_local_data``; device-resident arrays stay
+    in HBM — the block is split across this process's mesh devices with
+    on-device slices (no host round-trip), falling back to the host path
+    only if the sharding's device-to-index map doesn't line up with
+    process-contiguous blocks (it does for the process-grouped meshes
+    build_mesh constructs)."""
+    import jax as _jax
+    spec = P(SERVER_AXIS, *([None] * (local.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (nproc * local.shape[0],) + tuple(local.shape[1:])
+    if isinstance(local, jax.Array) and local.is_fully_addressable:
+        pid = _jax.process_index()
+        offset = pid * local.shape[0]
+        pieces, ok = [], True
+        for dev, idx in sharding.devices_indices_map(global_shape).items():
+            if dev.process_index != pid:
+                continue
+            lo = (idx[0].start or 0) - offset
+            hi = (idx[0].stop if idx[0].stop is not None
+                  else global_shape[0]) - offset
+            if lo < 0 or hi > local.shape[0]:
+                ok = False   # non-contiguous process blocks: host fallback
+                break
+            pieces.append((lo, hi, dev))
+        if ok:
+            arrs = [_jax.device_put(local[lo:hi], dev)
+                    for lo, hi, dev in pieces]
+            return _jax.make_array_from_single_device_arrays(
+                global_shape, sharding, arrs)
+        local = np.asarray(local)
+    return _jax.make_array_from_process_local_data(
+        sharding, np.asarray(local), global_shape)
+
+
 def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
                axis_name: str = SERVER_AXIS) -> Mesh:
     """1-D mesh over all (or given) devices along the server axis."""

@@ -125,22 +125,21 @@ class ArrayServer(ServerTable):
     # -- device plane (matrix/kv device_* counterpart) ----------------------
     # Traceable whole-table verbs for mesh-resident workers: scan them over
     # the state dict in your own step (PS rounds fuse into one XLA
-    # program). Same contract as the other device planes: single process,
-    # one writer, `state` handed through the scan carry and written back.
-
-    def _check_device_plane(self) -> None:
-        CHECK(multihost.process_count() <= 1,
-              "Array device plane is single-process (no collective merge)")
+    # program). One device-plane writer per round; multi-process the
+    # rounds are COLLECTIVE — every process traces the identical program
+    # over the globally-sharded state, passing either an identical
+    # replicated delta (one logical writer) or its OWN delta through
+    # device_place_parts_delta + device_update_parts (per-process deltas
+    # summed inside the traced round, the reference's every-worker's-Add-
+    # accumulates semantics).
 
     def device_state(self):
         """The live {'data','aux'} pytree (scan carry; write back with
         device_set_state). Host-plane Adds donate these buffers — re-take
         after any interleaved engine Add."""
-        self._check_device_plane()
         return self.state
 
     def device_set_state(self, state) -> None:
-        self._check_device_plane()
         CHECK(state["data"].shape == (self.padded,)
               and state["data"].dtype == self.dtype,
               "device_set_state: data leaf shape/dtype mismatch")
@@ -171,6 +170,36 @@ class ArrayServer(ServerTable):
         """Traceable: the whole table through the updater's access hook
         (slice [: size] yourself if you need the logical view)."""
         return self.updater.access(state["data"], state["aux"], opt)
+
+    def device_place_parts_delta(self, local_delta) -> jax.Array:
+        """THIS process's whole-table delta (logical ``size`` or padded
+        length) -> a ``(nproc * padded,)`` global array whose per-process
+        slice is that process's delta, for device_update_parts.
+        Collective multi-process; device-resident deltas stay in HBM
+        (place_parts). ``padded`` is a multiple of num_servers, so the
+        global stack always shards evenly."""
+        from multiverso_tpu.parallel.mesh import place_parts
+        if isinstance(local_delta, jax.Array):
+            d = local_delta.ravel().astype(self.dtype)
+            if d.shape[0] == self.size and self.padded != self.size:
+                d = jnp.pad(d, (0, self.padded - d.shape[0]))
+        else:
+            d = np.asarray(local_delta, self.dtype).ravel()
+            if d.size == self.size and self.padded != self.size:
+                d = np.pad(d, (0, self.padded - d.size))
+        CHECK(d.shape[0] == self.padded, "parts delta size mismatch")
+        return place_parts(self._zoo.mesh_ctx.mesh, d,
+                           multihost.process_count())
+
+    def device_update_parts(self, state, parts_delta, opt):
+        """Traceable: one collective whole-table Add from per-process
+        deltas — ``parts_delta`` is the stacked global array from
+        device_place_parts_delta; the per-process contributions sum
+        inside the traced round (XLA inserts the collectives), then the
+        table's updater applies the merged delta exactly once."""
+        nproc = parts_delta.shape[0] // self.padded
+        delta = parts_delta.reshape(nproc, self.padded).sum(axis=0)
+        return self.device_update(state, delta, opt)
 
     # -- checkpoint (reference array_table.cpp:145-154) ---------------------
 

@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.parallel import multihost
-from multiverso_tpu.parallel.mesh import next_bucket, pad_to_multiple
+from multiverso_tpu.parallel.mesh import (local_device_count, next_bucket,
+                                          pad_to_multiple, parts_bucket,
+                                          place_parts)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.log import CHECK
@@ -167,8 +169,11 @@ class KVServerTable(ServerTable):
         else:
             self._values = ctx.place(jnp.asarray(host), self._sharding)
 
-    def _pad_slots(self, slots: np.ndarray) -> np.ndarray:
-        b = next_bucket(len(slots))
+    def _pad_slots(self, slots: np.ndarray,
+                   bucket: Optional[int] = None) -> np.ndarray:
+        CHECK(bucket is None or len(slots) <= bucket,
+              f"slot batch {len(slots)} exceeds the fixed bucket {bucket}")
+        b = bucket if bucket is not None else next_bucket(len(slots))
         # trash = last slot of a spare padding region: use capacity-1; it may
         # hold a live key, so padding entries carry zero delta on Add and are
         # sliced off on Get.
@@ -230,24 +235,73 @@ class KVServerTable(ServerTable):
     # the traceable gather / scatter-add over the sharded values array
     # inside its own training step, so KV rounds fuse into the caller's
     # XLA program and values never leave HBM. Bypasses the engine: no
-    # collective merge and no single-writer arbitration — single process,
-    # one device-plane writer (the same contract as the matrix device
-    # plane). Resolve with create=True BEFORE taking device_values():
-    # growth at resolve time replaces the backing array.
+    # single-writer arbitration — the caller owns the table while using
+    # it. Multi-process, the verbs are COLLECTIVE: slot creation merges
+    # every process's keys (process order, exactly ProcessAdd) so the
+    # index evolves identically everywhere, and per-process slot batches
+    # ride the traced round as batch-sharded global arrays
+    # (device_place_slots) — scatter-add accumulates duplicates natively,
+    # so no dedup pass is needed. Resolve with create=True BEFORE taking
+    # device_values(): growth at resolve time replaces the backing array.
 
     def _check_device_plane(self) -> None:
-        CHECK(multihost.process_count() <= 1,
-              "KV device plane is single-process (no collective merge)")
         CHECK(not self._host_backed,
               "64-bit KV tables are host-resident (no device plane)")
 
-    def device_slots(self, keys, create: bool = False) -> np.ndarray:
+    def device_slots(self, keys, create: bool = False, *,
+                     bucket: Optional[int] = None) -> np.ndarray:
         """keys -> bucket-padded slot vector (pad/absent lanes -> the
         trash slot; on gather the caller masks them, on scatter their
-        deltas must be zero — exactly ProcessAdd's own padding rule)."""
+        deltas must be zero — exactly ProcessAdd's own padding rule).
+        Collective multi-process (create or not): every process's new
+        keys enter the index in process order on every host, and the
+        returned vectors share ONE bucket (the global max key count's
+        parts_bucket) so the parts round traces identically everywhere —
+        pass ``bucket`` explicitly to skip the host agreement in
+        scan-style loops."""
         self._check_device_plane()
         keys = np.asarray(keys, np.int64).ravel()
-        return self._pad_slots(self._slots_for(keys, create=create))
+        if multihost.process_count() > 1 and (create or bucket is None):
+            # identical index evolution on every host: resolve the union
+            # in process order first (the control plane is host logic —
+            # the one host collective the KV device plane keeps); the
+            # same allgather carries the per-process counts the shared
+            # bucket needs. An explicit bucket with create=False is the
+            # promised collective-free fast path.
+            parts = multihost.host_allgather_objects(keys)
+            if create:
+                self._slots_for(np.concatenate(parts), create=True)
+            if bucket is None:
+                bucket = parts_bucket(
+                    max(len(p) for p in parts),
+                    local_device_count(self._zoo.mesh_ctx.mesh))
+        return self._pad_slots(self._slots_for(keys, create=create), bucket)
+
+    def device_place_slots(self, padded_slots, deltas=None, *,
+                           dtype=None):
+        """THIS process's bucket-padded slot vector (and optional delta
+        vector) -> batch-sharded global arrays for the traceable verbs.
+        Collective multi-process; every process must pass the same bucket
+        size (device_slots' shared-bucket agreement guarantees that).
+        Device-resident deltas stay in HBM (place_parts). Single-process
+        it simply places the batch on device."""
+        slots = np.asarray(padded_slots, np.int32).ravel()
+        nproc = multihost.process_count()
+        ctx = self._zoo.mesh_ctx
+        local_dev = local_device_count(ctx.mesh)
+        CHECK(len(slots) % local_dev == 0,
+              f"device_place_slots: bucket {len(slots)} must be a multiple "
+              f"of the {local_dev} local devices (use device_slots' bucket)")
+        gslots = place_parts(ctx.mesh, slots, nproc)
+        if deltas is None:
+            return gslots
+        if isinstance(deltas, jax.Array):
+            CHECK(deltas.shape == slots.shape,
+                  "device_place_slots: size mismatch")
+            return gslots, place_parts(ctx.mesh, deltas, nproc)
+        d = np.asarray(deltas, dtype or self.dtype).ravel()
+        CHECK(d.size == slots.size, "device_place_slots: size mismatch")
+        return gslots, place_parts(ctx.mesh, d, nproc)
 
     def device_values(self) -> jax.Array:
         """The live sharded values array (hand it through your scan
@@ -268,12 +322,18 @@ class KVServerTable(ServerTable):
         self._values = values
 
     def device_gather_slots(self, values, padded_slots):
-        """Traceable: values[slots] (mask trash lanes yourself)."""
+        """Traceable: values[slots] (mask trash lanes yourself). Accepts a
+        replicated batch OR a batch-sharded parts batch
+        (device_place_slots) — for parts, jit with replicated
+        out_shardings and slice your process's range out of an
+        addressable copy."""
         return values[padded_slots]
 
     def device_scatter_add_slots(self, values, padded_slots, padded_deltas):
         """Traceable: values.at[slots].add(deltas) — duplicates
-        accumulate; pad-lane deltas must be zero."""
+        accumulate (within a batch AND across processes' parts batches);
+        pad-lane deltas must be zero. Accepts replicated or parts
+        batches."""
         return values.at[padded_slots].add(padded_deltas)
 
     @property

@@ -46,7 +46,8 @@ from jax.sharding import PartitionSpec as P
 from multiverso_tpu import ops
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
-                                          next_bucket,
+                                          local_device_count, next_bucket,
+                                          parts_bucket, place_parts,
                                           storage_partition_server)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
@@ -262,6 +263,34 @@ class MatrixServerTable(ServerTable):
         # trash/foreign lanes return 0 and are summed across shards).
         self.device_gather_rows = _gather_rows
 
+        # -- parts variants: the MULTI-PROCESS device plane ------------------
+        # ids/deltas arrive as batch-sharded GLOBAL arrays
+        # (device_place_batch) whose per-process slice is that process's
+        # own batch. The traced round merges them on device: dedup_rows
+        # combines duplicate ids across processes by summing deltas (the
+        # host plane's np.add.at pre-combine contract, so every updater
+        # is safe), and GSPMD inserts the gathers that replicate the
+        # merged batch into the row program. Every process traces the
+        # identical round (SPMD collective contract) — this is the
+        # reference's "workers on every node reach every server shard"
+        # (worker.cpp:30-79) with ICI as the wire instead of MPI.
+
+        def _update_rows_parts(state, ids_parts, deltas_parts, opt):
+            ids, deltas = ops.dedup_rows(ids_parts, deltas_parts)
+            return _update_rows(state, ids, deltas, opt)
+
+        self.device_update_rows_parts = _update_rows_parts
+        self._update_rows_parts_j = jax.jit(_update_rows_parts,
+                                            donate_argnums=(0,))
+
+        def _gather_rows_parts(data, aux, ids_parts):
+            # gather is duplicate-safe — no dedup; the sharded batch is
+            # replicated by GSPMD on entry to the row program
+            return _gather_rows(data, aux, ids_parts)
+
+        self.device_gather_rows_parts = _gather_rows_parts
+        self._gather_rows_parts_j = jax.jit(_gather_rows_parts)
+
     def _aux_sharding(self, leaf, ctx):
         if leaf.ndim == 2:
             return ctx.sharding_rows()
@@ -420,21 +449,66 @@ class MatrixServerTable(ServerTable):
     # these two are their eager siblings for callers that want per-block
     # dispatch with host-plane validation but no host round-trip of the
     # row data (e.g. the WordEmbedding communicator's -device_plane path).
-    # Both are single-process: the device plane bypasses the engine, so
-    # there is no collective merge and no single-writer arbitration —
-    # the caller owns the table while using them.
+    # The device plane bypasses the engine: no single-writer arbitration —
+    # the caller owns the table while using it. Multi-process, the verbs
+    # are COLLECTIVE (every process calls them in lockstep, each passing
+    # its OWN batch); the per-process batches merge on device through the
+    # parts round — nothing rides a host collective except the one-int
+    # bucket agreement, and duplicate ids across processes combine by sum
+    # exactly like the host plane's collective merge.
 
-    def _check_device_plane(self) -> None:
-        from multiverso_tpu.parallel import multihost
-        CHECK(multihost.process_count() <= 1,
-              "the device plane is single-process (the engine's collective "
-              "merge is bypassed)")
-
-    def device_fetch_rows(self, row_ids) -> jax.Array:
-        """Rows for ``row_ids`` as a DEVICE array (never leaves HBM)."""
-        self._check_device_plane()
+    def device_place_batch(self, row_ids, deltas=None, *, bucket=None):
+        """THIS process's (ids[, deltas]) batch -> batch-sharded global
+        arrays for the parts verbs. Collective multi-process. Every
+        process must use the same ``bucket`` (pass it explicitly in
+        scan-style loops; ``None`` agrees on parts_bucket of the global
+        max batch via one tiny host allgather). Pad lanes are -1/zero.
+        Device-resident deltas stay in HBM (place_parts splits them
+        across this process's devices with on-device slices)."""
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        nproc = multihost.process_count()
+        local_dev = local_device_count(self._mesh)
+        if bucket is None:
+            bucket = parts_bucket(max(
+                multihost.host_allgather_objects(len(ids))), local_dev)
+        CHECK(len(ids) <= bucket,
+              f"device_place_batch: batch {len(ids)} exceeds bucket {bucket}")
+        CHECK(bucket % local_dev == 0,
+              f"device_place_batch: bucket {bucket} must be a multiple of "
+              f"the {local_dev} local devices (use parts_bucket)")
+        padded = np.full(bucket, -1, np.int32)
+        padded[: len(ids)] = ids
+        gids = place_parts(self._mesh, padded, nproc)
+        if deltas is None:
+            return gids
+        if isinstance(deltas, jax.Array):
+            d = deltas.reshape(len(ids), self.num_cols).astype(self.dtype)
+            if len(ids) < bucket:
+                d = jnp.pad(d, ((0, bucket - len(ids)), (0, 0)))
+        else:
+            d = np.zeros((bucket, self.num_cols), self.dtype)
+            d[: len(ids)] = np.asarray(deltas, self.dtype).reshape(
+                len(ids), self.num_cols)
+        return gids, place_parts(self._mesh, d, nproc)
+
+    def device_fetch_rows(self, row_ids) -> jax.Array:
+        """Rows for ``row_ids`` as a DEVICE array (never leaves HBM).
+        Multi-process: collective; each process gets its own rows out of
+        one merged SPMD gather round."""
+        ids = np.asarray(row_ids, np.int32).ravel()
+        self._check_ids(ids)
+        if multihost.process_count() > 1:
+            gids = self.device_place_batch(ids)
+            bucket = gids.shape[0] // multihost.process_count()
+            rows = self._gather_rows_parts_j(self.state["data"],
+                                             self.state["aux"], gids)
+            # rows is fully replicated: slice THIS process's range out of
+            # an addressable single-device copy — a per-process-divergent
+            # slice of the global array would claim replicated contents
+            # it doesn't have
+            start = multihost.process_index() * bucket
+            return rows.addressable_data(0)[start: start + len(ids)]
         padded = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
         rows = self._gather_rows(self.state["data"], self.state["aux"],
                                  padded)
@@ -443,10 +517,15 @@ class MatrixServerTable(ServerTable):
     def device_apply_rows(self, row_ids, deltas,
                           option: Optional[AddOption] = None) -> None:
         """Apply a (device or host) delta batch to ``row_ids`` in place —
-        same validation and duplicate pre-combining as ProcessAdd."""
-        self._check_device_plane()
+        same validation and duplicate pre-combining as ProcessAdd.
+        Multi-process: collective; per-process batches merge on device."""
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        if multihost.process_count() > 1:
+            gids, gdeltas = self.device_place_batch(ids, deltas)
+            self.state = self._update_rows_parts_j(
+                self.state, gids, gdeltas, (option or AddOption()).as_jnp())
+            return
         if len(np.unique(ids)) != len(ids):
             # duplicates must pre-combine on the host (scatter order is
             # undefined — module docstring); costs a device->host hop, so

@@ -353,6 +353,101 @@ class TestTwoProcessSparse:
         run_two_process(_SPARSE_CHILD, tmp_path, expect="SPARSE OK")
 
 
+_DEVICE_PLANE_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+import multiverso_tpu as mv
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+from multiverso_tpu.updaters.base import AddOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+opt = AddOption().as_jnp()
+
+# -- matrix: eager multi-process device plane -------------------------------
+# divergent per-process batches WITH a cross-process duplicate (row 20):
+# the parts round merges on device; dedup combines row 20's deltas by sum
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=4))
+srv = mat.server()
+my_ids = np.array([rank, 10 + rank, 20], np.int32)
+srv.device_apply_rows(my_ids, np.full((3, 4), float(rank + 1), np.float32))
+rows = mat.GetRows(np.array([0, 1, 10, 11, 20], np.int32))
+assert np.allclose(rows[[0, 2]], 1.0), rows
+assert np.allclose(rows[[1, 3]], 2.0), rows
+assert np.allclose(rows[4], 3.0), rows  # 1.0 + 2.0 merged on device
+# eager fetch: each process reads its own rows out of one merged round
+mine = srv.device_fetch_rows(np.array([10 + rank], np.int32))
+assert np.allclose(np.asarray(mine), float(rank + 1)), mine
+
+# -- matrix: scan-style traced parts rounds (fixed bucket) ------------------
+for step in range(3):
+    gids, gdeltas = srv.device_place_batch(
+        np.array([rank, 20], np.int32),
+        np.full((2, 4), 1.0, np.float32), bucket=4)
+    srv.state = srv._update_rows_parts_j(srv.state, gids, gdeltas, opt)
+rows = mat.GetRows(np.array([0, 1, 20], np.int32))
+assert np.allclose(rows[0], 1.0 + 3.0), rows   # proc 0's three rounds
+assert np.allclose(rows[1], 2.0 + 3.0), rows
+assert np.allclose(rows[2], 3.0 + 6.0), rows   # both processes x 3 rounds
+
+# -- kv: multi-process device plane -----------------------------------------
+kv = mv.MV_CreateTable(KVTableOption())
+ksrv = kv.server()
+my_keys = np.array([100 + rank, 500], np.int64)
+slots = ksrv.device_slots(my_keys, create=True)   # merges key sets
+gslots, gdeltas = ksrv.device_place_slots(
+    slots, np.pad(np.ones(2, np.float32), (0, len(slots) - 2)))
+vals = ksrv.device_values()
+vals = jax.jit(ksrv.device_scatter_add_slots, donate_argnums=(0,))(
+    vals, gslots, gdeltas)
+ksrv.device_set_values(vals)
+got = kv.Get(np.array([100, 101, 500], np.int64))
+assert np.allclose(got, [1.0, 1.0, 2.0]), got   # 500 accumulated both
+# parts gather: replicated out, each process slices its own range
+rep = jax.jit(ksrv.device_gather_slots,
+              out_shardings=NamedSharding(ksrv._zoo.mesh_ctx.mesh, P()))(
+    ksrv.device_values(), gslots)
+local = np.asarray(rep.addressable_data(0))
+mine = local[rank * len(slots): rank * len(slots) + 2]
+assert np.allclose(mine, [1.0, 2.0]), mine
+
+# -- array: per-process parts delta summed in the traced round --------------
+arr = mv.MV_CreateTable(ArrayTableOption(size=16))
+asrv = arr.server()
+parts = asrv.device_place_parts_delta(
+    np.full(16, float(rank + 1), np.float32))
+state = jax.jit(asrv.device_update_parts, donate_argnums=(0,))(
+    asrv.device_state(), parts, opt)
+asrv.device_set_state(state)
+assert np.allclose(arr.Get(), 3.0), arr.Get()
+
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} DEVICE PLANE OK", flush=True)
+'''
+
+
+class TestTwoProcessDevicePlane:
+    """The SPMD multi-process device plane (round-3 top ask): every
+    process issues the identical traced round while passing its OWN
+    batch as a shard of a global parts array — cross-process duplicate
+    ids combine by sum ON DEVICE (ops.dedup_rows), the host plane then
+    reads the merged result. Matches the reference's workers-reach-every-
+    server-shard deployment (worker.cpp:30-79) with ICI as the wire."""
+
+    def test_device_plane_across_processes(self, tmp_path):
+        run_two_process(_DEVICE_PLANE_CHILD, tmp_path,
+                        expect="DEVICE PLANE OK")
+
+
 _LR_CHILD = r'''
 import os, sys
 rank, port, workdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
@@ -430,11 +525,12 @@ from multiverso_tpu.models.wordembedding.distributed import (
 os.chdir(workdir)
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2"])
+device_plane = len(sys.argv) > 4 and sys.argv[4] == "device"
 opt = Option.parse_args([
     "-train_file", f"corpus_{rank}.txt", "-output", f"vectors_{rank}.txt",
     "-size", "16", "-epoch", "2", "-negative", "3", "-min_count", "1",
     "-read_vocab", "vocab.txt", "-data_block_size", "20000",
-    "-is_pipeline", "0"])
+    "-is_pipeline", "0"] + (["-device_plane", "1"] if device_plane else []))
 dwe = DistributedWordEmbedding(opt)
 dwe.run()
 mv.MV_Barrier()
@@ -465,6 +561,30 @@ class TestTwoProcessWordEmbedding:
             for w in words:
                 f.write(f"{w} 100\n")
         run_two_process(_WE_CHILD, tmp_path, tmp_path, expect="WE OK")
+        v0 = (tmp_path / "vectors_0.txt").read_text()
+        v1 = (tmp_path / "vectors_1.txt").read_text()
+        assert v0 == v1, "processes saved different embeddings"
+
+    def test_we_device_plane_across_two_processes(self, tmp_path):
+        """-device_plane 1 across two processes: each process's block rows
+        merge on device through the parts round (cross-process duplicate
+        rows combine by sum, like the host plane's collective merge) and
+        the saved embeddings still agree."""
+        words = [f"w{i}" for i in range(120)]
+
+        def gen(path, seed, sents):
+            r = np.random.default_rng(seed)
+            with open(path, "w") as f:
+                for _ in range(sents):
+                    f.write(" ".join(r.choice(words, 10)) + "\n")
+
+        gen(tmp_path / "corpus_0.txt", 3, 400)
+        gen(tmp_path / "corpus_1.txt", 4, 400)
+        with open(tmp_path / "vocab.txt", "w") as f:
+            for w in words:
+                f.write(f"{w} 100\n")
+        run_two_process(_WE_CHILD, tmp_path, tmp_path, "device",
+                        expect="WE OK")
         v0 = (tmp_path / "vectors_0.txt").read_text()
         v1 = (tmp_path / "vectors_1.txt").read_text()
         assert v0 == v1, "processes saved different embeddings"
