@@ -53,8 +53,16 @@ N_ROWS = 1_000_000
 N_COLS = 50
 ROW_FRACTION = 0.01
 ROUNDS = 1000          # timed rounds (cycles the staged pool)
+ROUNDS_SHORT = 200     # differential partner: per-round = (tB-tA)/(B-A),
+                       # cancelling the axon tunnel's ~90ms per-call RTT
+                       # that a single-length timing folds into every round
 STAGED_ROUNDS = 50     # distinct (ids, deltas) staged in HBM
 HOST_ROUNDS = 3
+
+# v5e single-chip peaks for the roofline fields (public spec: 819 GB/s
+# HBM BW, 197 bf16 TFLOP/s per chip)
+V5E_HBM_GBS = 819.0
+V5E_BF16_TFLOPS = 197.0
 
 # KVTable sparse push-pull config (BASELINE.json config matrix: "KVTable
 # sparse push-pull (hashed int64->float parameter shards)")
@@ -407,8 +415,14 @@ def bench_we_app(np, rng, tmpdir="/tmp/mvt_bench_we"):
 
 
 def bench_matrix_table(np, rng):
-    """Device-plane rounds (random + dense id sets) with element-wise
-    correctness. -> (device_Melem_s, device_dense_Melem_s)."""
+    """Device-plane PS rounds (random + dense id sets) through the FUSED
+    Add+Get round verb (device_update_gather_rows), with element-wise
+    correctness and honest accounting: every round's Get output is fully
+    consumed (``rows.sum()``) so XLA cannot dead-code the gather half —
+    the r2 bench consumed one element and measured an elided gather.
+    Timing is DIFFERENTIAL over two compiled scan lengths, cancelling the
+    axon tunnel's ~90ms per-call RTT. -> dict of metric fields incl.
+    roofline context."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -428,56 +442,73 @@ def bench_matrix_table(np, rng):
         rng.choice(N_ROWS, size=k, replace=False).astype(np.int32)
         for _ in range(STAGED_ROUNDS)])
     padded = np.stack([server.pad_ids(row) for row in ids_all])
+    bucket = padded.shape[1]
     deltas_all = rng.standard_normal(
-        (STAGED_ROUNDS, padded.shape[1], N_COLS)).astype(np.float32)
+        (STAGED_ROUNDS, bucket, N_COLS)).astype(np.float32)
     deltas_all[:, k:] = 0.0
     opt = AddOption().as_jnp()
+    notes = []
 
-    @jax.jit
-    def run_rounds(state, padded_ids, deltas):
-        def body(state, t):
-            i = t % STAGED_ROUNDS
-            ids, d = padded_ids[i], deltas[i]
-            state = server.device_update_rows(state, ids, d, opt)
-            rows = server.device_gather_rows(state["data"], state["aux"], ids)
-            return state, rows[0, 0]
-        return lax.scan(body, state, jnp.arange(ROUNDS))
+    def make_run(n):
+        @jax.jit
+        def run(state, padded_ids, deltas):
+            def body(state, t):
+                i = t % STAGED_ROUNDS
+                state, rows = server.device_update_gather_rows(
+                    state, padded_ids[i], deltas[i], opt)
+                return state, rows.sum()   # consume the FULL Get result
+            return lax.scan(body, state, jnp.arange(n))
+        return run
 
-    padded_d = jax.device_put(padded)
+    run_short, run_long = make_run(ROUNDS_SHORT), make_run(ROUNDS)
+
+    def time_rounds(padded_pool, keep_state=False):
+        """Differential min-of-3 per length -> seconds per round. The
+        final long-run state lands in ``server.state`` when
+        ``keep_state`` (the correctness oracle reads it there). If
+        tunnel jitter makes the differential non-positive (the long run
+        timing under the short one), fall back to the conservative
+        whole-long-run average and note it in the JSON."""
+        best = {}
+        state = None
+        for n, run in ((ROUNDS_SHORT, run_short), (ROUNDS, run_long)):
+            s = jax.tree.map(jnp.copy, server.state)
+            _, ys = run(s, padded_pool, deltas_d)   # warm/compile
+            float(ys[-1])
+            best[n] = float("inf")
+            for _ in range(3):
+                s = jax.tree.map(jnp.copy, server.state)
+                t0 = time.perf_counter()
+                s, ys = run(s, padded_pool, deltas_d)
+                float(ys[-1])      # forced fetch = sync
+                best[n] = min(best[n], time.perf_counter() - t0)
+            state = s
+        if keep_state:
+            server.state = state
+        per = (best[ROUNDS] - best[ROUNDS_SHORT]) / (ROUNDS - ROUNDS_SHORT)
+        if per <= 0:
+            notes.append("differential timing non-positive (tunnel "
+                         "jitter); reported whole-run average incl. RTT")
+            per = best[ROUNDS] / ROUNDS
+        return per
+
     deltas_d = jax.device_put(deltas_all)
-    s0 = jax.tree.map(jnp.copy, server.state)
-    out = run_rounds(s0, padded_d, deltas_d)
-    float(out[1][-1])  # warm + sync
-    device_secs = float("inf")
-    for _ in range(3):   # min-of-3 (see logreg comment)
-        state = jax.tree.map(jnp.copy, server.state)
-        t0 = time.perf_counter()
-        state, ys = run_rounds(state, padded_d, deltas_d)
-        float(ys[-1])      # forced fetch = sync
-        device_secs = min(device_secs, time.perf_counter() - t0)
-    server.state = state
+    padded_d = jax.device_put(padded)
+    rand_secs = time_rounds(padded_d, keep_state=True)
 
     # dense variant: contiguous id blocks (reference test_matrix_perf's
-    # get-all phases / WE identity-remap blocks) — rides the kernels'
-    # coalesced multi-row-DMA branch instead of per-row DMAs
+    # get-all phases / WE identity-remap blocks) — rides the runtime
+    # dense-run path (ONE bulk dynamic_slice RMW instead of row DMAs)
     ids_dense = np.stack([
         (np.arange(k) + int(b)).astype(np.int32)
-        for b in rng.integers(0, N_ROWS - k, STAGED_ROUNDS)])
+        for b in rng.integers(0, N_ROWS - bucket - 1, STAGED_ROUNDS)])
     padded_dn = jax.device_put(np.stack([server.pad_ids(r)
                                          for r in ids_dense]))
-    state2 = jax.tree.map(jnp.copy, server.state)
-    state2, ys = run_rounds(state2, padded_dn, deltas_d)
-    float(ys[-1])
-    dense_secs = float("inf")
-    for _ in range(3):
-        state2 = jax.tree.map(jnp.copy, server.state)
-        t0 = time.perf_counter()
-        state2, ys = run_rounds(state2, padded_dn, deltas_d)
-        float(ys[-1])
-        dense_secs = min(dense_secs, time.perf_counter() - t0)
+    dense_secs = time_rounds(padded_dn)
 
     # correctness (reference CHECKs every element, test_matrix_perf.cpp:84-110)
-    # — accumulate only the contributions landing on the verified row set
+    # — the kept state saw exactly ROUNDS rounds from the pristine table;
+    # accumulate only the contributions landing on the verified row set
     check_ids = ids_all[-1]
     pos = {int(r): i for i, r in enumerate(check_ids)}
     expected = np.zeros((k, N_COLS), np.float32)
@@ -492,8 +523,37 @@ def bench_matrix_table(np, rng):
         _fail("matrix_row_get_add", "correctness check failed", "Melem/s")
 
     mv.MV_ShutDown()
-    elems = 2 * ROUNDS * k * N_COLS
-    return elems / device_secs / 1e6, elems / dense_secs / 1e6
+    elems = 2 * k * N_COLS              # logical elems per round (Add+Get)
+    store_cols = server.store_cols
+    # physical HBM bytes per round: row read + row write at storage width
+    # (the 128-lane padding is measured FASTER than logical-width access:
+    # 50-col random gather ran 19.9 GB/s logical vs 23.8 padded on v5e)
+    # plus the staged delta read
+    phys = (2 * bucket * store_cols + bucket * N_COLS) * 4
+
+    def fields(prefix, secs):
+        return {
+            f"{prefix}_Melem_s": round(elems / secs / 1e6, 1),
+            f"{prefix}_logical_gb_s": round(elems * 4 / secs / 1e9, 2),
+            f"{prefix}_phys_gb_s": round(phys / secs / 1e9, 1),
+            f"{prefix}_pct_hbm_roofline": round(
+                100 * phys / secs / 1e9 / V5E_HBM_GBS, 1),
+        }
+
+    out = fields("matrix_table_device", rand_secs)
+    out.update(fields("matrix_table_device_dense", dense_secs))
+    if notes:
+        out["matrix_timing_notes"] = notes
+    out["matrix_config"] = (
+        f"{N_ROWS}x{N_COLS} f32 (stored x{store_cols}), "
+        f"{ROW_FRACTION:.0%} rows/op, fused Add+Get rounds, full-Get "
+        f"consume, differential timing ({ROUNDS_SHORT}/{ROUNDS} rounds); "
+        f"dense = contiguous id blocks (runtime bulk-slice path)")
+    out["matrix_device_floor_note"] = (
+        "random bound: 17ns/row DMA-issue scatter floor + 61 GB/s "
+        "random 512B-row gather on v5e => ~3.8 Gelem/s ideal for this "
+        "round; dense rides bulk slices (~290 GB/s r+w measured)")
+    return out
 
 
 def bench_host_plane(np, rng):
@@ -613,6 +673,14 @@ def main() -> int:
         "config": f"dense sigmoid LR, {LR_FEATURES} features, "
                   f"batch {LR_BATCH}, {LR_STEPS} steps, bf16 matmuls / "
                   "f32 weights+grads (loss parity vs f32 numpy asserted)",
+        # MFU vs the v5e bf16 MXU peak: fwd 2BF + grad 2BF flops per step.
+        # The step is HBM-bound reading X (bf16), so the honest companion
+        # is the data-side bandwidth fraction.
+        "logreg_mfu_pct_bf16_peak": round(
+            100 * tpu_sps * 4 * LR_FEATURES / (V5E_BF16_TFLOPS * 1e12), 2),
+        "logreg_data_gb_s": round(tpu_sps * LR_FEATURES * 2 / 1e9, 1),
+        "logreg_pct_hbm_roofline": round(
+            100 * tpu_sps * LR_FEATURES * 2 / 1e9 / V5E_HBM_GBS, 1),
     }
 
     # secondaries: record an error note instead of zeroing the headline
@@ -634,19 +702,17 @@ def main() -> int:
         out["we_pairs_per_sec"] = round(pps)
         out["we_config"] = (f"skipgram+NEG k={WE_NEG}, vocab {WE_VOCAB}, "
                             f"dim {WE_DIM}, batch {WE_PAIRS} pairs, adagrad")
+        # ~6*D flops per (pair, output): fwd dot + the two grad outer rows
+        # (f32 math; quoted against the bf16 MXU peak as the upper bound)
+        out["we_mfu_pct_bf16_peak"] = round(
+            100 * pps * 6 * WE_DIM * (1 + WE_NEG)
+            / (V5E_BF16_TFLOPS * 1e12), 3)
 
     def fill_we_app(wps):
         out["we_app_words_per_sec"] = round(wps)
 
     def fill_matrix(res):
-        dev_me, dense_me = res
-        out["matrix_table_device_Melem_s"] = round(dev_me, 1)
-        out["matrix_table_device_dense_Melem_s"] = round(dense_me, 1)
-        out["matrix_config"] = (f"{N_ROWS}x{N_COLS} f32, "
-                                f"{ROW_FRACTION:.0%} rows/op, "
-                                f"{ROUNDS} rounds cycling a "
-                                f"{STAGED_ROUNDS}-round staged pool; dense = "
-                                f"contiguous id blocks (coalesced DMA path)")
+        out.update(res)
 
     def fill_host(d):
         out.update(d)

@@ -8,8 +8,8 @@ no full-table traffic), with an XLA fallback for CPU test meshes.
 """
 
 from multiverso_tpu.ops.rows import (dedup_rows, gather_rows, padded_cols,
-                                     scatter_set_rows, update_rows,
-                                     use_pallas)
+                                     scatter_set_rows, update_gather_rows,
+                                     update_rows, use_pallas)
 
 __all__ = ["dedup_rows", "gather_rows", "padded_cols", "scatter_set_rows",
-           "update_rows", "use_pallas"]
+           "update_gather_rows", "update_rows", "use_pallas"]

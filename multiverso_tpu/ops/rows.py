@@ -113,19 +113,62 @@ def dedup_rows(ids: jax.Array, deltas: jax.Array):
     return out_ids, out_deltas
 
 
+def _dense_run(ids: jax.Array, n_rows: int):
+    """Traced detector for the DENSE fast path: the non-trash lanes are a
+    PREFIX of the lane vector holding strictly consecutive row ids, and
+    the bucket-sized slice [start, start+bucket) fits inside the live
+    rows (never touches the trash row, so dynamic_slice cannot clamp).
+    Returns (ok, start, count).
+
+    Lead-trash batches (a shard seeing the middle of a cross-shard run)
+    and interior trash (dedup_rows output) route to the general path on
+    purpose: the prefix form needs NO lane rolls — the slice lanes line
+    up with the batch lanes 1:1, which measured ~3x faster than the
+    roll-compensated general-segment variant on v5e (and rolls plus a
+    read-back slice defeated XLA's in-place aliasing of the table
+    buffer, turning every round into a whole-table copy)."""
+    trash = n_rows - 1
+    bucket = ids.shape[0]
+    mine = ids != trash
+    count = jnp.sum(mine)
+    lane = jnp.arange(bucket)
+    start = ids[0]
+    ok = (jnp.all(mine == (lane < count))
+          & jnp.all(jnp.where(mine, ids == start + lane, True))
+          & (count > 0) & (start + bucket <= trash))
+    return ok, start, count
+
+
 def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
     """rows[i] = data[ids[i]]; all ids must be in range (caller maps
-    out-of-shard lanes to the trash row).
+    out-of-shard lanes to the trash row). Trash/pad lanes may return
+    ARBITRARY row content — every caller masks or trash-routes them.
 
-    Reads ride XLA's native gather on every backend: measured on v5e it
-    runs at ~100 GB/s on RANDOM 512-byte rows — 5x the per-row-DMA Pallas
-    kernel and faster even than its coalesced contiguous branch (vector
-    loads beat DMA descriptors for reads). ``use_pallas=on`` still forces
-    the Pallas kernel so tests cover it."""
+    Reads ride XLA's native gather (``mode='clip'`` — the jnp default
+    'fill' adds an out-of-bounds select measured 3x slower on v5e) at
+    ~60 GB/s on random 512-byte rows; a runtime-detected dense run
+    (lax.cond) collapses to ONE bulk dynamic_slice at ~300-400 GB/s.
+    ``use_pallas=on`` still forces the Pallas kernel so tests cover
+    it."""
     if _forced_on(data):
         from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
         return pallas_gather_rows(data, ids, interpret=_interpret())
-    return jnp.take(data, ids, axis=0)
+    if ids.shape[0] >= data.shape[0]:
+        # bucket >= shard rows: the dense slice is trace-time ill-formed
+        # (and the run can never fit) — general path only
+        return jnp.take(data, ids, axis=0, mode="clip")
+    ok, start, _ = _dense_run(ids, data.shape[0])
+    bucket = ids.shape[0]
+
+    def dense(_):
+        # prefix layout: slice lane i IS batch lane i (no roll)
+        return jax.lax.dynamic_slice(data, (start, 0),
+                                     (bucket, data.shape[1]))
+
+    def general(_):
+        return jnp.take(data, ids, axis=0, mode="clip")
+
+    return jax.lax.cond(ok, dense, general, None)
 
 
 def scatter_set_rows(data: jax.Array, ids: jax.Array,
@@ -134,12 +177,39 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
 
     Writes are the mirror image of reads on TPU: XLA's scatter measured
     ~3-6 GB/s (it serializes), while the Pallas row-DMA kernel does
-    ~25 GB/s random and 60-200 GB/s on coalesced contiguous runs — so
-    writes keep the Pallas path wherever it is eligible."""
-    if use_pallas(data):
+    ~30 GB/s random (17ns/row DMA-issue floor on v5e) and 60-200 GB/s
+    on coalesced contiguous runs — so writes keep the Pallas path
+    wherever it is eligible. A runtime-detected dense run takes the bulk
+    slice-merge-update path (~300 GB/s r+w) instead."""
+    if _forced_on(data):
+        # test mode: keep the Pallas kernel covered even for dense runs
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
-        return pallas_scatter_set_rows(data, ids, rows, interpret=_interpret())
-    return data.at[ids].set(rows)
+        return pallas_scatter_set_rows(data, ids, rows,
+                                       interpret=_interpret())
+    fallback_pallas = use_pallas(data)
+
+    def general(_):
+        if fallback_pallas:
+            from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
+            return pallas_scatter_set_rows(data, ids, rows,
+                                           interpret=_interpret())
+        return data.at[ids].set(rows)
+
+    if ids.shape[0] >= data.shape[0]:
+        return general(None)   # see gather_rows static guard
+    ok, start, count = _dense_run(ids, data.shape[0])
+    bucket = ids.shape[0]
+
+    def dense(_):
+        # bulk RMW: pad lanes must keep OLD rows (a blind bucket write
+        # would clobber the live rows after the run's end)
+        old = jax.lax.dynamic_slice(data, (start, 0),
+                                    (bucket, data.shape[1]))
+        keep = (jnp.arange(bucket) < count)[:, None]
+        return jax.lax.dynamic_update_slice(
+            data, jnp.where(keep, rows, old), (start, 0))
+
+    return jax.lax.cond(ok, dense, general, None)
 
 
 def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
@@ -150,20 +220,65 @@ def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     identity-stable (one object per table) so the jit cache holds.
 
     Default TPU path is the HYBRID: XLA vector-gather for the read half
-    (~100 GB/s random — see gather_rows), combine fused elementwise, and
-    the coalesced Pallas scatter for the write half. Measured ~1.5x over
-    the all-DMA fused kernel on random row sets (250us vs 365us for 10k
-    512B rows) and comparable on contiguous sets (both coalesce).
-    ``use_pallas=on`` forces the fused single-kernel RMW so tests cover
-    it; the XLA fallback is gather + combine + scatter."""
+    (clip mode, see gather_rows), combine fused elementwise, and the
+    Pallas scatter for the write half. A runtime-detected dense run
+    instead does ONE bulk dynamic_slice -> combine -> dynamic_update_slice
+    (~290 GB/s r+w measured v5e — the 64-row chunk DMAs can't touch bulk
+    copies). ``use_pallas=on`` forces the fused single-kernel RMW so
+    tests cover it; the XLA fallback is gather + combine + scatter."""
     if _forced_on(data):
         from multiverso_tpu.ops.pallas_rows import pallas_update_rows
         return pallas_update_rows(data, ids, deltas, combine,
                                   interpret=_interpret())
-    if use_pallas(data):
-        from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
-        rows = jnp.take(data, ids, axis=0)
-        return pallas_scatter_set_rows(data, ids, combine(rows, deltas),
-                                       interpret=_interpret())
-    rows = jnp.take(data, ids, axis=0)
-    return data.at[ids].set(combine(rows, deltas))
+    # ONE implementation with update_gather_rows: the dropped rows output
+    # is an intermediate both branches compute anyway (zero extra work)
+    return _update_gather_impl(data, ids, deltas, combine,
+                               use_pallas(data))[0]
+
+
+def update_gather_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
+                       combine):
+    """The fused PS round: data[ids] = combine(data[ids], deltas) AND
+    return the post-update rows — ONE row read serves both the update and
+    the Get (the reference's test_matrix_perf Add-then-Get-same-rows
+    round pays two). Returns (new_data, rows); trash/pad lanes of
+    ``rows`` are arbitrary (callers mask). Dense runs ride the bulk
+    slice path end to end."""
+    if _forced_on(data):
+        from multiverso_tpu.ops.pallas_rows import pallas_update_rows
+        new_data = pallas_update_rows(data, ids, deltas, combine,
+                                      interpret=_interpret())
+        return new_data, jnp.take(new_data, ids, axis=0, mode="clip")
+    return _update_gather_impl(data, ids, deltas, combine,
+                               use_pallas(data))
+
+
+def _update_gather_impl(data, ids, deltas, combine, pallas_write):
+    bucket = ids.shape[0]
+    trash = data.shape[0] - 1
+
+    def dense(_):
+        sl = jax.lax.dynamic_slice(data, (start, 0), (bucket, data.shape[1]))
+        # pad/foreign lanes' deltas are trash-bound — zero them so the
+        # bulk path never applies them to live rows; their positions get
+        # combine(row, 0) == row (the contract)
+        dz = jnp.where((ids != trash)[:, None], deltas, 0)
+        new = combine(sl, dz)
+        out = jax.lax.dynamic_update_slice(data, new, (start, 0))
+        return out, new   # prefix layout: the Get half IS ``new``
+
+    def general(_):
+        rows = jnp.take(data, ids, axis=0, mode="clip")
+        new = combine(rows, deltas)
+        if pallas_write:
+            from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
+            out = pallas_scatter_set_rows(data, ids, new,
+                                          interpret=_interpret())
+        else:
+            out = data.at[ids].set(new)
+        return out, new
+
+    if bucket >= data.shape[0]:
+        return general(None)   # see gather_rows static guard
+    ok, start, _ = _dense_run(ids, data.shape[0])
+    return jax.lax.cond(ok, dense, general, None)

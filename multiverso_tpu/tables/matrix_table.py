@@ -263,6 +263,57 @@ class MatrixServerTable(ServerTable):
         # trash/foreign lanes return 0 and are summed across shards).
         self.device_gather_rows = _gather_rows
 
+        # -- fused PS round: Add + Get of the same rows ----------------------
+        # One traced verb for the reference's Add-then-Get-same-rows round
+        # (test_matrix_perf.cpp:84-110): for fusable updaters the single
+        # row read serves both halves (ops.update_gather_rows), saving a
+        # full gather per round. (state, padded_ids, deltas, opt) ->
+        # (state, rows) with the same masking/psum contract as
+        # device_gather_rows.
+
+        def _update_gather_local(local_data, local_aux, ids, deltas, opt):
+            mine, safe = _local_lanes(ids)
+            if fuse:
+                data, rows = ops.update_gather_rows(local_data, safe,
+                                                    deltas, combine)
+                aux = local_aux
+            else:
+                # non-fused updaters already computed the post-update rows
+                # — reuse them instead of a second full gather (duplicates
+                # are caller-pre-combined, so per-lane new_rows are exact;
+                # trash lanes are garbage and masked below)
+                rows_in = ops.gather_rows(local_data, safe)
+                aux_rows = _gather_aux(local_aux, safe)
+                rows, new_aux_rows = updater.update(rows_in, aux_rows,
+                                                    deltas, opt)
+                data = ops.scatter_set_rows(local_data, safe, rows)
+                aux = _scatter_aux(local_aux, new_aux_rows, safe)
+            if has_access:
+                rows = updater.access(rows, _gather_aux(aux, safe), None)
+            rows = jnp.where(mine[:, None], rows[:, :num_cols_], 0)
+            if single:
+                return data, aux, rows
+            return data, aux, lax.psum(rows, SERVER_AXIS)
+
+        def _update_gather_rows(state, ids, deltas, opt):
+            if deltas.shape[-1] != store_cols:
+                deltas = jnp.pad(
+                    deltas, ((0, 0), (0, store_cols - deltas.shape[-1])))
+            if single:
+                data, aux, rows = _update_gather_local(
+                    state["data"], state["aux"], ids, deltas, opt)
+                return {"data": data, "aux": aux}, rows
+            data, aux, rows = jax.shard_map(
+                _update_gather_local, mesh=self._mesh,
+                in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
+                          P()),
+                out_specs=(P(SERVER_AXIS, None), self._aux_specs, P()),
+                check_vma=False,
+            )(state["data"], state["aux"], ids, deltas, opt)
+            return {"data": data, "aux": aux}, rows
+
+        self.device_update_gather_rows = _update_gather_rows
+
         # -- parts variants: the MULTI-PROCESS device plane ------------------
         # ids/deltas arrive as batch-sharded GLOBAL arrays
         # (device_place_batch) whose per-process slice is that process's

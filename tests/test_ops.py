@@ -210,6 +210,94 @@ class TestMatrixTableWithPallas:
         np.testing.assert_allclose(table.Get(), expect, rtol=1e-6)
 
 
+class TestDenseRunPath:
+    """The runtime dense fast path (lax.cond -> bulk dynamic_slice) must be
+    bit-identical to the general path. Trash id = data.shape[0]-1 (the
+    table layer's convention); trash lanes are don't-care on gather and
+    must not leak writes to live rows."""
+
+    combine = staticmethod(lambda r, d: r + d)
+
+    def _mk(self, n_rows=64, cols=8, seed=0):
+        rng = np.random.default_rng(seed)
+        data = rng.standard_normal((n_rows, cols)).astype(np.float32)
+        return rng, data
+
+    @pytest.mark.parametrize("ids", [
+        [10, 11, 12, 13],                   # clean run
+        [63, 20, 21, 22],                   # leading trash (63 = trash)
+        [30, 31, 32, 63],                   # trailing trash
+        [63, 40, 41, 63],                   # both
+        [5, 7, 8, 9],                       # NOT a run -> general
+        [63, 12, 63, 13],                   # interior trash -> general
+        [58, 59, 60, 61],                   # run near the end (61+4>63? ok)
+    ])
+    def test_update_and_gather_match_general(self, ids):
+        from multiverso_tpu.ops import rows as rops
+        rng, data = self._mk()
+        ids = np.asarray(ids, np.int32)
+        deltas = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        trash = 63
+        live = ids != trash
+
+        out = np.asarray(jax.jit(rops.update_rows, static_argnames="combine")(
+            jnp.asarray(data), jnp.asarray(ids), jnp.asarray(deltas),
+            self.combine))
+        expect = data.copy()
+        expect[ids[live]] += deltas[live]
+        rows_mask = [r for r in range(64) if r != trash]
+        np.testing.assert_allclose(out[rows_mask], expect[rows_mask],
+                                   rtol=1e-6)
+
+        got = np.asarray(jax.jit(rops.gather_rows)(
+            jnp.asarray(data), jnp.asarray(ids)))
+        np.testing.assert_allclose(got[live], data[ids[live]], rtol=1e-6)
+
+        new_rows = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        out2 = np.asarray(jax.jit(rops.scatter_set_rows)(
+            jnp.asarray(data), jnp.asarray(ids), jnp.asarray(new_rows)))
+        expect2 = data.copy()
+        expect2[ids[live]] = new_rows[live]
+        np.testing.assert_allclose(out2[rows_mask], expect2[rows_mask],
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("ids", [[4, 5, 6, 7], [0, 30, 62, 9]])
+    def test_update_gather_rows_fused(self, ids):
+        from multiverso_tpu.ops import rows as rops
+        rng, data = self._mk(seed=3)
+        ids = np.asarray(ids, np.int32)
+        deltas = rng.standard_normal((len(ids), 8)).astype(np.float32)
+        new_data, rows = jax.jit(rops.update_gather_rows,
+                                 static_argnames="combine")(
+            jnp.asarray(data), jnp.asarray(ids), jnp.asarray(deltas),
+            self.combine)
+        expect = data.copy()
+        expect[ids] += deltas
+        live_rows = [r for r in range(64) if r != 63]
+        np.testing.assert_allclose(np.asarray(new_data)[live_rows],
+                                   expect[live_rows], rtol=1e-6)
+        # the Get half returns POST-update rows
+        np.testing.assert_allclose(np.asarray(rows), expect[ids], rtol=1e-5)
+
+    def test_table_round_verb_matches_separate_verbs(self, mv_env):
+        from multiverso_tpu.tables.matrix_table import MatrixTableOption
+        from multiverso_tpu.updaters.base import AddOption
+        table = mv_env.MV_CreateTable(
+            MatrixTableOption(num_rows=40, num_cols=5))
+        srv = table.server()
+        ids = np.array([3, 17, 29], np.int32)
+        deltas = np.arange(15, dtype=np.float32).reshape(3, 5)
+        padded = srv.pad_ids(ids)
+        pdeltas = np.zeros((len(padded), 5), np.float32)
+        pdeltas[:3] = deltas
+        state, rows = jax.jit(srv.device_update_gather_rows)(
+            jax.tree.map(jnp.copy, srv.state), jnp.asarray(padded),
+            jnp.asarray(pdeltas), AddOption().as_jnp())
+        srv.state = state
+        np.testing.assert_allclose(np.asarray(rows)[:3], deltas, rtol=1e-6)
+        np.testing.assert_allclose(table.GetRows(ids), deltas, rtol=1e-6)
+
+
 class TestShardedLayout:
     def test_storage_roundtrip_many_servers(self, mv_env):
         from multiverso_tpu.tables.matrix_table import MatrixTableOption
