@@ -393,9 +393,10 @@ def bench_we_app(np, rng, tmpdir="/tmp/mvt_bench_we"):
     opt = Option(train_file=f"{tmpdir}/corpus.txt",
                  output_file=f"{tmpdir}/vec.txt",
                  embedding_size=128, window_size=5, negative_num=5,
-                 min_count=1, epoch=1, data_block_size=400_000,
+                 min_count=1, epoch=1, data_block_size=2_000_000,
                  pair_batch_size=4096, init_learning_rate=0.05,
-                 use_adagrad=True, device_plane=True, is_pipeline=False)
+                 use_adagrad=True, device_plane=True, device_pairs=True,
+                 is_pipeline=False)
     # time the TRAIN phase (the reference's logged words/sec is training
     # too, trainer.cpp:45-49); dictionary/sampler/table setup excluded.
     # First instance warms every jit compile (shared in-process cache);

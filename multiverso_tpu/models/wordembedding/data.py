@@ -51,6 +51,12 @@ class DataBlock:
     word_count: int = 0
     stacked: Optional[dict] = None
     pair_count: int = 0
+    # -device_pairs mode: the block carries only the subsampled token
+    # stream (ids + sentence ids); pairs are derived on device
+    # (device_pairs.py). ``pair_count`` stays 0 — the program reports the
+    # true count as a device scalar.
+    tokens: Optional[np.ndarray] = None
+    token_sent: Optional[np.ndarray] = None
 
 
 def sentences_from_file(path: str, dictionary: Dictionary) -> Iterator[Tuple[np.ndarray, int]]:
@@ -156,6 +162,20 @@ class PairGenerator:
                     out.append(([c], outputs, labels))
         return out
 
+    def _compact_tokens(self, sentences: List[np.ndarray]):
+        """Sentences -> one (ids, sentence-ids) stream with word2vec
+        subsampling applied by REMOVAL (windows then reach farther — the
+        word2vec semantics both pair paths must share)."""
+        lens = np.fromiter((len(s) for s in sentences), np.int64,
+                           len(sentences))
+        ids = (np.concatenate(sentences) if sentences
+               else np.empty(0, np.int32))
+        sent = np.repeat(np.arange(len(sentences), dtype=np.int32), lens)
+        if self.opt.sample > 0 and len(ids):
+            keep = self.sampler.KeepMask(ids, self.opt.sample)
+            ids, sent = ids[keep], sent[keep]
+        return ids.astype(np.int32), sent
+
     def _skipgram_neg_arrays(self, sentences: List[np.ndarray]):
         """Vectorized skip-gram + NEG pair construction over the whole
         block (2*window offset passes over the concatenated ids instead of
@@ -171,14 +191,7 @@ class PairGenerator:
         Returns full-block (P, C) arrays (inputs, imask, outputs, labels,
         omask) with GLOBAL row ids, or None when the block is empty."""
         opt = self.opt
-        lens = np.fromiter((len(s) for s in sentences), np.int64,
-                           len(sentences))
-        ids = np.concatenate(sentences) if sentences else \
-            np.empty(0, np.int32)
-        sent = np.repeat(np.arange(len(sentences)), lens)
-        if opt.sample > 0 and len(ids):
-            keep = self.sampler.KeepMask(ids, opt.sample)
-            ids, sent = ids[keep], sent[keep]
+        ids, sent = self._compact_tokens(sentences)
         if len(ids) == 0:
             return None
         # positions within (possibly filtered) sentences
@@ -289,11 +302,25 @@ class PairGenerator:
                          output_rows=output_rows, word_count=word_count,
                          stacked=stacked, pair_count=P)
 
+    def make_token_block(self, sentences: List[np.ndarray],
+                         word_count: int, rng_stream=None) -> DataBlock:
+        """-device_pairs block: subsample + compact on the host (word2vec
+        REMOVES subsampled words, so windows reach farther — a
+        data-dependent shape the device program can't do), ship only the
+        surviving (ids, sentence-ids) stream."""
+        if rng_stream is not None:
+            self.sampler.set_thread_stream(rng_stream)
+        ids, sent = self._compact_tokens(sentences)
+        return DataBlock(word_count=word_count, tokens=ids,
+                         token_sent=sent)
+
     def make_block(self, sentences: List[np.ndarray],
                    word_count: int, rng_stream=None) -> DataBlock:
         # per-block deterministic randomness: the loader spawns streams in
         # block order (sampler.spawn_stream) so -seed reproduces exactly,
         # independent of -threads and scheduling
+        if getattr(self.opt, "device_pairs", False):
+            return self.make_token_block(sentences, word_count, rng_stream)
         if rng_stream is not None:
             self.sampler.set_thread_stream(rng_stream)
         if not self.opt.cbow and not self.opt.hs:

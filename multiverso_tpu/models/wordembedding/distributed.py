@@ -69,6 +69,11 @@ class DistributedWordEmbedding:
             mv.MV_Init([])
             self._owns_mv = True
         self.comm = Communicator(opt, self.dictionary.Size())
+        self._dp_trainer = None
+        if opt.device_pairs:
+            from multiverso_tpu.models.wordembedding.device_pairs import (
+                DevicePairsTrainer)
+            self._dp_trainer = DevicePairsTrainer(opt, self.comm, counts)
 
     # -- training -----------------------------------------------------------
 
@@ -97,7 +102,9 @@ class DistributedWordEmbedding:
             while pending and (force or len(pending) >= 2):
                 loss, pairs = pending.popleft()
                 self.total_loss += float(loss)
-                self.total_pairs += pairs
+                # -device_pairs blocks report the pair count as a device
+                # scalar (the program derives the pairs); int() fetches it
+                self.total_pairs += int(pairs)
 
         current = queue.pop()
         prefetch = None
@@ -170,8 +177,13 @@ class DistributedWordEmbedding:
 
     def _train_block(self, block: DataBlock, step) -> tuple:
         """One block through the scanned program. Returns (loss, pairs)
-        where loss is a DEVICE scalar (the caller harvests lazily so the
-        dispatch overlaps the next block's prep)."""
+        where both may be DEVICE scalars (the caller harvests lazily so
+        the dispatch overlaps the next block's prep)."""
+        if self.opt.device_pairs and block.tokens is not None:
+            # fused generate+train: the tiny token stream is the upload
+            return self._dp_trainer.train_block(block.tokens,
+                                                block.token_sent,
+                                                self._current_lr())
         if not block.pair_count:
             return 0.0, 0
         import jax.numpy as jnp

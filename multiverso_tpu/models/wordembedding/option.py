@@ -45,6 +45,12 @@ class Option:
     # device (communicator device plane, docs/DESIGN.md §4) — no host
     # round-trip per block. Single-process, single-worker path.
     device_plane: bool = False
+    # TPU-native extension 2: generate the training PAIRS on device too —
+    # the block uploads only the subsampled token stream (~80x smaller
+    # than the stacked pair tensors) and one fused program expands
+    # windows/negatives and trains in place on the tables
+    # (device_pairs.py). skipgram+NEG, single-process.
+    device_pairs: bool = False
     # force a jax platform ("cpu"/"tpu"); "" = jax default. Applied by
     # main() before the first backend touch (env JAX_PLATFORMS is not
     # reliable under every plugin, e.g. tunneled TPU shims).
@@ -75,6 +81,7 @@ class Option:
         "pair_batch": ("pair_batch_size", int),
         "seed": ("seed", int),
         "device_plane": ("device_plane", lambda v: bool(int(v))),
+        "device_pairs": ("device_pairs", lambda v: bool(int(v))),
         "platform": ("platform", str),
     }
 

@@ -157,6 +157,65 @@ class TestEndToEnd:
         _, avg_loss = _run(tmp_path, is_pipeline=False)
         assert avg_loss < 0.69 * 4 * 0.9
 
+    def test_device_pairs_trains_with_topic_structure(self, tmp_path):
+        """-device_pairs 1: the fused on-device generate+train program must
+        learn the same topic structure the host pair path learns (same
+        marginal pair distribution — windows, subsampling, unigram^0.75
+        negatives — different RNG stream)."""
+        opt, avg_loss = _run(tmp_path, device_pairs=True)
+        assert avg_loss < 0.69 * (1 + opt.negative_num) * 0.9
+        lines = open(opt.output_file).read().splitlines()[1:]
+        vecs = {l.split()[0]: np.array(l.split()[1:], float) for l in lines}
+
+        def cos(a, b):
+            return a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+
+        same = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{5*t + k}"])
+                        for t in range(4) for k in range(1, 5)])
+        cross = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{(5*t + 7) % 20}"])
+                         for t in range(4)])
+        assert same > cross
+        assert all(np.all(np.isfinite(v)) for v in vecs.values())
+
+    def test_device_pairs_adagrad(self, tmp_path):
+        _, avg_loss = _run(tmp_path, device_pairs=True, use_adagrad=True,
+                           init_learning_rate=0.1)
+        assert avg_loss < 0.69 * 4 * 0.9
+
+    def test_device_pairs_sparse_adagrad_matches_dense(self, tmp_path,
+                                                       monkeypatch):
+        """The large-vocab sparse touched-rows adagrad step must produce
+        the same tables as the dense full-table step (identical math,
+        different data movement) — same seed, same block, two thresholds."""
+        import jax.numpy as jnp
+        import multiverso_tpu as mv
+        from multiverso_tpu.models.wordembedding import device_pairs as dp
+        from multiverso_tpu.models.wordembedding.distributed import (
+            DistributedWordEmbedding)
+        corpus = tmp_path / "corpus.txt"
+        _make_corpus(str(corpus))
+        results = {}
+        for name, threshold in (("dense", 1 << 60), ("sparse", 0)):
+            monkeypatch.setattr(dp, "_SPARSE_BYTES", threshold)
+            opt = Option(train_file=str(corpus),
+                         output_file=str(tmp_path / f"v_{name}.txt"),
+                         embedding_size=16, window_size=2, negative_num=3,
+                         min_count=1, epoch=1, use_adagrad=True,
+                         device_pairs=True, init_learning_rate=0.1)
+            we = DistributedWordEmbedding(opt)
+            we.run()
+            results[name] = we.comm.pull_embeddings()
+            we.close()
+        np.testing.assert_allclose(results["sparse"], results["dense"],
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_device_pairs_rejects_cbow_and_hs(self, tmp_path):
+        from multiverso_tpu.utils.log import FatalError
+        with pytest.raises(FatalError):
+            _run(tmp_path, device_pairs=True, cbow=True)
+        with pytest.raises(FatalError):
+            _run(tmp_path, device_pairs=True, hs=True, negative_num=0)
+
     def test_device_plane_matches_host_plane(self, tmp_path):
         """-device_plane 1: fetch/train/push entirely in HBM must produce
         the same embeddings as the host-plane run (same verb order, same
