@@ -113,6 +113,15 @@ def dedup_rows(ids: jax.Array, deltas: jax.Array):
     return out_ids, out_deltas
 
 
+def _dense_backend_ok() -> bool:
+    """The dense-run lax.cond is a TPU-only optimization: on the CPU
+    backend XLA fails to alias the donated table through a conditional
+    whose branches read-modify-write it — every call copies the whole
+    table (measured ~300x). TPU aliases it fine (measured: dense rounds
+    9-18 Gelem/s, random unharmed)."""
+    return jax.default_backend() == "tpu"
+
+
 def _dense_run(ids: jax.Array, n_rows: int):
     """Traced detector for the DENSE fast path: the non-trash lanes are a
     PREFIX of the lane vector holding strictly consecutive row ids, and
@@ -139,40 +148,32 @@ def _dense_run(ids: jax.Array, n_rows: int):
     return ok, start, count
 
 
-def gather_rows(data: jax.Array, ids: jax.Array) -> jax.Array:
+def gather_rows(data: jax.Array, ids: jax.Array, *,
+                dense: bool = True) -> jax.Array:
     """rows[i] = data[ids[i]]; all ids must be in range (caller maps
     out-of-shard lanes to the trash row). Trash/pad lanes may return
     ARBITRARY row content — every caller masks or trash-routes them.
 
     Reads ride XLA's native gather (``mode='clip'`` — the jnp default
-    'fill' adds an out-of-bounds select measured 3x slower on v5e) at
-    ~60 GB/s on random 512-byte rows; a runtime-detected dense run
-    (lax.cond) collapses to ONE bulk dynamic_slice at ~300-400 GB/s.
-    ``use_pallas=on`` still forces the Pallas kernel so tests cover
-    it."""
+    'fill' adds an out-of-bounds select measured 3x slower on v5e).
+    ``use_pallas=on`` still forces the Pallas kernel so tests cover it.
+
+    NO dense-run cond here, deliberately: a lax.cond over a LIVE
+    (non-donated) table defeats XLA's buffer aliasing — each branch gets
+    an operand copy of the whole table (measured ~150x on the CPU
+    backend: 512MB copied per Get). The dense bulk-slice fast path lives
+    only in the verbs that consume/donate the table (scatter_set_rows,
+    update_rows, update_gather_rows), where the in-place chain survives
+    the cond. ``dense`` is accepted for signature symmetry."""
+    del dense
     if _forced_on(data):
         from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
         return pallas_gather_rows(data, ids, interpret=_interpret())
-    if ids.shape[0] >= data.shape[0]:
-        # bucket >= shard rows: the dense slice is trace-time ill-formed
-        # (and the run can never fit) — general path only
-        return jnp.take(data, ids, axis=0, mode="clip")
-    ok, start, _ = _dense_run(ids, data.shape[0])
-    bucket = ids.shape[0]
-
-    def dense(_):
-        # prefix layout: slice lane i IS batch lane i (no roll)
-        return jax.lax.dynamic_slice(data, (start, 0),
-                                     (bucket, data.shape[1]))
-
-    def general(_):
-        return jnp.take(data, ids, axis=0, mode="clip")
-
-    return jax.lax.cond(ok, dense, general, None)
+    return jnp.take(data, ids, axis=0, mode="clip")
 
 
 def scatter_set_rows(data: jax.Array, ids: jax.Array,
-                     rows: jax.Array) -> jax.Array:
+                     rows: jax.Array, *, dense: bool = True) -> jax.Array:
     """data[ids[i]] = rows[i]; duplicates only on the trash row.
 
     Writes are the mirror image of reads on TPU: XLA's scatter measured
@@ -195,12 +196,13 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
                                            interpret=_interpret())
         return data.at[ids].set(rows)
 
-    if ids.shape[0] >= data.shape[0]:
-        return general(None)   # see gather_rows static guard
+    if (not dense or not _dense_backend_ok()
+            or ids.shape[0] >= data.shape[0]):
+        return general(None)   # static guards (see gather_rows)
     ok, start, count = _dense_run(ids, data.shape[0])
     bucket = ids.shape[0]
 
-    def dense(_):
+    def dense_fn(_):
         # bulk RMW: pad lanes must keep OLD rows (a blind bucket write
         # would clobber the live rows after the run's end)
         old = jax.lax.dynamic_slice(data, (start, 0),
@@ -209,11 +211,11 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
         return jax.lax.dynamic_update_slice(
             data, jnp.where(keep, rows, old), (start, 0))
 
-    return jax.lax.cond(ok, dense, general, None)
+    return jax.lax.cond(ok, dense_fn, general, None)
 
 
 def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
-                combine) -> jax.Array:
+                combine, *, dense: bool = True) -> jax.Array:
     """data[ids[i]] = combine(data[ids[i]], deltas[i]) — the server-side
     Add for aux-free elementwise updaters. ``combine`` must satisfy
     combine(rows, 0) == rows (see pallas_rows contract) and be
@@ -233,11 +235,11 @@ def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     # ONE implementation with update_gather_rows: the dropped rows output
     # is an intermediate both branches compute anyway (zero extra work)
     return _update_gather_impl(data, ids, deltas, combine,
-                               use_pallas(data))[0]
+                               use_pallas(data), dense)[0]
 
 
 def update_gather_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
-                       combine):
+                       combine, *, dense: bool = True):
     """The fused PS round: data[ids] = combine(data[ids], deltas) AND
     return the post-update rows — ONE row read serves both the update and
     the Get (the reference's test_matrix_perf Add-then-Get-same-rows
@@ -250,14 +252,15 @@ def update_gather_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
                                       interpret=_interpret())
         return new_data, jnp.take(new_data, ids, axis=0, mode="clip")
     return _update_gather_impl(data, ids, deltas, combine,
-                               use_pallas(data))
+                               use_pallas(data), dense)
 
 
-def _update_gather_impl(data, ids, deltas, combine, pallas_write):
+def _update_gather_impl(data, ids, deltas, combine, pallas_write,
+                        allow_dense):
     bucket = ids.shape[0]
     trash = data.shape[0] - 1
 
-    def dense(_):
+    def dense_fn(_):
         sl = jax.lax.dynamic_slice(data, (start, 0), (bucket, data.shape[1]))
         # pad/foreign lanes' deltas are trash-bound — zero them so the
         # bulk path never applies them to live rows; their positions get
@@ -278,7 +281,8 @@ def _update_gather_impl(data, ids, deltas, combine, pallas_write):
             out = data.at[ids].set(new)
         return out, new
 
-    if bucket >= data.shape[0]:
-        return general(None)   # see gather_rows static guard
+    if (not allow_dense or not _dense_backend_ok()
+            or bucket >= data.shape[0]):
+        return general(None)   # static guards (see gather_rows)
     ok, start, _ = _dense_run(ids, data.shape[0])
-    return jax.lax.cond(ok, dense, general, None)
+    return jax.lax.cond(ok, dense_fn, general, None)

@@ -25,11 +25,26 @@ from __future__ import annotations
 import collections
 from typing import Deque, Dict, List
 
+import numpy as np
+
 from multiverso_tpu.actor import Actor, actor_names
 from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_bool, MV_DEFINE_int
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK, Log
+
+
+def _copy_result(result):
+    """Fresh buffers for a deduped Get's extra repliers (callers own and
+    may mutate their result arrays). Non-array results are shared."""
+    if isinstance(result, np.ndarray):
+        return result.copy()
+    if isinstance(result, tuple):
+        return tuple(_copy_result(r) for r in result)
+    if isinstance(result, list):
+        return [_copy_result(r) for r in result]
+    return result
 
 MV_DEFINE_bool("sync", False, "sync or async")
 # Declared-but-dead in the reference (server.cpp:21); kept for flag parity.
@@ -88,7 +103,7 @@ class Server(Actor):
         super().__init__(actor_names.kServer)
         self.store_: List = []  # ServerTable list (reference server.h:24)
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
-        self.RegisterHandler(MsgType.Request_Add, self.ProcessAdd)
+        self.RegisterHandler(MsgType.Request_Add, self._add_entry)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
         # barrier ping: replies once the mailbox drained up to this point —
         # must NOT touch the BSP clocks, unlike FinishTrain (native
@@ -104,30 +119,83 @@ class Server(Actor):
         self.store_.append(server_table)
         return table_id
 
-    #: how many queued messages one Get drains into its pipeline window.
-    #: Each pipelined Get hides one device->host copy RTT; the window stays
-    #: modest so Adds interleaved behind it are not starved for long.
+    #: how many queued messages one Get/Add drains into its window.
+    #: Each pipelined Get hides one device->host copy RTT, queued Adds to
+    #: one table coalesce into one merged dispatch, and identical queued
+    #:  Gets share one gather; the window stays modest so other messages
+    #: are not starved for long.
     GET_PIPELINE_WINDOW = 16
 
     def _get_entry(self, msg: Message) -> None:
-        """Request_Get handler, async engine: RTT pipelining. Drains a
-        window of already-queued messages and runs every Get's dispatch
-        phase (device program + async host copy, ProcessGetAsync) before
-        finalizing any — N queued Gets overlap their device->host copies
-        instead of paying one RTT each. Processing stays in pop order
-        (Adds apply between dispatches, so a Get queued after an Add still
-        sees it — device dataflow orders them). SyncServer overrides this
-        with its unbatched clocked path: the BSP defer/drain protocol
-        must see messages strictly one at a time."""
+        """Window handler for Request_Get AND Request_Add, async engine.
+
+        Drains a window of already-queued messages, then:
+
+        * ADD COALESCING — all Adds to one table inside the window apply
+          as ONE merged dispatch (table.ProcessAddRun) at the position of
+          the table's FIRST Add. Later Adds of the run thereby land
+          before any Get queued between them — legal under the async
+          contract (a Get may observe MORE progress, never less: every
+          coalesced Add was already enqueued when the Get was). Falls
+          back to per-message ProcessAdd when the table declines the
+          merge (aux updaters, multihost, validation doubts).
+        * GET DEDUP — identical queued Gets (same table, payload,
+          option) share one device gather; extra repliers get copies.
+        * GET PIPELINING — distinct Gets overlap their device->host
+          copies (dispatch all, finalize after), as before.
+
+        SyncServer overrides both entries with its unbatched clocked
+        path: the BSP defer/drain protocol must see messages strictly
+        one at a time."""
         batch = [msg]
         while len(batch) < self.GET_PIPELINE_WINDOW:
             ok, nxt = self.mailbox.TryPop()
             if not ok:
                 break
             batch.append(nxt)
-        pending = []  # (msg, finalize) in pop order
+        from multiverso_tpu.parallel import multihost
+        if multihost.process_count() > 1:
+            # multi-process: table verbs run HOST COLLECTIVES inside the
+            # engine thread; the window's add-coalescing reorders an Add
+            # across a Get, and window boundaries race differently on
+            # each process — reordered collectives deadlock the world.
+            # Strict pop order preserves the cross-process sequence.
+            for m in batch:
+                if m.msg_type is MsgType.Request_Add:
+                    self.ProcessAdd(m)
+                elif m.msg_type is MsgType.Request_Get:
+                    self.ProcessGet(m)
+                else:
+                    self._dispatch(m)
+            return
+        add_runs: Dict[int, list] = {}
+        n_gets = 0
         for m in batch:
-            if m.msg_type is MsgType.Request_Get:
+            if m.msg_type is MsgType.Request_Add:
+                add_runs.setdefault(m.table_id, []).append(m)
+            elif m.msg_type is MsgType.Request_Get:
+                n_gets += 1
+        applied = set()
+        pending = []   # (finalize, [msgs]) in dispatch order
+        seen: Dict[tuple, int] = {}
+        for m in batch:
+            if m.msg_type is MsgType.Request_Add:
+                if m.table_id not in applied:
+                    applied.add(m.table_id)
+                    self._process_add_run(add_runs[m.table_id])
+                    # a Get queued after this Add must not join a gather
+                    # dispatched before it (it would observe LESS progress
+                    # than was enqueued ahead of it) — drop the table's
+                    # dedup entries
+                    seen = {k: v for k, v in seen.items()
+                            if k[0] != m.table_id}
+            elif m.msg_type is MsgType.Request_Get:
+                # key cost (tobytes of the payload arrays) only when the
+                # window could actually contain a duplicate
+                key = self._get_dedup_key(m) if n_gets > 1 else None
+                if key is not None and key in seen:
+                    pending[seen[key]][1].append(m)
+                    continue
                 with monitor_region("SERVER_PROCESS_GET"):
                     try:
                         table = self.store_[m.table_id]
@@ -135,7 +203,9 @@ class Server(Actor):
                         if finalize is None:
                             self.ProcessGet(m)
                         else:
-                            pending.append((m, finalize))
+                            if key is not None:
+                                seen[key] = len(pending)
+                            pending.append((finalize, [m]))
                     except Exception as exc:
                         # failures (bad table id included) reply to THIS
                         # message only — an escape here would abandon every
@@ -144,23 +214,69 @@ class Server(Actor):
                                   exc)
                         m.reply(exc)
             else:
-                # non-Get drained into the window: its normal handler
-                # (Add / barrier / finish) runs in order, with the actor's
-                # standard error routing
+                # other message types drained into the window run their
+                # normal handler in order, with standard error routing
                 self._dispatch(m)
-        for m, finalize in pending:
+        for finalize, msgs in pending:
             try:
-                m.reply(finalize())
+                result = finalize()
             except Exception as exc:
                 Log.Error("table %d Get finalize failed: %r",
-                          m.table_id, exc)
-                m.reply(exc)
+                          msgs[0].table_id, exc)
+                for m in msgs:
+                    m.reply(exc)
+                continue
+            msgs[0].reply(result)
+            for m in msgs[1:]:
+                # each deduped caller owns its result arrays
+                m.reply(_copy_result(result))
+
+    def _process_add_run(self, msgs) -> None:
+        """Apply a table's window-worth of Adds: merged when the table
+        accepts (ProcessAddRun validates BEFORE mutating and returns
+        False to decline), per-message otherwise."""
+        if len(msgs) > 1:
+            try:
+                table = self.store_[msgs[0].table_id]
+                merged = table.ProcessAddRun([m.payload for m in msgs])
+            except Exception as exc:
+                # the run contract: state mutates only after validation,
+                # so a raise here means the whole merged Add failed
+                Log.Error("table %d merged Add failed: %r",
+                          msgs[0].table_id, exc)
+                for m in msgs:
+                    m.reply(exc)
+                return
+            if merged:
+                for m in msgs:
+                    m.reply(None)
+                return
+        for m in msgs:
+            self.ProcessAdd(m)
+
+    @staticmethod
+    def _get_dedup_key(m: Message):
+        """Hashable identity of a Get's request, or None when any payload
+        part can't be keyed (those never dedup)."""
+        parts = [m.table_id]
+        for k in sorted(m.payload):
+            v = m.payload[k]
+            if isinstance(v, np.ndarray):
+                parts.append((k, v.dtype.str, v.shape, v.tobytes()))
+            elif v is None or isinstance(v, (bool, int, float, str, bytes)):
+                parts.append((k, v))
+            elif isinstance(v, (GetOption, AddOption)):
+                parts.append((k, repr(v)))
+            else:
+                return None
+        return tuple(parts)
 
     def ProcessGet(self, msg: Message) -> None:
         with monitor_region("SERVER_PROCESS_GET"):
-            table = self.store_[msg.table_id]
             try:
-                result = table.ProcessGet(**msg.payload)
+                # store_ lookup inside the try: a bad table id must reply
+                # to THIS message, not escape and abandon the window
+                result = self.store_[msg.table_id].ProcessGet(**msg.payload)
             except Exception as exc:
                 # Deliver the failure to THIS request — critical when this
                 # message is a drained cached message processed inside
@@ -172,11 +288,16 @@ class Server(Actor):
                 return
             msg.reply(result)
 
+    def _add_entry(self, msg: Message) -> None:
+        """Request_Add enters the same window as Gets (coalescing — see
+        _get_entry). SyncServer re-binds this to its strict ProcessAdd."""
+        self._get_entry(msg)
+
     def ProcessAdd(self, msg: Message) -> None:
         with monitor_region("SERVER_PROCESS_ADD"):
-            table = self.store_[msg.table_id]
             try:
-                table.ProcessAdd(**msg.payload)
+                # store_ lookup inside the try (see ProcessGet)
+                self.store_[msg.table_id].ProcessAdd(**msg.payload)
             except Exception as exc:
                 Log.Error("table %d ProcessAdd failed: %r", msg.table_id, exc)
                 msg.reply(exc)
@@ -237,6 +358,10 @@ class SyncServer(Server):
         # no pipelining window under BSP: the vector-clock protocol's
         # defer/drain decisions depend on strict one-at-a-time processing
         self.ProcessGet(msg)
+
+    def _add_entry(self, msg: Message) -> None:
+        # no add-coalescing under BSP either (same strictness)
+        self.ProcessAdd(msg)
 
     def ProcessGet(self, msg: Message) -> None:
         worker = msg.src

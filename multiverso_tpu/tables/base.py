@@ -67,6 +67,15 @@ class ServerTable:
         accelerator makes the copy the cost to hide)."""
         return None
 
+    def ProcessAddRun(self, payloads) -> bool:
+        """Engine add-coalescing hook: apply a window's queued Adds to
+        this table as ONE merged dispatch. Return True when handled;
+        False declines (the engine then processes each Add normally —
+        the path that produces precise per-message errors). CONTRACT:
+        validate everything BEFORE mutating state — an exception from
+        this method fails the whole run, with no per-message fallback."""
+        return False
+
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:
         raise NotImplementedError

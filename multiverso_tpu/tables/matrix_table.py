@@ -159,7 +159,8 @@ class MatrixServerTable(ServerTable):
                     # row-shaped aux (momentum smooth, 2-D hist) writes ride
                     # the same coalesced Pallas scatter as data rows — XLA's
                     # scatter measured ~25x slower on TPU (rows.py)
-                    return ops.scatter_set_rows(leaf, safe, new_leaf)
+                    return ops.scatter_set_rows(leaf, safe, new_leaf,
+                                                dense=single)
                 return leaf.at[:, safe].set(new_leaf)
             return jax.tree.map(s, aux, new_aux)
 
@@ -178,20 +179,29 @@ class MatrixServerTable(ServerTable):
         # the trash row is don't-care (never read back: Get masks non-mine
         # lanes to 0, _from_storage strips it).
         fuse = updater.fusable and not jax.tree.leaves(aux)
+        # merged engine Adds (ProcessAddRun) are sound for exactly the
+        # LINEAR aux-free updaters: a window's batches apply as one
+        # duplicate-safe scatter-add of combine_scale * deltas
+        merge_scale = updater.combine_scale
+        self._merge_adds = fuse and merge_scale is not None
         combine = updater.combine  # captured once: identity-stable jit key
 
         def _update_rows_local(local_data, local_aux, ids, deltas, opt):
             _, safe = _local_lanes(ids)
+            # dense=single: the runtime dense-run cond belongs to the
+            # single-shard program only — inside a shard_map body it
+            # defeats donation (whole-table copies; rows.py gather_rows)
             if fuse:
                 return ops.update_rows(local_data, safe, deltas,
-                                       combine), local_aux
-            rows = ops.gather_rows(local_data, safe)
+                                       combine, dense=single), local_aux
+            rows = ops.gather_rows(local_data, safe, dense=single)
             aux_rows = _gather_aux(local_aux, safe)
             new_rows, new_aux_rows = updater.update(rows, aux_rows, deltas,
                                                     opt)
             # Non-mine lanes computed garbage from the trash row — it goes
             # straight back to the trash row, never to live data.
-            data = ops.scatter_set_rows(local_data, safe, new_rows)
+            data = ops.scatter_set_rows(local_data, safe, new_rows,
+                                        dense=single)
             aux = _scatter_aux(local_aux, new_aux_rows, safe)
             return data, aux
 
@@ -218,6 +228,24 @@ class MatrixServerTable(ServerTable):
             return {"data": data, "aux": aux}
 
         self._update_rows = jax.jit(_update_rows, donate_argnums=(0,))
+
+        def _merged_add_rows(state, uniq_ids, deltas, inv, opt):
+            """A window's stacked Add batches as ONE dispatch. The
+            duplicate structure (unique ids + inverse mapping) is
+            computed on the HOST (np.unique — XLA's sort was measured
+            6x slower than numpy's on the CPU backend); the device does
+            ONE segment-sum over the flattened delta payload and the
+            normal fused row update at the UNIQUE bucket size. Sound
+            because linear updaters sum — the combined batch rides the
+            same update path as unmerged adds. Pad lanes (inverse 0
+            pointing at a zero delta, uniq id -1 -> trash) are inert."""
+            flat = deltas.reshape(-1, deltas.shape[-1])
+            combined = jax.ops.segment_sum(
+                flat, inv, num_segments=uniq_ids.shape[0])
+            return _update_rows(state, uniq_ids, combined, opt)
+
+        self._merged_add_rows = jax.jit(_merged_add_rows,
+                                        donate_argnums=(0,))
         # Device plane: the same row-update program, un-jitted, for callers
         # that trace it into a larger computation (a training step or a
         # lax.scan over PS rounds) — on TPU this is how workers that live on
@@ -236,7 +264,7 @@ class MatrixServerTable(ServerTable):
 
         def _gather_rows_local(local_data, local_aux, ids):
             mine, safe = _local_lanes(ids)
-            rows = ops.gather_rows(local_data, safe)
+            rows = ops.gather_rows(local_data, safe, dense=single)
             if has_access:
                 rows = updater.access(rows, _gather_aux(local_aux, safe),
                                       None)
@@ -275,18 +303,20 @@ class MatrixServerTable(ServerTable):
             mine, safe = _local_lanes(ids)
             if fuse:
                 data, rows = ops.update_gather_rows(local_data, safe,
-                                                    deltas, combine)
+                                                    deltas, combine,
+                                                    dense=single)
                 aux = local_aux
             else:
                 # non-fused updaters already computed the post-update rows
                 # — reuse them instead of a second full gather (duplicates
                 # are caller-pre-combined, so per-lane new_rows are exact;
                 # trash lanes are garbage and masked below)
-                rows_in = ops.gather_rows(local_data, safe)
+                rows_in = ops.gather_rows(local_data, safe, dense=single)
                 aux_rows = _gather_aux(local_aux, safe)
                 rows, new_aux_rows = updater.update(rows_in, aux_rows,
                                                     deltas, opt)
-                data = ops.scatter_set_rows(local_data, safe, rows)
+                data = ops.scatter_set_rows(local_data, safe, rows,
+                                            dense=single)
                 aux = _scatter_aux(local_aux, new_aux_rows, safe)
             if has_access:
                 rows = updater.access(rows, _gather_aux(aux, safe), None)
@@ -395,6 +425,67 @@ class MatrixServerTable(ServerTable):
         return uniq.astype(np.int32), combined
 
     # -- server verbs -------------------------------------------------------
+
+    def ProcessAddRun(self, payloads) -> bool:
+        """Engine add-coalescing (base-class contract): merge a window's
+        row-set Adds into ONE device dispatch — concat the batches,
+        pre-combine duplicates ACROSS the merged adds (np.add.at), one
+        jit'd update. Sound exactly when delta application is additive
+        and stateless: aux-free elementwise updaters (default/sgd) with
+        equal option scalars — pre-summing then equals sequential
+        application. Declines multihost jobs (the collective-merge
+        protocol owns those), whole-table adds, aux updaters, unequal
+        options, and anything that fails validation (the per-message
+        path then reports precise errors)."""
+        if multihost.process_count() > 1 or not self._merge_adds:
+            return False
+        ids_list, deltas_list = [], []
+        for p in payloads:
+            row_ids = p.get("row_ids")
+            if row_ids is None:
+                return False
+            ids = np.asarray(row_ids, np.int32).ravel()
+            if (ids.size == 0 or int(ids.min()) < 0
+                    or int(ids.max()) >= self.num_rows):
+                return False
+            values = np.asarray(p.get("values"), self.dtype)
+            if values.size != ids.size * self.num_cols:
+                return False
+            ids_list.append(ids)
+            deltas_list.append(values.reshape(len(ids), self.num_cols))
+        if len({a.shape for a in deltas_list}) != 1:
+            # mixed batch shapes would mint a fresh compile per window
+            # composition — the per-message path is cheaper than that
+            return False
+        # option scalars are irrelevant to linear updaters (default/sgd
+        # ignore them), so runs merge regardless of per-message options.
+        # The batch count quantizes to a power of two and the unique-id
+        # count to the bucket ladder, so the jit cache holds a bounded
+        # shape set however the engine's windows race the producers.
+        n, k = len(ids_list), ids_list[0].size
+        nb = 1 << (n - 1).bit_length()
+        ids = np.full((nb, k), -1, np.int32)
+        deltas = np.zeros((nb, k, self.num_cols), self.dtype)
+        for i, (a, d) in enumerate(zip(ids_list, deltas_list)):
+            ids[i] = a
+            deltas[i] = d
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        # POWER-OF-TWO bucket (coarser than the ladder): the unique count
+        # varies continuously with window overlap, and every distinct
+        # bucket is a compile of this table's merged program — pow2 caps
+        # the shape set at log2(window) sizes, all warmable up front
+        bucket = max(8, 1 << (len(uniq) - 1).bit_length())
+        uniq_p = np.full(bucket, -1, np.int32)
+        uniq_p[: len(uniq)] = uniq
+        self.state = self._merged_add_rows(
+            self.state, jnp.asarray(uniq_p), jnp.asarray(deltas),
+            jnp.asarray(inv.astype(np.int32)), AddOption().as_jnp())
+        # subclass bookkeeping fires per payload in message order, exactly
+        # like the per-message path (SparseMatrixTable's freshness bits
+        # must see every add's id set + worker attribution)
+        for p, a in zip(payloads, ids_list):
+            self._note_add_parts(p.get("option") or AddOption(), [a])
+        return True
 
     def _note_add_parts(self, option: AddOption, parts) -> None:
         """Hook: every rank's id set (None = whole table) of the applied

@@ -84,6 +84,11 @@ class Updater:
     #: replaced by the inherited '+=' combine on the row path; opt in by
     #: setting True AND overriding ``combine`` to match ``update``.
     fusable = False
+    #: when the rule is LINEAR — update(data, delta) == data +
+    #: combine_scale * delta — merged engine Adds may apply a window's
+    #: concatenated batches as one duplicate-safe scatter-add
+    #: (matrix_table.ProcessAddRun). None = not linear, never merge.
+    combine_scale = None
 
     def init_aux(self, shape, dtype, num_workers: int) -> Dict[str, Any]:
         """Aux state pytree. Leaves shaped like data are shared state;
@@ -108,6 +113,7 @@ class Updater:
 class AddUpdater(Updater):
     name = "default"
     fusable = True  # combine (inherited '+=') IS update
+    combine_scale = 1.0
 
 
 class SGDUpdater(Updater):
@@ -116,6 +122,7 @@ class SGDUpdater(Updater):
 
     name = "sgd"
     fusable = True
+    combine_scale = -1.0
 
     def combine(self, rows, deltas):
         return rows - deltas
