@@ -56,6 +56,10 @@ class Configure:
     # loss stay float32 (mixed precision), so training trajectories track
     # the float32 ones to bf16 rounding.
     compute_type: str = "float32"    # float32 / bfloat16
+    # TPU-native extension 2: wire compression of the sparse PS table's
+    # row pushes ("sparse" = exact index/value pairs, "1bit" = sign bits
+    # + error feedback; tables/base.py TableOption.compress). "" = off.
+    compress: str = ""
 
     @classmethod
     def from_file(cls, config_file: str) -> "Configure":

@@ -208,7 +208,7 @@ class PSModel(Model):
         elif config.sparse:
             self.table = mv.MV_CreateTable(MatrixTableOption(
                 num_rows=config.input_size, num_cols=config.output_size,
-                updater_type="sgd"))
+                updater_type="sgd", compress=config.compress or None))
         else:
             self.table = mv.MV_CreateTable(ArrayTableOption(
                 size=config.input_size * config.output_size,

@@ -44,6 +44,17 @@ class TableOption:
     """Base table creation record (reference CreateTableOption structs)."""
 
     dtype: Any = np.float32
+    #: opt-in wire compression for row Adds across the host<->device
+    #: boundary: "sparse" (exact — (index, value) pairs when >half the
+    #: payload is zero, dense fallback otherwise; reference
+    #: quantization_util.h:95-137) or "1bit" (lossy — sign bits + two
+    #: means with per-row error feedback). Decompression happens in the
+    #: jit'd consumer ON DEVICE, so the saved bytes are real transfer
+    #: bytes. None = off. Tables that don't implement a compressed wire
+    #: leave _supports_compress False — CreateTable rejects the request
+    #: loudly instead of silently shipping dense.
+    compress: Any = None
+    _supports_compress = False
 
 
 class ServerTable:
@@ -167,6 +178,9 @@ def CreateTable(option: TableOption):
     (reference table_factory.h:16-27 + MV_CreateTable barrier semantics are
     in api.MV_CreateTable)."""
     from multiverso_tpu.zoo import Zoo
+    CHECK(option.compress is None or option._supports_compress,
+          f"table type {type(option).__name__} has no compressed wire "
+          f"(compress={option.compress!r})")
     zoo = Zoo.Get()
     server_table = option.make_server(zoo)
     table_id = zoo.RegisterServerTable(server_table)

@@ -70,6 +70,21 @@ def _pad_row_batch(ids: jax.Array, deltas: jax.Array, bucket: int):
     return ids, deltas
 
 
+def _combine_duplicate_rows(ids: np.ndarray, deltas: np.ndarray,
+                            num_cols: int, dtype):
+    """Host pre-combine of duplicate row ids by SUM (scatter order on
+    duplicates is undefined — module docstring). One np.unique pass
+    serves both the dup check and the inverse mapping."""
+    ids = np.asarray(ids, np.int32).ravel()
+    deltas = np.asarray(deltas, dtype).reshape(len(ids), num_cols)
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    if len(uniq) == len(ids):
+        return ids, deltas
+    combined = np.zeros((len(uniq), num_cols), dtype)
+    np.add.at(combined, inverse, deltas)
+    return uniq.astype(np.int32), combined
+
+
 @functools.partial(jax.jit, static_argnames=("bucket",))
 def _pad_id_batch(ids: jax.Array, bucket: int):
     pad = bucket - ids.shape[0]
@@ -80,22 +95,32 @@ def _pad_id_batch(ids: jax.Array, bucket: int):
 class MatrixTableOption(TableOption):
     num_rows: int = 0
     num_cols: int = 0
+    _supports_compress = True
     updater_type: Optional[str] = None
     initializer: Optional[Callable[[Tuple[int, int]], np.ndarray]] = None
 
     def make_server(self, zoo):
         return MatrixServerTable(self.num_rows, self.num_cols, self.dtype, zoo,
-                                 self.updater_type, self.initializer)
+                                 self.updater_type, self.initializer,
+                                 compress=self.compress)
 
     def make_worker(self, zoo):
-        return MatrixWorkerTable(self.num_rows, self.num_cols, self.dtype)
+        return MatrixWorkerTable(self.num_rows, self.num_cols, self.dtype,
+                                 compress=self.compress)
 
 
 class MatrixServerTable(ServerTable):
     def __init__(self, num_rows: int, num_cols: int, dtype, zoo,
                  updater_type: Optional[str] = None,
-                 initializer: Optional[Callable] = None):
+                 initializer: Optional[Callable] = None,
+                 compress: Optional[str] = None):
         CHECK(num_rows > 0 and num_cols > 0, "matrix dims must be positive")
+        CHECK(compress in (None, "sparse", "1bit"),
+              f"unknown compress mode {compress!r}")
+        self.compress = compress
+        #: wire accounting for compressed Adds: what the payload would
+        #: have cost dense vs what actually crossed host->device
+        self.wire_stats = {"dense_bytes": 0, "payload_bytes": 0}
         self.num_rows = num_rows
         self.num_cols = num_cols
         self.dtype = np.dtype(dtype)
@@ -246,6 +271,37 @@ class MatrixServerTable(ServerTable):
 
         self._merged_add_rows = jax.jit(_merged_add_rows,
                                         donate_argnums=(0,))
+
+        # -- compressed-wire consumers (compress="sparse"/"1bit") ------------
+        # The worker ships the COMPRESSED payload; these jit'd consumers
+        # reconstruct the dense delta ON DEVICE and run the normal row
+        # update — the dense form never crosses the host<->device link.
+
+        num_cols_c = num_cols
+
+        def _consume_sparse(state, padded_ids, idx, val, opt):
+            # idx addresses the flattened (row_bucket, cols) delta block;
+            # pad lanes carry an out-of-range index (scatter drops OOB)
+            size = padded_ids.shape[0] * num_cols_c
+            dense = jnp.zeros((size,), val.dtype).at[idx].set(val)
+            return _update_rows(state, padded_ids,
+                                dense.reshape(padded_ids.shape[0],
+                                              num_cols_c), opt)
+
+        self._consume_sparse = jax.jit(_consume_sparse, donate_argnums=(0,))
+
+        def _consume_1bit(state, padded_ids, packed, pos_means, neg_means,
+                          opt):
+            shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+            bits = ((packed[:, None] >> shifts) & 1).astype(jnp.bool_)
+            lanes = bits.reshape(-1)[: padded_ids.shape[0] * num_cols_c]
+            lanes = lanes.reshape(padded_ids.shape[0], num_cols_c)
+            deltas = jnp.where(lanes, pos_means[:, None],
+                               neg_means[:, None]).astype(
+                state["data"].dtype)
+            return _update_rows(state, padded_ids, deltas, opt)
+
+        self._consume_1bit = jax.jit(_consume_1bit, donate_argnums=(0,))
         # Device plane: the same row-update program, un-jitted, for callers
         # that trace it into a larger computation (a training step or a
         # lax.scan over PS rounds) — on TPU this is how workers that live on
@@ -417,12 +473,8 @@ class MatrixServerTable(ServerTable):
 
     def _combine_duplicates(self, ids: np.ndarray, deltas: np.ndarray):
         """Pre-combine duplicate row ids (see module docstring)."""
-        uniq, inverse = np.unique(ids, return_inverse=True)
-        if len(uniq) == len(ids):
-            return ids, deltas
-        combined = np.zeros((len(uniq), deltas.shape[1]), deltas.dtype)
-        np.add.at(combined, inverse, deltas)
-        return uniq.astype(np.int32), combined
+        return _combine_duplicate_rows(ids, deltas, deltas.shape[1],
+                                       deltas.dtype)
 
     # -- server verbs -------------------------------------------------------
 
@@ -442,7 +494,7 @@ class MatrixServerTable(ServerTable):
         ids_list, deltas_list = [], []
         for p in payloads:
             row_ids = p.get("row_ids")
-            if row_ids is None:
+            if row_ids is None or p.get("compressed") is not None:
                 return False
             ids = np.asarray(row_ids, np.int32).ravel()
             if (ids.size == 0 or int(ids.min()) < 0
@@ -487,14 +539,72 @@ class MatrixServerTable(ServerTable):
             self._note_add_parts(p.get("option") or AddOption(), [a])
         return True
 
+    def _process_add_compressed(self, comp: dict, option: AddOption) -> None:
+        """Apply a worker-compressed Add: the payload stays compressed
+        until it is ON DEVICE (the jit'd consumers reconstruct + update
+        in one program). Multihost falls back to host decompression —
+        the collective-merge protocol owns that path."""
+        from multiverso_tpu.utils.quantization import SparseFilter
+        ids = np.asarray(comp["row_ids"], np.int32).ravel()
+        self._check_ids(ids)
+        kind = comp["kind"]
+        if multihost.process_count() > 1:
+            if kind == "sparse":
+                deltas = SparseFilter().decompress(
+                    True, comp["idx"], comp["val"],
+                    len(ids) * self.num_cols,
+                    self.dtype).reshape(len(ids), self.num_cols)
+            else:
+                lanes = np.unpackbits(comp["packed"])[: len(ids)
+                                                      * self.num_cols]
+                lanes = lanes.astype(bool).reshape(len(ids), self.num_cols)
+                deltas = np.where(lanes, comp["pos"][:, None],
+                                  comp["neg"][:, None]).astype(self.dtype)
+            return self.ProcessAdd(deltas, option, row_ids=ids)
+        padded = self._pad_ids(ids)
+        dense_bytes = ids.size * self.num_cols * self.dtype.itemsize
+        if kind == "sparse":
+            idx = np.asarray(comp["idx"], np.int32)
+            val = np.asarray(comp["val"], self.dtype)
+            nb = next_bucket(max(len(idx), 1))
+            # pad index = out-of-range: the device scatter DROPS it
+            idx_p = np.full(nb, len(padded) * self.num_cols, np.int32)
+            idx_p[: len(idx)] = idx
+            val_p = np.zeros(nb, self.dtype)
+            val_p[: len(val)] = val
+            self.state = self._consume_sparse(
+                self.state, jnp.asarray(padded), jnp.asarray(idx_p),
+                jnp.asarray(val_p), option.as_jnp())
+            self.wire_stats["payload_bytes"] += idx_p.nbytes + val_p.nbytes
+        else:
+            packed = np.asarray(comp["packed"], np.uint8)
+            CHECK(packed.size * 8 >= len(padded) * self.num_cols,
+                  "1bit payload shorter than the padded lane count")
+            pos = np.zeros(len(padded), np.float32)
+            pos[: len(ids)] = comp["pos"]
+            neg = np.zeros(len(padded), np.float32)
+            neg[: len(ids)] = comp["neg"]
+            self.state = self._consume_1bit(
+                self.state, jnp.asarray(padded), jnp.asarray(packed),
+                jnp.asarray(pos), jnp.asarray(neg), option.as_jnp())
+            self.wire_stats["payload_bytes"] += (packed.nbytes
+                                                 + pos.nbytes + neg.nbytes)
+        self.wire_stats["dense_bytes"] += dense_bytes
+        self._note_add_parts(option, [ids])
+
     def _note_add_parts(self, option: AddOption, parts) -> None:
         """Hook: every rank's id set (None = whole table) of the applied
         collective Add, in rank order — fires AFTER the data update so a
         rejected add cannot desynchronize subclass bookkeeping.
         SparseMatrixTable overrides this for its freshness bits."""
 
-    def ProcessAdd(self, values: np.ndarray, option: AddOption,
-                   row_ids: Optional[np.ndarray] = None) -> None:
+    def ProcessAdd(self, values: Optional[np.ndarray] = None,
+                   option: AddOption = None,
+                   row_ids: Optional[np.ndarray] = None,
+                   compressed: Optional[dict] = None) -> None:
+        if compressed is not None:
+            return self._process_add_compressed(compressed,
+                                                option or AddOption())
         if row_ids is None:
             values = np.asarray(values, self.dtype).reshape(self.num_rows,
                                                             self.num_cols)
@@ -721,11 +831,51 @@ class MatrixServerTable(ServerTable):
 class MatrixWorkerTable(WorkerTable):
     """Worker half (reference matrix_table.h:26-77)."""
 
-    def __init__(self, num_rows: int, num_cols: int, dtype=np.float32):
+    def __init__(self, num_rows: int, num_cols: int, dtype=np.float32,
+                 compress: Optional[str] = None):
         super().__init__()
         self.num_rows = num_rows
         self.num_cols = num_cols
         self.dtype = np.dtype(dtype)
+        self._compress = compress
+        self._onebit = None
+        if compress == "1bit":
+            import threading
+            from multiverso_tpu.utils.quantization import RowOneBitsFilter
+            self._onebit = RowOneBitsFilter(num_rows, num_cols)
+            self._onebit_lock = threading.Lock()
+
+    def _compressed_payload(self, ids: np.ndarray,
+                            deltas: np.ndarray) -> Optional[dict]:
+        """Compress a row-set delta batch for the wire, or None when the
+        dense payload wins (sparse filter's >50%-zeros rule) / the mode
+        is off. Duplicate ids pre-combine here — compression and the
+        1-bit residual are per unique row."""
+        if self._compress is None:
+            return None
+        ids = np.asarray(ids, np.int32).ravel()
+        if (ids.size == 0 or int(ids.min()) < 0
+                or int(ids.max()) >= self.num_rows):
+            # invalid ids take the DENSE path: the server's _check_ids
+            # produces the proper caller-side error with NO side effects
+            # (compressing first would corrupt the 1bit residual)
+            return None
+        deltas = np.asarray(deltas, self.dtype).reshape(len(ids),
+                                                        self.num_cols)
+        ids, deltas = _combine_duplicate_rows(ids, deltas, self.num_cols,
+                                              self.dtype)
+        if self._compress == "sparse":
+            from multiverso_tpu.utils.quantization import SparseFilter
+            is_sparse, idx, val = SparseFilter().compress(deltas)
+            if not is_sparse:
+                return None   # dense fallback: the normal payload
+            return {"kind": "sparse", "row_ids": ids,
+                    "idx": idx, "val": val.astype(self.dtype)}
+        with self._onebit_lock:
+            packed, pos, neg = self._onebit.compress(
+                ids, deltas, next_bucket(len(ids)))
+        return {"kind": "1bit", "row_ids": ids, "packed": packed,
+                "pos": pos, "neg": neg}
 
     # -- sync verbs ---------------------------------------------------------
 
@@ -746,6 +896,10 @@ class MatrixWorkerTable(WorkerTable):
     def AddRows(self, row_ids, deltas: np.ndarray,
                 option: Optional[AddOption] = None) -> None:
         ids = np.asarray(row_ids, np.int32)
+        comp = self._compressed_payload(ids, deltas)
+        if comp is not None:
+            self.Wait(self.AddAsync({"compressed": comp}, option))
+            return
         self.Wait(self.AddAsync(
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)}, option))
 
@@ -757,12 +911,21 @@ class MatrixWorkerTable(WorkerTable):
 
     def AddAsyncHandle(self, deltas, row_ids=None, option=None) -> int:
         ids = None if row_ids is None else np.asarray(row_ids, np.int32)
+        if ids is not None:
+            comp = self._compressed_payload(ids, deltas)
+            if comp is not None:
+                return self.AddAsync({"compressed": comp}, option)
         return self.AddAsync(
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)}, option)
 
     def AddFireForget(self, deltas, row_ids=None, option=None) -> None:
         """Untracked async push (no Waiter/result bookkeeping)."""
         ids = None if row_ids is None else np.asarray(row_ids, np.int32)
+        if ids is not None:
+            comp = self._compressed_payload(ids, deltas)
+            if comp is not None:
+                self.AddAsync({"compressed": comp}, option, track=False)
+                return
         self.AddAsync(
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)},
             option, track=False)

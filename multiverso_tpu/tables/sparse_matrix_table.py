@@ -49,17 +49,19 @@ class SparseMatrixTableOption(MatrixTableOption):
     def make_server(self, zoo):
         return SparseMatrixServerTable(self.num_rows, self.num_cols,
                                        self.dtype, zoo, self.updater_type,
-                                       self.initializer)
+                                       self.initializer,
+                                       compress=self.compress)
 
     def make_worker(self, zoo):
-        return SparseMatrixWorkerTable(self.num_rows, self.num_cols, self.dtype)
+        return SparseMatrixWorkerTable(self.num_rows, self.num_cols,
+                                       self.dtype, compress=self.compress)
 
 
 class SparseMatrixServerTable(MatrixServerTable):
     def __init__(self, num_rows, num_cols, dtype, zoo, updater_type=None,
-                 initializer=None):
+                 initializer=None, compress=None):
         super().__init__(num_rows, num_cols, dtype, zoo, updater_type,
-                         initializer)
+                         initializer, compress=compress)
         from multiverso_tpu.parallel import multihost
         self._procs = max(1, multihost.process_count())
         self._rank = multihost.process_index() if self._procs > 1 else 0

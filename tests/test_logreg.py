@@ -190,6 +190,41 @@ class TestPSModes:
         lr.close()
         assert acc > 0.85
 
+    def test_ps_sparse_compressed_identical_loss(self, sparse_binary):
+        """compress="sparse" on the PS table is EXACT (index/value pairs
+        or the dense fallback, both lossless): the training run must be
+        bit-for-bit the run without compression. LR's row pushes are
+        dense WITHIN the touched rows (the row protocol is already
+        sparsity-aware), so the >50%-zeros rule correctly falls back —
+        the filter engages on workloads with intra-row zeros
+        (TestWireCompression asserts the byte reduction there)."""
+        results = {}
+        for mode in ("", "sparse"):
+            cfg = _config(sparse_binary, input_size=50, output_size=1,
+                          use_ps=True, sparse=True,
+                          objective_type="sigmoid", updater_type="sgd",
+                          learning_rate=0.5, train_epoch=5, compress=mode)
+            lr = LogReg(cfg)
+            loss = lr.Train()
+            acc = lr.Test()
+            lr.close()
+            results[mode] = (loss, acc)
+        assert results["sparse"][0] == results[""][0], results
+        assert results["sparse"][1] == results[""][1] > 0.85, results
+
+    def test_ps_sparse_1bit_trains(self, sparse_binary):
+        """compress="1bit" is lossy; error feedback must still take the
+        model to a usable accuracy."""
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      use_ps=True, sparse=True, objective_type="sigmoid",
+                      updater_type="sgd", learning_rate=0.5, train_epoch=8,
+                      compress="1bit")
+        lr = LogReg(cfg)
+        lr.Train()
+        acc = lr.Test()
+        lr.close()
+        assert acc > 0.8, acc
+
     def test_ps_ftrl(self, sparse_binary):
         cfg = _config(sparse_binary, input_size=50, output_size=1,
                       use_ps=True, objective_type="ftrl", alpha=1.0,
