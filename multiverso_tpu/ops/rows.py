@@ -32,6 +32,13 @@ MV_DEFINE_string("matrix_pad_cols", "auto",
                  "pad matrix storage cols to the 128-lane tile: auto/on/off")
 
 LANE = 128
+#: Pallas row kernels take the id vector as a SCALAR-PREFETCH operand in
+#: SMEM (1MB/core on v5e): a 262144-id batch (exactly 1MB of i32) OOM'd
+#: SMEM by its 1.1KB of spill slots. Id vectors above this BYTE budget
+#: (half of SMEM — headroom for spills/other scalars) route to the XLA
+#: path; matrix_table's merge cap uses the same constant so merged
+#: windows never outgrow the fast path they were built for.
+SMEM_IDS_BYTES = 512 * 1024
 
 
 def _pallas_eligible(data) -> bool:
@@ -46,7 +53,9 @@ def _pallas_eligible(data) -> bool:
             and _chunk_for(data.shape[-1], data.dtype.itemsize) > 0)
 
 
-def use_pallas(data=None) -> bool:
+def use_pallas(data=None, ids=None) -> bool:
+    if ids is not None and ids.shape[0] * 4 > SMEM_IDS_BYTES:
+        return False   # id vector would overflow the SMEM prefetch
     mode = str(GetFlag("use_pallas")).lower()
     if mode == "on":
         # forced on (interpreter mode off-TPU; tests): still respect the
@@ -77,9 +86,11 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _forced_on(data) -> bool:
+def _forced_on(data, ids=None) -> bool:
     """``use_pallas=on`` (test mode): force the Pallas kernel for verbs
     whose default path is XLA, so tests keep covering the kernels."""
+    if ids is not None and ids.shape[0] * 4 > SMEM_IDS_BYTES:
+        return False
     return (str(GetFlag("use_pallas")).lower() == "on"
             and _pallas_eligible(data))
 
@@ -166,7 +177,7 @@ def gather_rows(data: jax.Array, ids: jax.Array, *,
     update_rows, update_gather_rows), where the in-place chain survives
     the cond. ``dense`` is accepted for signature symmetry."""
     del dense
-    if _forced_on(data):
+    if _forced_on(data, ids):
         from multiverso_tpu.ops.pallas_rows import pallas_gather_rows
         return pallas_gather_rows(data, ids, interpret=_interpret())
     return jnp.take(data, ids, axis=0, mode="clip")
@@ -182,12 +193,12 @@ def scatter_set_rows(data: jax.Array, ids: jax.Array,
     on coalesced contiguous runs — so writes keep the Pallas path
     wherever it is eligible. A runtime-detected dense run takes the bulk
     slice-merge-update path (~300 GB/s r+w) instead."""
-    if _forced_on(data):
+    if _forced_on(data, ids):
         # test mode: keep the Pallas kernel covered even for dense runs
         from multiverso_tpu.ops.pallas_rows import pallas_scatter_set_rows
         return pallas_scatter_set_rows(data, ids, rows,
                                        interpret=_interpret())
-    fallback_pallas = use_pallas(data)
+    fallback_pallas = use_pallas(data, ids)
 
     def general(_):
         if fallback_pallas:
@@ -228,14 +239,14 @@ def update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     (~290 GB/s r+w measured v5e — the 64-row chunk DMAs can't touch bulk
     copies). ``use_pallas=on`` forces the fused single-kernel RMW so
     tests cover it; the XLA fallback is gather + combine + scatter."""
-    if _forced_on(data):
+    if _forced_on(data, ids):
         from multiverso_tpu.ops.pallas_rows import pallas_update_rows
         return pallas_update_rows(data, ids, deltas, combine,
                                   interpret=_interpret())
     # ONE implementation with update_gather_rows: the dropped rows output
     # is an intermediate both branches compute anyway (zero extra work)
     return _update_gather_impl(data, ids, deltas, combine,
-                               use_pallas(data), dense)[0]
+                               use_pallas(data, ids), dense)[0]
 
 
 def update_gather_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
@@ -246,13 +257,13 @@ def update_gather_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
     round pays two). Returns (new_data, rows); trash/pad lanes of
     ``rows`` are arbitrary (callers mask). Dense runs ride the bulk
     slice path end to end."""
-    if _forced_on(data):
+    if _forced_on(data, ids):
         from multiverso_tpu.ops.pallas_rows import pallas_update_rows
         new_data = pallas_update_rows(data, ids, deltas, combine,
                                       interpret=_interpret())
         return new_data, jnp.take(new_data, ids, axis=0, mode="clip")
     return _update_gather_impl(data, ids, deltas, combine,
-                               use_pallas(data), dense)
+                               use_pallas(data, ids), dense)
 
 
 def _update_gather_impl(data, ids, deltas, combine, pallas_write,

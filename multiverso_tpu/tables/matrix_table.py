@@ -516,6 +516,11 @@ class MatrixServerTable(ServerTable):
         # shape set however the engine's windows race the producers.
         n, k = len(ids_list), ids_list[0].size
         nb = 1 << (n - 1).bit_length()
+        if nb * k * 4 > ops.rows.SMEM_IDS_BYTES:
+            # the merged id vector must fit the Pallas SMEM prefetch
+            # budget (shared constant, ops/rows.py) — huge windows
+            # process per-message so they keep the row-DMA fast path
+            return False
         ids = np.full((nb, k), -1, np.int32)
         deltas = np.zeros((nb, k, self.num_cols), self.dtype)
         for i, (a, d) in enumerate(zip(ids_list, deltas_list)):
