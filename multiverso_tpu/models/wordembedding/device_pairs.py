@@ -51,11 +51,15 @@ from multiverso_tpu.utils.log import CHECK
 
 
 class _LazyStats:
-    """One element of a shared (2,) device stats array; float()/int()
-    fetch the WHOLE array once (cached on the array handle by jax), so a
-    block's loss+pairs harvest costs one transfer. Lane 1 (the pair
-    count) is an int32 BITCAST into the f32 array — a float-rounded
-    count would drift above 2^24 pairs (a 100MB reference-scale block
+    """One element of a shared (2,) INT32 device stats array;
+    float()/int() fetch the WHOLE array once (cached on the array handle
+    by jax), so a block's loss+pairs harvest costs one transfer. The
+    array is integer-typed with the f32 loss BITCAST into lane 0: the
+    reverse packing (count bitcast into an f32 lane) shipped the count
+    as a DENORMAL float, which the TPU flushes to zero in flight —
+    silently zeroing every block's pair count (the avg-loss display
+    became the raw sum). Integer lanes are never flushed, and an int32
+    count stays exact past 2^24 pairs (a 100MB reference-scale block
     holds ~75M)."""
 
     __slots__ = ("_arr", "_i", "_bits")
@@ -67,7 +71,7 @@ class _LazyStats:
 
     def _value(self):
         lane = np.asarray(self._arr)[self._i: self._i + 1]
-        return lane.view(np.int32)[0] if self._bits else lane[0]
+        return lane.view(np.float32)[0] if self._bits else lane[0]
 
     def __float__(self):
         return float(self._value())
@@ -290,12 +294,15 @@ class DevicePairsTrainer:
             state, losses = lax.scan(body, state, stacked)
             out = ((state.ie, state.eo, state.ie_g2, state.eo_g2)
                    if use_adagrad else (state.ie, state.eo))
-            # ONE (2,) stats array: the caller's lazy harvest pays a
-            # single host fetch per block instead of two tunnel RTTs.
-            # The int32 pair count rides as raw BITS (see _LazyStats).
-            count_bits = lax.bitcast_convert_type(
-                jnp.sum(pmask).astype(jnp.int32), jnp.float32)
-            stats = jnp.stack([jnp.sum(losses), count_bits])
+            # ONE (2,) INT32 stats array: the caller's lazy harvest pays
+            # a single host fetch per block instead of two tunnel RTTs.
+            # The f32 loss rides as raw BITS in lane 0 (see _LazyStats —
+            # an f32-typed array would flush the bitcast count lane as a
+            # denormal on TPU).
+            loss_bits = lax.bitcast_convert_type(
+                jnp.sum(losses).astype(jnp.float32), jnp.int32)
+            stats = jnp.stack([loss_bits,
+                               jnp.sum(pmask).astype(jnp.int32)])
             return out, stats
 
         import jax as _jax
@@ -330,6 +337,6 @@ class DevicePairsTrainer:
             self._take_states(), self._slots, jnp.asarray(ids),
             jnp.asarray(sent), key, jnp.float32(lr))
         self._put_states(states)
-        # stats is a (2,) device array; one np.asarray in the harvest
-        # fetches both scalars (lane 1 is the bitcast int32 pair count)
-        return _LazyStats(stats, 0), _LazyStats(stats, 1, bits=True)
+        # stats is a (2,) int32 device array; one np.asarray in the
+        # harvest fetches both scalars (lane 0 is the bitcast f32 loss)
+        return _LazyStats(stats, 0, bits=True), _LazyStats(stats, 1)

@@ -263,3 +263,55 @@ class TestEndToEnd:
                                  "-use_adagrad", "1", "-epoch", "3"])
         assert opt.embedding_size == 64 and opt.cbow and \
             opt.negative_num == 10 and opt.use_adagrad and opt.epoch == 3
+
+
+class TestDevicePairsStats:
+    def test_stats_lanes_exact_and_flush_proof(self):
+        """The block stats ride ONE int32 array: loss as bitcast f32 bits
+        (lane 0), pair count as a plain int32 (lane 1). The count must be
+        exact past 2^24 and must NOT live in a float lane — a bitcast
+        int-in-f32 is a denormal that TPUs flush to zero in flight (the
+        bug this test pins: every block's pair count read back 0)."""
+        import jax.numpy as jnp
+        from jax import lax
+        from multiverso_tpu.models.wordembedding.device_pairs import _LazyStats
+        for loss, count in ((123.456, 7), (0.0, 0), (1e-20, 2**24 + 3),
+                            (3.25e6, 75_000_000)):
+            loss_bits = lax.bitcast_convert_type(
+                jnp.float32(loss), jnp.int32)
+            stats = jnp.stack([loss_bits, jnp.int32(count)])
+            assert stats.dtype == jnp.int32   # int lanes are never flushed
+            got_loss = float(_LazyStats(stats, 0, bits=True))
+            got_count = int(_LazyStats(stats, 1))
+            assert got_count == count
+            np.testing.assert_allclose(got_loss, np.float32(loss))
+
+    def test_production_stats_array_is_integer_typed(self, tmp_path):
+        """Exercise the REAL program: the trainer's returned stats must be
+        backed by an int32 array (a float-typed one would flush the count
+        lane to zero on TPU) and round-trip a correct count."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.models.wordembedding.communicator import (
+            Communicator)
+        from multiverso_tpu.models.wordembedding.device_pairs import (
+            DevicePairsTrainer, _LazyStats)
+        import jax.numpy as jnp
+        mv.MV_Init([])
+        try:
+            opt = Option(embedding_size=8, window_size=2, negative_num=2,
+                         device_pairs=True, pair_batch_size=64)
+            comm = Communicator(opt, vocab_size=50)
+            tr = DevicePairsTrainer(opt, comm, counts=[10] * 50)
+            ids = np.arange(40, dtype=np.int32) % 50
+            sent = (np.arange(40, dtype=np.int32) // 8).astype(np.int32)
+            loss, pairs = tr.train_block(ids, sent, 0.01)
+            assert isinstance(loss, _LazyStats) and isinstance(pairs,
+                                                               _LazyStats)
+            assert loss._arr.dtype == jnp.int32, loss._arr.dtype
+            assert loss._arr is pairs._arr       # one shared fetch
+            n = int(pairs)
+            # 5 sentences x 8 tokens, W<=2 windows: a plausible range
+            assert 20 <= n <= 40 * 4, n
+            assert np.isfinite(float(loss)) and float(loss) > 0
+        finally:
+            mv.MV_ShutDown()
