@@ -29,33 +29,37 @@ class LogReg:
             config = Configure.from_file(config)
         config.finalize()
         self.config = config
-        self._owns_mv = False
+        from multiverso_tpu.utils.world import WorldOwner
+        self._world = WorldOwner()
         if config.use_ps:
-            import multiverso_tpu as mv
-            from multiverso_tpu.zoo import Zoo
-            if not Zoo.Get().started:
-                mv.MV_Init([])
-                self._owns_mv = True
-        self.model = Model.Get(config)
-        # per-worker output files in PS mode so concurrent workers don't
-        # clobber each other (reference ps_model.cpp:43-46 appends
-        # -<worker_id>); kept as instance paths — the caller's Configure is
-        # never mutated
-        self.output_model_file = config.output_model_file
-        self.output_file = config.output_file
-        if config.use_ps:
-            import multiverso_tpu as mv
-            wid = mv.MV_WorkerId()
-            if self.output_model_file:
-                self.output_model_file += f"-{wid}"
-            if self.output_file:
-                self.output_file += f"-{wid}"
-        if config.init_model_file and not config.use_ps:
-            self.model.Load(config.init_model_file)
+            self._world.init_if_needed()
+        # exception-safe: model/table construction after MV_Init must not
+        # strand a started Zoo (same obligation as the WE driver)
+        with self._world.guard("logreg.init"):
+            self.model = Model.Get(config)
+            # per-worker output files in PS mode so concurrent workers don't
+            # clobber each other (reference ps_model.cpp:43-46 appends
+            # -<worker_id>); kept as instance paths — the caller's Configure
+            # is never mutated
+            self.output_model_file = config.output_model_file
+            self.output_file = config.output_file
+            if config.use_ps:
+                import multiverso_tpu as mv
+                wid = mv.MV_WorkerId()
+                if self.output_model_file:
+                    self.output_model_file += f"-{wid}"
+                if self.output_file:
+                    self.output_file += f"-{wid}"
+            if config.init_model_file and not config.use_ps:
+                self.model.Load(config.init_model_file)
 
     def Train(self, train_file: Optional[str] = None) -> float:
         """One full training run (config.train_epoch epochs); returns the
         final epoch's average train loss per sample."""
+        with self._world.guard("logreg.Train"):
+            return self._train(train_file)
+
+    def _train(self, train_file: Optional[str] = None) -> float:
         cfg = self.config
         files = train_file or cfg.train_file
         avg_loss = 0.0
@@ -97,6 +101,11 @@ class LogReg:
         if not files:
             Log.Info("[logreg] no test file; skip test")
             return 0.0
+        with self._world.guard("logreg.Test"):
+            return self._test(files)
+
+    def _test(self, files) -> float:
+        cfg = self.config
         correct = 0
         total = 0
         out_lines = []
@@ -138,7 +147,4 @@ class LogReg:
         self.model.Store(path or self.output_model_file)
 
     def close(self) -> None:
-        if self._owns_mv:
-            import multiverso_tpu as mv
-            mv.MV_ShutDown()
-            self._owns_mv = False
+        self._world.close()

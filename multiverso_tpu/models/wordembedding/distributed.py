@@ -37,7 +37,8 @@ class DistributedWordEmbedding:
         self.huffman: Optional[HuffmanEncoder] = None
         self.sampler: Optional[Sampler] = None
         self.comm: Optional[Communicator] = None
-        self._owns_mv = False
+        from multiverso_tpu.utils.world import WorldOwner
+        self._world = WorldOwner()
         self.total_loss = 0.0
         self.total_pairs = 0
 
@@ -64,16 +65,17 @@ class DistributedWordEmbedding:
         if opt.hs:
             self.huffman = HuffmanEncoder()
             self.huffman.BuildFromTermFrequency(counts)
-        from multiverso_tpu.zoo import Zoo
-        if not Zoo.Get().started:
-            mv.MV_Init([])
-            self._owns_mv = True
-        self.comm = Communicator(opt, self.dictionary.Size())
-        self._dp_trainer = None
-        if opt.device_pairs:
-            from multiverso_tpu.models.wordembedding.device_pairs import (
-                DevicePairsTrainer)
-            self._dp_trainer = DevicePairsTrainer(opt, self.comm, counts)
+        self._world.init_if_needed()
+        # exception-safe: anything raising after MV_Init (table creation,
+        # trainer CHECKs) must not strand a started Zoo the caller can
+        # never shut down
+        with self._world.guard("wordembedding.prepare"):
+            self.comm = Communicator(opt, self.dictionary.Size())
+            self._dp_trainer = None
+            if opt.device_pairs:
+                from multiverso_tpu.models.wordembedding.device_pairs import (
+                    DevicePairsTrainer)
+                self._dp_trainer = DevicePairsTrainer(opt, self.comm, counts)
 
     # -- training -----------------------------------------------------------
 
@@ -237,18 +239,22 @@ class DistributedWordEmbedding:
     # -- lifecycle ----------------------------------------------------------
 
     def run(self) -> float:
-        """Full job (reference Run, distributed_wordembedding.cpp:366)."""
+        """Full job (reference Run, distributed_wordembedding.cpp:366).
+
+        Exception-safe end to end: a raise anywhere after MV_Init (training,
+        export) shuts down the world this driver started, so the process /
+        test suite never inherits a stranded Zoo. Success leaves the world
+        up — the caller owns close()."""
         self.prepare()
-        avg_loss = self.train()
-        mv.MV_Barrier()
-        if mv.MV_WorkerId() == 0:
-            self.save_embeddings()
+        with self._world.guard("wordembedding.run"):
+            avg_loss = self.train()
+            mv.MV_Barrier()
+            if mv.MV_WorkerId() == 0:
+                self.save_embeddings()
         return avg_loss
 
     def close(self) -> None:
-        if self._owns_mv:
-            mv.MV_ShutDown()
-            self._owns_mv = False
+        self._world.close()
 
 
 def main(argv=None) -> int:

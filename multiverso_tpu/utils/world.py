@@ -1,0 +1,56 @@
+"""Shared MV-world ownership guard for application drivers.
+
+Any driver that lazily ``MV_Init``'s a world (WordEmbedding, LogReg) owes
+the process the reverse obligation: if anything raises while the driver
+owns a started Zoo, the Zoo must come down WITH the exception — a stranded
+global world poisons every later ``MV_Init`` in the process (the reference
+test fixture tears down unconditionally for the same reason,
+Test/unittests/multiverso_env.h:10-29).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from multiverso_tpu.utils.log import Log
+
+
+class WorldOwner:
+    """Tracks whether this driver started the MV world.
+
+    ``init_if_needed()`` starts a world only when none is up; ``guard()``
+    wraps any risky block so an exception closes an *owned* world (never a
+    caller-owned one) without masking the original error; ``close()`` is
+    idempotent.
+    """
+
+    def __init__(self) -> None:
+        self.owns = False
+
+    def init_if_needed(self, argv=()) -> None:
+        import multiverso_tpu as mv
+        from multiverso_tpu.zoo import Zoo
+        if not Zoo.Get().started:
+            mv.MV_Init(list(argv))
+            self.owns = True
+
+    def close(self) -> None:
+        if self.owns:
+            import multiverso_tpu as mv
+            # drop ownership even when shutdown fails: retrying
+            # MV_ShutDown on a half-torn-down world from a caller's
+            # `finally` would raise again and mask the original error
+            self.owns = False
+            mv.MV_ShutDown()
+
+    @contextlib.contextmanager
+    def guard(self, context: str):
+        try:
+            yield
+        except BaseException:
+            try:
+                self.close()
+            except Exception as exc:
+                Log.Error("[%s] world shutdown after failure itself failed "
+                          "(%r); original error follows", context, exc)
+            raise

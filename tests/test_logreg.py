@@ -262,3 +262,27 @@ regular_coef=0.0007
         lr = LogReg(cfg)
         lr.Train()
         assert lr.Test() > 0.85
+
+
+class TestLifecycle:
+    def test_init_failure_does_not_strand_zoo(self, dense_binary):
+        """A raise during PS-mode construction (after the lazy MV_Init) must
+        bring the owned world down with the exception — a stranded Zoo
+        poisons every later MV_Init in the process (the round-3 suite-order
+        leak class, now guarded by utils.world.WorldOwner)."""
+        from multiverso_tpu.zoo import Zoo
+        # output_size=0 -> the PS ArrayTable gets size 0 and its CHECK
+        # raises inside Model.Get, strictly after the lazy MV_Init
+        cfg = _config(dense_binary, input_size=8, output_size=0,
+                      use_ps=True)
+        with pytest.raises(Exception):
+            LogReg(cfg)
+        assert not Zoo.Get().started
+        # and a fresh PS world must come up cleanly afterwards
+        lr = LogReg(_config(dense_binary, input_size=8, output_size=1,
+                            use_ps=True, train_epoch=1))
+        try:
+            lr.Train()
+        finally:
+            lr.close()
+        assert not Zoo.Get().started

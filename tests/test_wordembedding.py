@@ -286,32 +286,27 @@ class TestDevicePairsStats:
             assert got_count == count
             np.testing.assert_allclose(got_loss, np.float32(loss))
 
-    def test_production_stats_array_is_integer_typed(self, tmp_path):
+    def test_production_stats_array_is_integer_typed(self, mv_env):
         """Exercise the REAL program: the trainer's returned stats must be
         backed by an int32 array (a float-typed one would flush the count
         lane to zero on TPU) and round-trip a correct count."""
-        import multiverso_tpu as mv
         from multiverso_tpu.models.wordembedding.communicator import (
             Communicator)
         from multiverso_tpu.models.wordembedding.device_pairs import (
             DevicePairsTrainer, _LazyStats)
         import jax.numpy as jnp
-        mv.MV_Init([])
-        try:
-            opt = Option(embedding_size=8, window_size=2, negative_num=2,
-                         device_pairs=True, pair_batch_size=64)
-            comm = Communicator(opt, vocab_size=50)
-            tr = DevicePairsTrainer(opt, comm, counts=[10] * 50)
-            ids = np.arange(40, dtype=np.int32) % 50
-            sent = (np.arange(40, dtype=np.int32) // 8).astype(np.int32)
-            loss, pairs = tr.train_block(ids, sent, 0.01)
-            assert isinstance(loss, _LazyStats) and isinstance(pairs,
-                                                               _LazyStats)
-            assert loss._arr.dtype == jnp.int32, loss._arr.dtype
-            assert loss._arr is pairs._arr       # one shared fetch
-            n = int(pairs)
-            # 5 sentences x 8 tokens, W<=2 windows: a plausible range
-            assert 20 <= n <= 40 * 4, n
-            assert np.isfinite(float(loss)) and float(loss) > 0
-        finally:
-            mv.MV_ShutDown()
+        opt = Option(embedding_size=8, window_size=2, negative_num=2,
+                     device_pairs=True, pair_batch_size=64)
+        comm = Communicator(opt, vocab_size=50)
+        tr = DevicePairsTrainer(opt, comm, counts=[10] * 50)
+        ids = np.arange(40, dtype=np.int32) % 50
+        sent = (np.arange(40, dtype=np.int32) // 8).astype(np.int32)
+        loss, pairs = tr.train_block(ids, sent, 0.01)
+        assert isinstance(loss, _LazyStats) and isinstance(pairs,
+                                                           _LazyStats)
+        assert loss._arr.dtype == jnp.int32, loss._arr.dtype
+        assert loss._arr is pairs._arr       # one shared fetch
+        n = int(pairs)
+        # 5 sentences x 8 tokens, W<=2 windows: a plausible range
+        assert 20 <= n <= 40 * 4, n
+        assert np.isfinite(float(loss)) and float(loss) > 0
