@@ -102,6 +102,9 @@ class Server(Actor):
     def __init__(self):
         super().__init__(actor_names.kServer)
         self.store_: List = []  # ServerTable list (reference server.h:24)
+        #: windows split by a non-Get/Add barrier message (observability +
+        #: lets tests assert the barrier path actually engaged)
+        self.window_barrier_splits = 0
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self._add_entry)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
@@ -138,7 +141,10 @@ class Server(Actor):
           contract (a Get may observe MORE progress, never less: every
           coalesced Add was already enqueued when the Get was). Falls
           back to per-message ProcessAdd when the table declines the
-          merge (aux updaters, multihost, validation doubts).
+          merge (aux updaters, multihost, validation doubts). Any
+          OTHER message type (StoreLoad, flag sets, ...) is a window
+          BARRIER: runs split at it, so an Add acknowledged before a
+          Load is never re-applied after the restore.
         * GET DEDUP — identical queued Gets (same table, payload,
           option) share one device gather; extra repliers get copies.
         * GET PIPELINING — distinct Gets overlap their device->host
@@ -168,55 +174,73 @@ class Server(Actor):
                 else:
                     self._dispatch(m)
             return
-        add_runs: Dict[int, list] = {}
-        n_gets = 0
+        # Any non-Get/Add message (e.g. Request_StoreLoad's Load) mutates
+        # table state outside the Add/Get algebra: it BARRIERS the window.
+        # Adds must not coalesce across it (a Load between two Adds would
+        # apply the later Add before the restore and silently wipe it),
+        # and a Get queued after it must not join a gather dispatched
+        # before it.
+        segments: list = [[]]
         for m in batch:
-            if m.msg_type is MsgType.Request_Add:
-                add_runs.setdefault(m.table_id, []).append(m)
-            elif m.msg_type is MsgType.Request_Get:
-                n_gets += 1
-        applied = set()
+            if m.msg_type in (MsgType.Request_Add, MsgType.Request_Get):
+                segments[-1].append(m)
+            else:
+                segments.append(m)       # barrier marker
+                segments.append([])
         pending = []   # (finalize, [msgs]) in dispatch order
         seen: Dict[tuple, int] = {}
-        for m in batch:
-            if m.msg_type is MsgType.Request_Add:
-                if m.table_id not in applied:
-                    applied.add(m.table_id)
-                    self._process_add_run(add_runs[m.table_id])
-                    # a Get queued after this Add must not join a gather
-                    # dispatched before it (it would observe LESS progress
-                    # than was enqueued ahead of it) — drop the table's
-                    # dedup entries
-                    seen = {k: v for k, v in seen.items()
-                            if k[0] != m.table_id}
-            elif m.msg_type is MsgType.Request_Get:
-                # key cost (tobytes of the payload arrays) only when the
-                # window could actually contain a duplicate
-                key = self._get_dedup_key(m) if n_gets > 1 else None
-                if key is not None and key in seen:
-                    pending[seen[key]][1].append(m)
-                    continue
-                with monitor_region("SERVER_PROCESS_GET"):
-                    try:
-                        table = self.store_[m.table_id]
-                        finalize = table.ProcessGetAsync(**m.payload)
-                        if finalize is None:
-                            self.ProcessGet(m)
-                        else:
-                            if key is not None:
-                                seen[key] = len(pending)
-                            pending.append((finalize, [m]))
-                    except Exception as exc:
-                        # failures (bad table id included) reply to THIS
-                        # message only — an escape here would abandon every
-                        # pending finalize and hang their waiters
-                        Log.Error("table ProcessGet dispatch failed: %r",
-                                  exc)
-                        m.reply(exc)
-            else:
-                # other message types drained into the window run their
-                # normal handler in order, with standard error routing
-                self._dispatch(m)
+        for seg in segments:
+            if not isinstance(seg, list):
+                # barrier: runs its normal handler in order, with
+                # standard error routing; no dedup survives it
+                self.window_barrier_splits += 1
+                self._dispatch(seg)
+                seen.clear()
+                continue
+            add_runs: Dict[int, list] = {}
+            n_gets = 0
+            for m in seg:
+                if m.msg_type is MsgType.Request_Add:
+                    add_runs.setdefault(m.table_id, []).append(m)
+                else:
+                    n_gets += 1
+            applied = set()
+            for m in seg:
+                if m.msg_type is MsgType.Request_Add:
+                    if m.table_id not in applied:
+                        applied.add(m.table_id)
+                        self._process_add_run(add_runs[m.table_id])
+                        # a Get queued after this Add must not join a
+                        # gather dispatched before it (it would observe
+                        # LESS progress than was enqueued ahead of it) —
+                        # drop the table's dedup entries
+                        seen = {k: v for k, v in seen.items()
+                                if k[0] != m.table_id}
+                else:
+                    # key cost (tobytes of the payload arrays) only when
+                    # the window could actually contain a duplicate
+                    key = self._get_dedup_key(m) if n_gets > 1 else None
+                    if key is not None and key in seen:
+                        pending[seen[key]][1].append(m)
+                        continue
+                    with monitor_region("SERVER_PROCESS_GET"):
+                        try:
+                            table = self.store_[m.table_id]
+                            finalize = table.ProcessGetAsync(**m.payload)
+                            if finalize is None:
+                                self.ProcessGet(m)
+                            else:
+                                if key is not None:
+                                    seen[key] = len(pending)
+                                pending.append((finalize, [m]))
+                        except Exception as exc:
+                            # failures (bad table id included) reply to
+                            # THIS message only — an escape here would
+                            # abandon every pending finalize and hang
+                            # their waiters
+                            Log.Error("table ProcessGet dispatch failed: "
+                                      "%r", exc)
+                            m.reply(exc)
         for finalize, msgs in pending:
             try:
                 result = finalize()

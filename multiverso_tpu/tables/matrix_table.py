@@ -482,13 +482,15 @@ class MatrixServerTable(ServerTable):
         """Engine add-coalescing (base-class contract): merge a window's
         row-set Adds into ONE device dispatch — concat the batches,
         pre-combine duplicates ACROSS the merged adds (np.add.at), one
-        jit'd update. Sound exactly when delta application is additive
-        and stateless: aux-free elementwise updaters (default/sgd) with
-        equal option scalars — pre-summing then equals sequential
-        application. Declines multihost jobs (the collective-merge
-        protocol owns those), whole-table adds, aux updaters, unequal
-        options, and anything that fails validation (the per-message
-        path then reports precise errors)."""
+        jit'd update. Sound exactly when the updater declares itself
+        LINEAR (``combine_scale is not None``): update(data, delta) ==
+        data + c*delta with c a class constant and AddOption scalars
+        ignored by contract (updaters/base.py combine_scale) — so
+        pre-summing a window equals sequential application whatever
+        per-message options rode along. Declines multihost jobs (the
+        collective-merge protocol owns those), whole-table adds,
+        non-linear/aux updaters, and anything that fails validation
+        (the per-message path then reports precise errors)."""
         if multihost.process_count() > 1 or not self._merge_adds:
             return False
         ids_list, deltas_list = [], []

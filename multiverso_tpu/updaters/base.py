@@ -85,9 +85,13 @@ class Updater:
     #: setting True AND overriding ``combine`` to match ``update``.
     fusable = False
     #: when the rule is LINEAR — update(data, delta) == data +
-    #: combine_scale * delta — merged engine Adds may apply a window's
-    #: concatenated batches as one duplicate-safe scatter-add
-    #: (matrix_table.ProcessAddRun). None = not linear, never merge.
+    #: combine_scale * delta, with combine_scale a CONSTANT of the class —
+    #: merged engine Adds may apply a window's concatenated batches as one
+    #: duplicate-safe scatter-add (matrix_table.ProcessAddRun). Linearity
+    #: is a CONTRACT: the rule must ignore AddOption scalars entirely (the
+    #: merge applies one default option to the whole window; a subclass
+    #: whose update reads opt must leave combine_scale = None).
+    #: None = not linear, never merge.
     combine_scale = None
 
     def init_aux(self, shape, dtype, num_workers: int) -> Dict[str, Any]:
