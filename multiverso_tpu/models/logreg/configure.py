@@ -60,6 +60,17 @@ class Configure:
     # row pushes ("sparse" = exact index/value pairs, "1bit" = sign bits
     # + error feedback; tables/base.py TableOption.compress). "" = off.
     compress: str = ""
+    # TPU-native extension 3: train whole windows as one jit'd program
+    # consuming the PS tables' HBM storage directly (the WE -device_pairs
+    # pattern; models/logreg/device_plane.py). Requires use_ps; dense and
+    # sparse objectives; single-process.
+    device_plane: bool = False
+    # TPU-native extension 4: parse-once epoch cache (data.py WindowCache)
+    # — epoch 2+ replay the identical window sequence from memory instead
+    # of re-parsing the text files; capped at cache_data_mb (larger
+    # datasets stream every epoch, reference-style).
+    cache_data: bool = True
+    cache_data_mb: int = 4096
 
     @classmethod
     def from_file(cls, config_file: str) -> "Configure":

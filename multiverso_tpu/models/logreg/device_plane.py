@@ -1,0 +1,251 @@
+"""On-device window training for the LogisticRegression app
+(``device_plane=true``).
+
+The reference's headline runs train LR through the PS with per-minibatch
+delta pushes and periodic pulls
+(Applications/LogisticRegression/src/model/ps_model.cpp:185-259); the
+host-plane port mirrors that verb order, which costs per-window
+host<->device round trips of the MODEL (dense: the full flat weight
+vector per sync; sparse: the window's row block both ways). On the axon
+tunnel those transfers dominate — the same bottleneck the WordEmbedding
+app hit before ``-device_pairs`` (models/wordembedding/device_pairs.py).
+
+``device_plane=true`` moves a WHOLE WINDOW into one jit'd donated XLA
+program that consumes the PS tables' sharded HBM storage directly:
+
+* dense — the ArrayTable's flat (output-major) storage reshapes to the
+  weight cache in-program; the window's batches scan over it at the
+  window-start weights; the per-batch lr-scaled gradients sum and apply
+  once through the table's own sgd updater (``device_update``). Only
+  the window's SAMPLES (X, labels, weights) are uploaded.
+* sparse — the window's unique keys gather their row block from the
+  MatrixTable storage (``device_gather_rows``), the batches scan over
+  it with host-remapped window-local key indices, and the summed
+  lr-scaled row deltas apply once (``device_update_rows``). Only the
+  sample lanes (keys/values/mask, labels, weights) are uploaded.
+
+Semantics match the host plane (parity-tested): every batch's gradient
+is computed at the window-start weights, and the server rule is linear
+sgd — per-batch pushes sum to the window's one application. Ragged
+final windows pad with zero-lr, zero-weight batches (inert: lr scales
+the delta contribution to zero and the loss metric weights to zero).
+One deliberate refinement: the device plane refreshes its cache at
+EVERY window start (it reads the live table), where the host plane's
+reference-faithful modulo-counter sync (`_batch_count %
+sync_frequency`, ps_model.cpp:172-181) drifts off window boundaries
+after a ragged final window — the device cache is then FRESHER, never
+staler. When epochs' batch counts divide sync_frequency the two paths
+are bit-comparable (the parity tests pin that case).
+
+Loss scalars stay ON DEVICE: ``train_window`` returns a 0-d jax array
+so the driver's accumulation never forces a tunnel round-trip; the
+periodic log line / epoch summary forces one fetch when it formats.
+
+Single-process/single-writer (the device-plane ownership contract, as
+WE); dense + sparse objectives (FTRL keeps the host path — its KV
+state rides host-control verbs by design, SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from multiverso_tpu.parallel.mesh import next_bucket
+from multiverso_tpu.updaters import AddOption
+from multiverso_tpu.utils.log import CHECK
+
+_PROGRAM_CACHE: dict = {}
+
+
+class DeviceWindowTrainer:
+    """Owns the window programs; constructed by PSModel when
+    ``config.device_plane`` is set."""
+
+    def __init__(self, config, model):
+        from multiverso_tpu.parallel import multihost
+        CHECK(not model.ftrl,
+              "device_plane covers dense/sparse LR (ftrl rides the host "
+              "path: KV state is host-control by design)")
+        CHECK(multihost.process_count() <= 1,
+              "device_plane is single-process (device-plane ownership)")
+        self.config = config
+        self.model = model
+        self.table = model.table
+        self._opt = AddOption().as_jnp()
+
+    # -- host-side window staging -------------------------------------------
+
+    def train_window(self, window):
+        """One Window as one donated program dispatch; returns the summed
+        window loss as a DEVICE scalar (fetch-on-format)."""
+        cfg = self.config
+        nb = max(1, cfg.sync_frequency)
+        batches = window.batches
+        # per-batch decayed lr, ticking ONLY real batches (pad batches get
+        # lr 0 -> their whole delta contribution is scaled out)
+        lrs = np.zeros(nb, np.float32)
+        for i in range(len(batches)):
+            lrs[i] = self.model.updater.learning_rate()
+            self.model.updater.tick()
+        self.model._batch_count += len(batches)
+        self.model.compute_count += len(batches)
+        if cfg.sparse:
+            return self._train_sparse(window, nb, lrs)
+        return self._train_dense(window, nb, lrs)
+
+    def _train_dense(self, window, nb: int, lrs: np.ndarray):
+        import jax.numpy as jnp
+        cfg = self.config
+        staged = getattr(window, "_staged_dense", None)
+        if staged is None or staged[0] != nb:
+            B = cfg.minibatch_size
+            cdt = jnp.dtype(cfg.compute_type)
+            X = np.zeros((nb, B, cfg.input_size), cdt)
+            labels = np.zeros((nb, B), np.int32)
+            weights = np.zeros((nb, B), np.float32)
+            for i, b in enumerate(window.batches):
+                X[i] = b.dense
+                labels[i] = b.labels
+                weights[i] = b.weights
+            # DEVICE-staged: with the epoch cache replaying windows, later
+            # epochs skip the host staging AND the upload (lrs re-upload
+            # per call — the decay schedule moves)
+            staged = (nb, jnp.asarray(X), jnp.asarray(labels),
+                      jnp.asarray(weights))
+            window._staged_dense = staged
+        srv = self.table.server()
+        program = self._dense_program(nb)
+        new_state, loss = program(srv.device_state(), staged[1], staged[2],
+                                  staged[3], jnp.asarray(lrs))
+        srv.device_set_state(new_state)
+        loss.copy_to_host_async()   # the lagged epoch log finds it landed
+        return loss
+
+    def _train_sparse(self, window, nb: int, lrs: np.ndarray):
+        import jax.numpy as jnp
+        cfg = self.config
+        B = cfg.minibatch_size
+        keys = window.keys                       # unique, sorted (np.unique)
+        if keys.size == 0:
+            return jnp.float32(0.0)
+        bucket = next_bucket(len(keys))
+        K = max(b.keys.shape[1] for b in window.batches)
+        staged = getattr(window, "_staged_sparse", None)
+        if staged is None or staged[0] != (nb, K, bucket):
+            # window-local remap + K-lane padding on the host (the
+            # reader's batches already pad ragged samples with key 0 /
+            # mask 0; the window-level K extension uses the same
+            # convention so the device program sees exactly the host
+            # path's lane set)
+            bkeys = np.zeros((nb, B, K), np.int32)
+            values = np.zeros((nb, B, K), np.float32)
+            mask = np.zeros((nb, B, K), np.float32)
+            labels = np.zeros((nb, B), np.int32)
+            weights = np.zeros((nb, B), np.float32)
+            for i, b in enumerate(window.batches):
+                kb = b.keys.shape[1]
+                bkeys[i, :, :kb] = np.searchsorted(keys, b.keys)
+                bkeys[i, :, kb:] = np.searchsorted(keys, 0)
+                values[i, :, :kb] = b.values
+                mask[i, :, :kb] = b.mask
+                labels[i] = b.labels
+                weights[i] = b.weights
+            ids = np.full(bucket, -1, np.int32)
+            ids[: len(keys)] = keys.astype(np.int32)
+            staged = ((nb, K, bucket), jnp.asarray(ids), jnp.asarray(bkeys),
+                      jnp.asarray(values), jnp.asarray(mask),
+                      jnp.asarray(labels), jnp.asarray(weights))
+            window._staged_sparse = staged
+        srv = self.table.server()
+        program = self._sparse_program(nb, B, K, bucket)
+        state = dict(srv.state)
+        new_state, loss = program(state, staged[1], staged[2], staged[3],
+                                  staged[4], staged[5], staged[6],
+                                  jnp.asarray(lrs))
+        srv.state = new_state
+        loss.copy_to_host_async()   # the lagged epoch log finds it landed
+        return loss
+
+    # -- the window programs -------------------------------------------------
+
+    def _dense_program(self, nb: int):
+        # structural key (NOT table identity): a fresh world with the same
+        # table geometry reuses the compiled program — the traced closure
+        # bakes in only shapes and updater constants, state rides as an
+        # argument (the device_pairs._PROGRAM_CACHE convention)
+        cfg = self.config
+        srv = self.table.server()
+        key = ("lr_dense", nb, cfg.minibatch_size, cfg.compute_type,
+               cfg.input_size, cfg.output_size, srv.padded,
+               type(srv.updater).__name__, cfg.objective_type,
+               cfg.regular_type, cfg.regular_coef)
+        if key in _PROGRAM_CACHE:
+            return _PROGRAM_CACHE[key]
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        srv = self.table.server()
+        grad_fn = self.model._dense_grad
+        n_in, n_out = cfg.input_size, cfg.output_size
+        opt = self._opt
+
+        def program(state, X, labels, weights, lrs):
+            # the ArrayTable stores the flat OUTPUT-MAJOR weights
+            # (reference key layout); the cache view is (in, out)
+            W = state["data"][: n_in * n_out].reshape(n_out, n_in).T
+
+            def body(acc, x):
+                Xb, lab, wt, lr = x
+                grad, loss = grad_fn(W, Xb, lab, wt)
+                return acc + lr * grad, loss
+
+            delta, losses = lax.scan(
+                body, jnp.zeros((n_in, n_out), jnp.float32),
+                (X, labels, weights, lrs))
+            padded = jnp.zeros_like(state["data"]).at[: n_in * n_out].set(
+                delta.T.reshape(-1))
+            return srv.device_update(state, padded, opt), jnp.sum(losses)
+
+        compiled = jax.jit(program, donate_argnums=(0,))
+        _PROGRAM_CACHE[key] = compiled
+        return compiled
+
+    def _sparse_program(self, nb: int, B: int, K: int, bucket: int):
+        cfg = self.config
+        srv = self.table.server()
+        key = ("lr_sparse", nb, B, K, bucket, cfg.output_size,
+               srv.block_rows, srv.store_cols, srv.num_rows,
+               type(srv.updater).__name__, cfg.objective_type,
+               cfg.regular_type, cfg.regular_coef)
+        if key in _PROGRAM_CACHE:
+            return _PROGRAM_CACHE[key]
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        srv = self.table.server()
+        grad_fn = self.model._sparse_grad
+        n_out = cfg.output_size
+        opt = self._opt
+
+        def program(state, ids, bkeys, values, mask, labels, weights, lrs):
+            W_rows = srv.device_gather_rows(state["data"], state["aux"],
+                                            ids)   # (bucket, out)
+
+            def body(acc, x):
+                k, v, m, lab, wt, lr = x
+                grad, loss = grad_fn(W_rows, k, v, m, lab, wt)
+                return acc + lr * grad, loss
+
+            delta, losses = lax.scan(
+                body, jnp.zeros((bucket, n_out), jnp.float32),
+                (bkeys, values, mask, labels, weights, lrs))
+            return (srv.device_update_rows(state, ids, delta, opt),
+                    jnp.sum(losses))
+
+        compiled = jax.jit(program, donate_argnums=(0,))
+        _PROGRAM_CACHE[key] = compiled
+        return compiled

@@ -10,6 +10,7 @@ throughput logging), ``Test`` scores the test file and writes predictions
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Union
 
@@ -63,8 +64,15 @@ class LogReg:
         cfg = self.config
         files = train_file or cfg.train_file
         avg_loss = 0.0
+        log_threads: list = []
+        cache = None
+        if cfg.cache_data:
+            from multiverso_tpu.models.logreg.data import WindowCache
+            cache = WindowCache(cfg.cache_data_mb)
         for epoch in range(cfg.train_epoch):
-            reader = WindowReader(files, cfg, cfg.sync_frequency)
+            reader = (cache.reader(files, cfg, cfg.sync_frequency)
+                      if cache is not None
+                      else WindowReader(files, cfg, cfg.sync_frequency))
             timer = Timer()
             samples = 0
             loss_sum = 0.0
@@ -83,8 +91,26 @@ class LogReg:
                     next_report += cfg.show_time_per_sample
                     self.model.DisplayTime()
             avg_loss = loss_sum / max(samples, 1)
-            Log.Info("[logreg] epoch %d done: %d samples, avg loss %.5f, "
-                     "%.2fs", epoch, samples, avg_loss, timer.elapse())
+            if cfg.device_plane:
+                # device-plane losses are DEVICE scalars: formatting one
+                # forces a tunnel round-trip that would barrier the
+                # pipeline once per epoch. Emit the epoch line from a
+                # harvest thread instead — the fetch waits on the tunnel
+                # there while the training loop keeps dispatching.
+                t = threading.Thread(
+                    target=Log.Info,
+                    args=("[logreg] epoch %d done: %d samples, avg loss "
+                          "%.5f, %.2fs", epoch, samples, avg_loss,
+                          timer.elapse()),
+                    daemon=True)
+                t.start()
+                log_threads.append(t)
+            else:
+                Log.Info("[logreg] epoch %d done: %d samples, avg loss "
+                         "%.5f, %.2fs", epoch, samples, avg_loss,
+                         timer.elapse())
+        for t in log_threads:
+            t.join()
         if cfg.use_ps:
             import multiverso_tpu as mv
             mv.MV_Barrier()

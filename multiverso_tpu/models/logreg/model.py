@@ -21,6 +21,10 @@ TPU design
   block.
 * FTRL: (z, n) state rows; local mode keeps them on device, PS mode in two
   KVTables keyed ``feature*output_size + o``.
+* device_plane=true (PS modes): whole sync windows train as ONE jit'd
+  donated program consuming the tables' HBM storage directly — see
+  models/logreg/device_plane.py (the on-chip path behind the 7.8x
+  head-to-head, baseline_ref/README.md row 4).
 """
 
 from __future__ import annotations
@@ -215,6 +219,11 @@ class PSModel(Model):
                 updater_type="sgd"))
         self._batch_count = 0
         self._pending_get: Optional[int] = None   # pipelined pull handle
+        self._device_trainer = None
+        if config.device_plane:
+            from multiverso_tpu.models.logreg.device_plane import (
+                DeviceWindowTrainer)
+            self._device_trainer = DeviceWindowTrainer(config, self)
         if config.init_model_file:
             self.Load(config.init_model_file)
             self._push_initial_model()
@@ -252,6 +261,9 @@ class PSModel(Model):
             self.table.Add(flat)
 
     def train_window(self, window: Window) -> float:
+        if self._device_trainer is not None:
+            # whole window in HBM; returns a DEVICE loss scalar
+            return self._device_trainer.train_window(window)
         if self.ftrl:
             return self._train_window_ftrl(window)
         if self.config.sparse:

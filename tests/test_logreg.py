@@ -286,3 +286,119 @@ class TestLifecycle:
         finally:
             lr.close()
         assert not Zoo.Get().started
+
+
+class TestDevicePlane:
+    """device_plane=true: whole windows train as one jit'd program over
+    the PS tables' HBM storage; must match the host plane exactly (same
+    verb order — window-start cache, summed linear deltas)."""
+
+    def _final_weights(self, d, **kw):
+        kw.setdefault("objective_type", "sigmoid")
+        cfg = _config(d, use_ps=True, updater_type="sgd",
+                      learning_rate=0.5, train_epoch=4, pipeline=False,
+                      **kw)
+        lr = LogReg(cfg)
+        try:
+            lr.Train()
+            return lr.model.weights().copy(), lr.Test()
+        finally:
+            lr.close()
+
+    def test_dense_matches_host_plane(self, dense_binary):
+        # sync_frequency divides the 25 batches/epoch: the host plane's
+        # modulo-counter sync then lands exactly on window boundaries,
+        # where the device plane's per-window refresh is bit-comparable
+        W_h, acc_h = self._final_weights(dense_binary, input_size=8,
+                                         output_size=1, sync_frequency=5)
+        W_d, acc_d = self._final_weights(dense_binary, input_size=8,
+                                         output_size=1, sync_frequency=5,
+                                         device_plane=True)
+        np.testing.assert_allclose(W_d, W_h, rtol=1e-4, atol=1e-6)
+        assert acc_d > 0.9 and abs(acc_d - acc_h) < 0.02
+
+    def test_sparse_matches_host_plane(self, sparse_binary):
+        W_h, acc_h = self._final_weights(sparse_binary, input_size=50,
+                                         output_size=1, sparse=True,
+                                         sync_frequency=5)
+        W_d, acc_d = self._final_weights(sparse_binary, input_size=50,
+                                         output_size=1, sparse=True,
+                                         sync_frequency=5,
+                                         device_plane=True)
+        np.testing.assert_allclose(W_d, W_h, rtol=1e-4, atol=1e-6)
+        assert acc_d > 0.85 and abs(acc_d - acc_h) < 0.02
+
+    def test_softmax_multiclass_device(self, tmp_path):
+        rng = np.random.default_rng(3)
+        W_true = rng.normal(size=(8, 3))
+        X = rng.normal(size=(600, 8)).astype(np.float32)
+        y = np.argmax(X @ W_true, axis=1)
+        _write_dense(tmp_path / "train.data", X[:500], y[:500])
+        _write_dense(tmp_path / "test.data", X[500:], y[500:])
+        _, acc = self._final_weights(tmp_path, input_size=8, output_size=3,
+                                     objective_type="softmax",
+                                     sync_frequency=2, device_plane=True)
+        assert acc > 0.85
+
+    def test_ftrl_rejected(self, sparse_binary):
+        from multiverso_tpu.utils.log import FatalError
+        from multiverso_tpu.zoo import Zoo
+        cfg = _config(sparse_binary, input_size=50, output_size=1,
+                      use_ps=True, objective_type="ftrl",
+                      device_plane=True)
+        with pytest.raises(FatalError):
+            LogReg(cfg)
+        assert not Zoo.Get().started   # guard brought the world down
+
+
+class TestReaderFastPaths:
+    def test_epoch_cache_matches_streaming(self, dense_binary):
+        """cache_data replays the IDENTICAL window sequence: final weights
+        must be bit-equal to re-parsing every epoch."""
+        weights = {}
+        for cached in (True, False):
+            cfg = _config(dense_binary, input_size=8, output_size=1,
+                          objective_type="sigmoid", updater_type="sgd",
+                          learning_rate=0.5, train_epoch=3,
+                          cache_data=cached)
+            lr = LogReg(cfg)
+            lr.Train()
+            weights[cached] = lr.model.weights().copy()
+        np.testing.assert_array_equal(weights[True], weights[False])
+
+    def test_dense_fast_parser_matches_parse_line(self, tmp_path):
+        from multiverso_tpu.models.logreg.data import (
+            _iter_samples_dense_fast, parse_line)
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(57, 5)).astype(np.float32)
+        y = rng.integers(0, 2, 57)
+        _write_dense(tmp_path / "d.data", X, y)
+        cfg = _config(tmp_path, input_size=5, output_size=1)
+        fast = list(_iter_samples_dense_fast(str(tmp_path / "d.data"), cfg))
+        slow = [parse_line(l, 5, False, False)
+                for l in open(tmp_path / "d.data")]
+        assert len(fast) == len(slow) == 57
+        for (fl, fw, _, fv), (sl, sw, _, sv) in zip(fast, slow):
+            assert fl == sl and fw == sw
+            np.testing.assert_array_equal(fv, sv)
+
+    def test_dense_fast_parser_rejects_bad_width(self, tmp_path):
+        from multiverso_tpu.utils.log import FatalError
+        from multiverso_tpu.models.logreg.data import (
+            _iter_samples_dense_fast)
+        (tmp_path / "bad.data").write_text("1 0.5 0.5\n0 0.1 0.2 0.3\n")
+        cfg = _config(tmp_path, input_size=3, output_size=1)
+        with pytest.raises(FatalError):
+            list(_iter_samples_dense_fast(str(tmp_path / "bad.data"), cfg))
+
+    def test_dense_fast_parser_rejects_coincidental_reshape(self, tmp_path):
+        """Ragged widths whose token TOTAL still divides evenly must not
+        silently misparse (np.loadtxt validates per-line columns)."""
+        from multiverso_tpu.utils.log import FatalError
+        from multiverso_tpu.models.logreg.data import (
+            _iter_samples_dense_fast)
+        # widths 2 and 4: total 6 == 2 lines * 3 cols would reshape
+        (tmp_path / "c.data").write_text("1 0.5\n0 0.1 0.2 0.3\n")
+        cfg = _config(tmp_path, input_size=2, output_size=1)
+        with pytest.raises(FatalError):
+            list(_iter_samples_dense_fast(str(tmp_path / "c.data"), cfg))
