@@ -19,7 +19,10 @@ and one jit'd, donated program derives the pairs and trains:
     (reference wordembedding.cpp:58-75 ``rand % window``) and one
     masked shift pass per offset d in [-W..W]\\{0} — the same
     construction as data.py:159-213, lanes masked instead of compacted
-    (SPMD static shapes);
+    (SPMD static shapes). Skip-gram emits one pair per (center,
+    context) lane; CBOW stacks the offsets into the pair's INPUT lanes
+    (the step's imask mean is the context average,
+    wordembedding.cpp cbow branch);
   * negatives from the reference's quantized unigram^0.75 SLOT table
     (util.h SetNegativeSamplingDistribution) uploaded once — one
     random-int gather per draw, the fastest sampler measured on v5e
@@ -27,6 +30,10 @@ and one jit'd, donated program derives the pairs and trains:
     the rare-word tail at word2vec-scale vocabularies);
   * center-collision negative lanes masked (reference skips
     target==word_idx draws);
+  * hierarchical softmax from (points, 1-codes, mask) tables built
+    once from the Huffman tree and gathered per center — the output
+    lanes become the center's root path (huffman_encoder.cpp), no
+    negative draws;
   * the standard train step (model.make_train_step) scanned over the
     lane batches, operating DIRECTLY on the tables' sharded storage
     (ids remapped to the interleaved layout: sid = r + r//block_rows).
@@ -36,8 +43,9 @@ semantics physically shorten sentences (windows then reach farther),
 which requires compaction — a data-dependent shape. It is one
 vectorized pass over the tokens and rides the loader thread.
 
-Single-process/single-writer (the device-plane ownership contract);
-skipgram + negative sampling only (cbow/hs keep the host path).
+Single-process/single-writer (the device-plane ownership contract).
+All four mode combinations (skipgram/cbow x NEG/HS) ride the fused
+path (round 4; rounds 2-3 covered skipgram+NEG only).
 """
 
 from __future__ import annotations
@@ -147,31 +155,57 @@ def _make_sparse_adagrad_step(eps: float = 1e-10):
 class DevicePairsTrainer:
     """Owns the uploaded sampling tables; programs cache module-wide."""
 
-    def __init__(self, opt, comm, counts):
+    def __init__(self, opt, comm, counts, huffman=None):
         import jax.numpy as jnp
-        CHECK(not opt.cbow and not opt.hs,
-              "-device_pairs covers skipgram+NEG (cbow/hs ride the host "
-              "pair path)")
         from multiverso_tpu.parallel import multihost
         CHECK(multihost.process_count() <= 1,
               "-device_pairs is single-process (device-plane ownership)")
         self.opt = opt
         self.comm = comm
-        # negative-sampling SLOT table (reference util.h
-        # SetNegativeSamplingDistribution; same quantization law as
-        # sampler.Sampler): word i owns round(p_i * T) consecutive slots.
-        # A float32 CDF + searchsorted loses the tail at word2vec-scale
-        # vocabularies (rare words' mass rounds to zero-width intervals)
-        # AND is slower — one random-int gather beats every searchsorted
-        # method measured on v5e.
-        probs = np.asarray(counts, np.float64) ** 0.75
-        cum = np.cumsum(probs / probs.sum())
-        T = int(min(max(1 << 20, 64 * len(counts)), 1 << 24))
-        bounds = np.round(cum * T).astype(np.int64)
-        self._slots = jnp.asarray(np.repeat(
-            np.arange(len(counts), dtype=np.int32),
-            np.diff(bounds, prepend=0)))
         self._block_counter = 0
+        if opt.hs:
+            # hierarchical softmax: the (points, 1-codes) tables upload
+            # ONCE; each center's output lanes gather from them like the
+            # NEG table (reference huffman_encoder.cpp paths; inner-node
+            # ids live in the output table rows like word2vec syn1).
+            # The driver's already-built encoder is reused when passed —
+            # the tree build is O(V log V) at word2vec vocabularies.
+            enc = huffman
+            if enc is None:
+                from multiverso_tpu.models.wordembedding.huffman import (
+                    HuffmanEncoder)
+                enc = HuffmanEncoder()
+                enc.BuildFromTermFrequency(counts)
+            V, MC = len(counts), max(enc.max_code_length, 1)
+            pts = np.zeros((V, MC), np.int32)
+            labs = np.zeros((V, MC), np.float32)
+            hmask = np.zeros((V, MC), np.float32)
+            for w in range(V):
+                info = enc.GetLabelInfo(w)
+                L = len(info.codes)
+                pts[w, :L] = info.points
+                labs[w, :L] = [1 - c for c in info.codes]
+                hmask[w, :L] = 1.0
+            self._hs_points = jnp.asarray(pts)
+            self._hs_labels = jnp.asarray(labs)
+            self._hs_mask = jnp.asarray(hmask)
+            self._max_code = MC
+            self._slots = None
+        else:
+            # negative-sampling SLOT table (reference util.h
+            # SetNegativeSamplingDistribution; same quantization law as
+            # sampler.Sampler): word i owns round(p_i * T) consecutive
+            # slots. A float32 CDF + searchsorted loses the tail at
+            # word2vec-scale vocabularies (rare words' mass rounds to
+            # zero-width intervals) AND is slower — one random-int gather
+            # beats every searchsorted method measured on v5e.
+            probs = np.asarray(counts, np.float64) ** 0.75
+            cum = np.cumsum(probs / probs.sum())
+            T = int(min(max(1 << 20, 64 * len(counts)), 1 << 24))
+            bounds = np.round(cum * T).astype(np.int64)
+            self._slots = jnp.asarray(np.repeat(
+                np.arange(len(counts), dtype=np.int32),
+                np.diff(bounds, prepend=0)))
 
     # -- table storage plumbing --------------------------------------------
 
@@ -199,7 +233,8 @@ class DevicePairsTrainer:
         sparse = opt.use_adagrad and table_bytes > _SPARSE_BYTES
         cache_key = (t_pad, nb, opt.window_size, opt.negative_num,
                      opt.pair_batch_size, opt.use_adagrad, sparse,
-                     srv.block_rows)
+                     srv.block_rows, opt.cbow, opt.hs,
+                     self._max_code if opt.hs else 0)
         if cache_key in _PROGRAM_CACHE:
             return _PROGRAM_CACHE[cache_key]
         import jax
@@ -221,7 +256,9 @@ class DevicePairsTrainer:
             layout: block_rows live rows + 1 trash row per shard)."""
             return r + r // block_rows
 
-        def program(states, slots, ids, sent, key, lr):
+        cbow, hs = opt.cbow, opt.hs
+
+        def program(states, aux, ids, sent, key, lr):
             n = t_pad
             ar = jnp.arange(n, dtype=jnp.int32)
             valid = ids >= 0
@@ -237,7 +274,7 @@ class DevicePairsTrainer:
             kb, kneg = jax.random.split(key)
             b = jax.random.randint(kb, (n,), 1, W + 1)
 
-            centers_l, contexts_l, mask_l = [], [], []
+            shifts_l, ok_l = [], []
             for d in list(range(-W, 0)) + list(range(1, W + 1)):
                 if d > 0:
                     shifted = jnp.concatenate(
@@ -247,30 +284,50 @@ class DevicePairsTrainer:
                         [jnp.full((-d,), -1, jnp.int32), ids[:d]])
                 ok = (valid & (abs(d) <= b) & (pos + d >= 0)
                       & (pos + d < slen) & (shifted >= 0))
-                centers_l.append(ids)
-                contexts_l.append(shifted)
-                mask_l.append(ok)
-            centers = jnp.concatenate(centers_l)
-            contexts = jnp.concatenate(contexts_l)
-            pmask = jnp.concatenate(mask_l)
-            centers = jnp.where(pmask, centers, 0)
-            contexts = jnp.where(pmask, contexts, 0)
-            P = centers.shape[0]                      # 2W * t_pad
-            draws = jax.random.randint(kneg, (P, K), 0, slots.shape[0])
-            negs = jnp.take(slots, draws)
+                shifts_l.append(shifted)
+                ok_l.append(ok)
 
-            # skipgram lanes: input = context word, outputs = [center]+negs
-            inputs = contexts[:, None]
-            imask = pmask[:, None].astype(jnp.float32)
-            outputs = jnp.concatenate([centers[:, None], negs], axis=1)
-            omask = jnp.concatenate(
-                [pmask[:, None],
-                 pmask[:, None] & (negs != centers[:, None])],
-                axis=1).astype(jnp.float32)
-            labels = jnp.broadcast_to(
-                jnp.concatenate([jnp.ones((1,), jnp.float32),
-                                 jnp.zeros((K,), jnp.float32)])[None, :],
-                (P, 1 + K))
+            if cbow:
+                # one pair per CENTER: the input lanes are the center's
+                # shrunk-window context words, mean-combined by the step's
+                # imask (reference wordembedding.cpp cbow branch)
+                ibool = jnp.stack(ok_l, axis=1)           # (n, 2W)
+                inputs = jnp.where(ibool, jnp.stack(shifts_l, axis=1), 0)
+                imask = ibool.astype(jnp.float32)
+                pmask = ibool.any(axis=1)                 # center usable
+                centers = jnp.where(pmask, ids, 0)
+            else:
+                # skip-gram: one pair per (center, context) lane
+                pmask = jnp.concatenate(ok_l)
+                centers = jnp.where(pmask, jnp.concatenate([ids] * (2 * W)),
+                                    0)
+                contexts = jnp.where(pmask, jnp.concatenate(shifts_l), 0)
+                inputs = contexts[:, None]
+                imask = pmask[:, None].astype(jnp.float32)
+            P = centers.shape[0]              # t_pad (cbow) | 2W*t_pad
+
+            if hs:
+                # output lanes = the center's Huffman path: inner-node
+                # rows + (1-code) labels, gathered from the uploaded
+                # tables exactly like the NEG slot gather
+                hs_points, hs_labels, hs_mask = aux
+                outputs = jnp.take(hs_points, centers, axis=0)
+                labels = jnp.take(hs_labels, centers, axis=0)
+                omask = (jnp.take(hs_mask, centers, axis=0)
+                         * pmask[:, None].astype(jnp.float32))
+            else:
+                (slots,) = aux
+                draws = jax.random.randint(kneg, (P, K), 0, slots.shape[0])
+                negs = jnp.take(slots, draws)
+                outputs = jnp.concatenate([centers[:, None], negs], axis=1)
+                omask = jnp.concatenate(
+                    [pmask[:, None],
+                     pmask[:, None] & (negs != centers[:, None])],
+                    axis=1).astype(jnp.float32)
+                labels = jnp.broadcast_to(
+                    jnp.concatenate([jnp.ones((1,), jnp.float32),
+                                     jnp.zeros((K,), jnp.float32)])[None, :],
+                    (P, 1 + K))
 
             def batched(a):
                 pad = nb * B - P
@@ -327,14 +384,16 @@ class DevicePairsTrainer:
         ids[:T] = token_ids
         sent = np.full(t_pad, -1, np.int32)
         sent[:T] = token_sent
-        P = 2 * self.opt.window_size * t_pad
+        P = t_pad if self.opt.cbow else 2 * self.opt.window_size * t_pad
         nb = next_bucket(-(-P // self.opt.pair_batch_size), min_bucket=4)
         program = self._program(t_pad, nb)
         self._block_counter += 1
         key = jax.random.fold_in(jax.random.PRNGKey(self.opt.seed),
                                  self._block_counter)
+        aux = ((self._hs_points, self._hs_labels, self._hs_mask)
+               if self.opt.hs else (self._slots,))
         states, stats = program(
-            self._take_states(), self._slots, jnp.asarray(ids),
+            self._take_states(), aux, jnp.asarray(ids),
             jnp.asarray(sent), key, jnp.float32(lr))
         self._put_states(states)
         # stats is a (2,) int32 device array; one np.asarray in the

@@ -75,7 +75,8 @@ class DistributedWordEmbedding:
             if opt.device_pairs:
                 from multiverso_tpu.models.wordembedding.device_pairs import (
                     DevicePairsTrainer)
-                self._dp_trainer = DevicePairsTrainer(opt, self.comm, counts)
+                self._dp_trainer = DevicePairsTrainer(opt, self.comm, counts,
+                                                      huffman=self.huffman)
 
     # -- training -----------------------------------------------------------
 

@@ -102,6 +102,21 @@ def _make_corpus(path, n_sentences=300, seed=0):
             f.write(" ".join(words) + "\n")
 
 
+def _topic_separation(output_file):
+    """-> (same_topic_cos, cross_topic_cos) for _make_corpus vectors."""
+    lines = open(output_file).read().splitlines()[1:]
+    vecs = {l.split()[0]: np.array(l.split()[1:], float) for l in lines}
+
+    def cos(a, b):
+        return a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+
+    same = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{5*t + k}"])
+                    for t in range(4) for k in range(1, 5)])
+    cross = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{(5*t + 7) % 20}"])
+                     for t in range(4)])
+    return same, cross
+
+
 def _run(tmp_path, **kw):
     from multiverso_tpu.models.wordembedding.distributed import (
         DistributedWordEmbedding)
@@ -209,12 +224,35 @@ class TestEndToEnd:
         np.testing.assert_allclose(results["sparse"], results["dense"],
                                    rtol=2e-5, atol=2e-6)
 
-    def test_device_pairs_rejects_cbow_and_hs(self, tmp_path):
-        from multiverso_tpu.utils.log import FatalError
-        with pytest.raises(FatalError):
-            _run(tmp_path, device_pairs=True, cbow=True)
-        with pytest.raises(FatalError):
-            _run(tmp_path, device_pairs=True, hs=True, negative_num=0)
+    def test_device_pairs_cbow(self, tmp_path):
+        """-device_pairs covers CBOW: context lanes mean-combine through
+        the step's imask (round-3 rejected this mode; round 4 fuses it).
+        Must learn the corpus topic structure, not just reduce loss."""
+        opt, loss = _run(tmp_path, device_pairs=True, cbow=True,
+                         use_adagrad=True, init_learning_rate=0.1)
+        assert loss < 0.69 * 4 * 0.9
+        same, cross = _topic_separation(opt.output_file)
+        assert same > cross
+
+    def test_device_pairs_hs(self, tmp_path):
+        """-device_pairs covers hierarchical softmax: the center's Huffman
+        path gathers from the uploaded (points, 1-codes) tables. A
+        misaligned gather could still shrink the loss, so the corpus
+        topic structure is the real assertion."""
+        opt, loss = _run(tmp_path, device_pairs=True, hs=True,
+                         negative_num=0, use_adagrad=True,
+                         init_learning_rate=0.1, epoch=3)
+        assert 0 < loss < 0.69 * 6
+        same, cross = _topic_separation(opt.output_file)
+        assert same > cross
+
+    def test_device_pairs_cbow_hs(self, tmp_path):
+        opt, loss = _run(tmp_path, device_pairs=True, cbow=True, hs=True,
+                         negative_num=0, use_adagrad=True,
+                         init_learning_rate=0.1, epoch=3)
+        assert 0 < loss < 0.69 * 6
+        same, cross = _topic_separation(opt.output_file)
+        assert same > cross
 
     def test_device_plane_matches_host_plane(self, tmp_path):
         """-device_plane 1: fetch/train/push entirely in HBM must produce
