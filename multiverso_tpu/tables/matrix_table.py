@@ -146,6 +146,20 @@ class MatrixServerTable(ServerTable):
             data = jnp.zeros((self.padded_rows, self.store_cols), self.dtype)
         aux = self.updater.init_aux((self.padded_rows, self.store_cols),
                                     self.dtype, zoo.num_workers)
+        # CPU-backend native host mirror (native/src/host_store.cc): the
+        # GIL-free threaded C++ store applies/serves the HOST-plane verbs
+        # for linear aux-free updaters; exactly one side is authoritative
+        # at a time — the ``state`` property/setter below keeps the two
+        # coherent (any device-path write drops the mirror; any state
+        # read syncs pending native writes back). Eligibility is static;
+        # the store itself is created lazily on the first host verb.
+        self._nat_store = None
+        self._nat_dirty = False
+        self._native_host_ok = (
+            self.updater.fusable and self.updater.combine_scale is not None
+            and not jax.tree.leaves(aux) and self.dtype == np.float32
+            and compress is None and multihost.process_count() <= 1
+            and jax.default_backend() == "cpu")
         self.state = {
             "data": ctx.place(data, self._sharding),
             "aux": jax.tree.map(
@@ -455,6 +469,52 @@ class MatrixServerTable(ServerTable):
                                                   : self.num_cols]
         return blocks.reshape(-1, self.num_cols)[: self.num_rows]
 
+    # -- native host mirror (CPU backend) -----------------------------------
+
+    @property
+    def state(self):
+        """The jax {'data','aux'} pytree. Reading it syncs any pending
+        native-mirror writes back into sharded device storage first, so
+        every device-path consumer (device planes, checkpoint, raw(),
+        engine jit programs) always sees the authoritative data."""
+        if self._nat_dirty:
+            ctx = self._zoo.mesh_ctx
+            st = dict(self._state)
+            st["data"] = ctx.place(self._to_storage(self._nat_store.get_all()),
+                                   self._sharding)
+            self._state = st
+            # cleared only after the sync landed: a placement failure must
+            # leave the dirty flag set so retries/later reads still sync
+            self._nat_dirty = False
+        return self._state
+
+    @state.setter
+    def state(self, value) -> None:
+        self._state = value
+        if self._nat_store is not None:
+            # a device-path write made the jax state authoritative; the
+            # mirror is stale — drop it (rebuilt on the next host verb)
+            self._nat_store = None
+            self._nat_dirty = False
+
+    def _host_store(self):
+        """The live native mirror, or None when this table cannot ride it
+        (aux updater, compressed wire, multihost, non-CPU backend, or no
+        native toolchain)."""
+        if not self._native_host_ok:
+            return None
+        if self._nat_store is None:
+            from multiverso_tpu import native as native_mod
+            store = native_mod.NativeHostStore.create(
+                self.num_rows, self.num_cols,
+                float(self.updater.combine_scale))
+            if store is None:
+                self._native_host_ok = False   # no toolchain: stay python
+                return None
+            store.load(self.raw())
+            self._nat_store = store
+        return self._nat_store
+
     # -- helpers ------------------------------------------------------------
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
@@ -507,6 +567,34 @@ class MatrixServerTable(ServerTable):
                 return False
             ids_list.append(ids)
             deltas_list.append(values.reshape(len(ids), self.num_cols))
+        nat = self._host_store()
+        if nat is not None:
+            # native merged apply. Same-id-set payloads (one worker
+            # hammering, or replicated pushes) collapse to vector-summed
+            # deltas + ONE C++ add; otherwise per-payload pre-combine +
+            # one GIL-free add each (uniqueness is only needed WITHIN one
+            # threaded apply — linear updaters sum across applies). A
+            # cross-window np.add.at combine measured ~3x slower than
+            # the applies it saved.
+            first = ids_list[0]
+            if len(ids_list) > 1 and all(
+                    a.shape == first.shape and np.array_equal(a, first)
+                    for a in ids_list[1:]):
+                total = deltas_list[0].astype(self.dtype, copy=True)
+                for d in deltas_list[1:]:
+                    total += d
+                ua, ud = _combine_duplicate_rows(first, total,
+                                                 self.num_cols, self.dtype)
+                nat.add_rows(ua, ud)
+            else:
+                for a, d in zip(ids_list, deltas_list):
+                    ua, ud = _combine_duplicate_rows(a, d, self.num_cols,
+                                                     self.dtype)
+                    nat.add_rows(ua, ud)
+            self._nat_dirty = True
+            for p, a in zip(payloads, ids_list):
+                self._note_add_parts(p.get("option") or AddOption(), [a])
+            return True
         if len({a.shape for a in deltas_list}) != 1:
             # mixed batch shapes would mint a fresh compile per window
             # composition — the per-message path is cheaper than that
@@ -619,6 +707,12 @@ class MatrixServerTable(ServerTable):
             # (reference semantics — every worker's Add accumulates)
             values, parts = multihost.sum_collective_add(option, values,
                                                          with_parts=True)
+            nat = self._host_store()
+            if nat is not None:
+                nat.add_all(values)
+                self._nat_dirty = True
+                self._note_add_parts(option, parts)
+                return
             delta = self._zoo.mesh_ctx.place(self._to_storage(values),
                                              self._sharding)
             self.state = self._update_full(self.state, delta, option.as_jnp())
@@ -635,6 +729,13 @@ class MatrixServerTable(ServerTable):
             option, ids, deltas, with_parts=True)
         self._check_ids(ids)  # every rank's part validated on every replica
         ids, deltas = self._combine_duplicates(ids, deltas)
+        nat = self._host_store()
+        if nat is not None:
+            # unique validated ids: the threaded C++ apply is race-free
+            nat.add_rows(ids, deltas)
+            self._nat_dirty = True
+            self._note_add_parts(option, parts)
+            return
         # ship exact-size arrays; pad to the bucket on device (_pad_row_batch)
         padded_ids, padded_deltas = _pad_row_batch(
             jnp.asarray(ids), jnp.asarray(deltas), next_bucket(len(ids)))
@@ -649,12 +750,18 @@ class MatrixServerTable(ServerTable):
         of this collective Get (SparseMatrixTable computes all ranks' stale
         sets for its lockstep bits) passes the precomputed union so the
         id sets don't ride a second host collective."""
+        nat = self._host_store()
         if row_ids is None:
+            if nat is not None:
+                return nat.get_all()
             data = self.updater.access(self.state["data"], self.state["aux"],
                                        None)
             return self._from_storage(self._zoo.mesh_ctx.fetch(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        if nat is not None:
+            # single-process by eligibility: no union round needed
+            return nat.get_rows(ids)
         union = (_union if _union is not None
                  else multihost.union_collective_ids(ids))
         if union is not None:
@@ -682,6 +789,17 @@ class MatrixServerTable(ServerTable):
         pipelined RTT instead of one each."""
         if multihost.process_count() > 1:
             return None  # collective fetch/union — keep the sync path
+        nat = self._host_store()
+        if nat is not None:
+            # the native gather is synchronous and cheap (no device->host
+            # copy to overlap); serve it eagerly under the window
+            if row_ids is None:
+                out = nat.get_all()
+            else:
+                ids = np.asarray(row_ids, np.int32).ravel()
+                self._check_ids(ids)
+                out = nat.get_rows(ids)
+            return lambda: out
         if row_ids is None:
             data = self.updater.access(self.state["data"], self.state["aux"],
                                        None)

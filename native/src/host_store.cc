@@ -1,0 +1,195 @@
+// Threaded f32 LOGICAL row store backing the CPU-backend matrix host
+// plane (multiverso_tpu/tables/matrix_table.py native mirror).
+//
+// The python engine thread owns every call (single-writer, the actor
+// contract), so the store itself needs no locking — the parallelism is
+// INSIDE one apply: a persistent worker pool splits the row batch, the
+// reference's OpenMP-parallel server update loop re-done with
+// std::thread (reference src/updater/updater.cpp:21-29). Row ids arrive
+// unique (the python side pre-combines duplicates with np.add.at —
+// the same contract as the device scatter), so per-row writes are
+// disjoint and the pool needs no synchronization beyond the barrier.
+//
+// Only the LINEAR aux-free rules ride this path: data += sign * delta
+// (sign +1 default / -1 sgd). Aux-carrying updaters keep the python/XLA
+// path — their state lives in the jax aux pytree.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+class Pool {
+ public:
+  explicit Pool(int n) : nthreads_(n) {
+    for (int i = 0; i < n; ++i) {
+      threads_.emplace_back([this, i] { Run(i); });
+    }
+  }
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> l(m_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  // fn(tid, nthreads); blocks until every worker finished its slice.
+  void ParallelFor(const std::function<void(int, int)>& fn) {
+    std::unique_lock<std::mutex> l(m_);
+    fn_ = &fn;
+    done_ = 0;
+    ++gen_;
+    cv_.notify_all();
+    cv_done_.wait(l, [this] { return done_ == nthreads_; });
+    fn_ = nullptr;
+  }
+
+  int size() const { return nthreads_; }
+
+ private:
+  void Run(int tid) {
+    uint64_t seen = 0;
+    for (;;) {
+      const std::function<void(int, int)>* fn;
+      {
+        std::unique_lock<std::mutex> l(m_);
+        cv_.wait(l, [&] { return stop_ || gen_ != seen; });
+        if (stop_) return;
+        seen = gen_;
+        fn = fn_;
+      }
+      (*fn)(tid, nthreads_);
+      {
+        std::lock_guard<std::mutex> l(m_);
+        if (++done_ == nthreads_) cv_done_.notify_all();
+      }
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_, cv_done_;
+  std::vector<std::thread> threads_;
+  const std::function<void(int, int)>* fn_ = nullptr;
+  uint64_t gen_ = 0;
+  int done_ = 0;
+  bool stop_ = false;
+  int nthreads_;
+};
+
+Pool& GlobalPool() {
+  static Pool* pool = [] {
+    int n = static_cast<int>(std::thread::hardware_concurrency());
+    if (const char* env = std::getenv("MVT_HOST_STORE_THREADS")) {
+      n = std::atoi(env);
+    }
+    if (n < 1) n = 1;
+    if (n > 16) n = 16;
+    return new Pool(n);
+  }();
+  return *pool;
+}
+
+// below this many bytes of touched rows, pool wakeup latency (~10us)
+// costs more than it buys — run inline on the calling thread
+constexpr int64_t kParallelBytes = 1 << 18;
+
+struct HostStore {
+  int64_t rows, cols;
+  float sign;
+  std::vector<float> data;
+};
+
+inline void ForRows(int64_t n, int64_t cols,
+                    const std::function<void(int64_t, int64_t)>& body) {
+  if (n * cols * static_cast<int64_t>(sizeof(float)) < kParallelBytes) {
+    body(0, n);
+    return;
+  }
+  Pool& pool = GlobalPool();
+  int nt = pool.size();
+  if (nt <= 1) {  // single-core host: a pool handoff is pure overhead
+    body(0, n);
+    return;
+  }
+  int64_t chunk = (n + nt - 1) / nt;
+  pool.ParallelFor([&](int tid, int) {
+    int64_t lo = tid * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo < hi) body(lo, hi);
+  });
+}
+
+}  // namespace
+
+extern "C" {
+
+void* MV_HostStoreNew(int64_t rows, int64_t cols, float sign) {
+  if (rows <= 0 || cols <= 0) return nullptr;
+  auto* s = new HostStore{rows, cols, sign, {}};
+  s->data.assign(static_cast<size_t>(rows * cols), 0.0f);
+  return s;
+}
+
+void MV_HostStoreFree(void* h) { delete static_cast<HostStore*>(h); }
+
+void MV_HostStoreLoad(void* h, const float* src) {
+  auto* s = static_cast<HostStore*>(h);
+  std::memcpy(s->data.data(), src, s->data.size() * sizeof(float));
+}
+
+void MV_HostStoreGetAll(void* h, float* out) {
+  auto* s = static_cast<HostStore*>(h);
+  std::memcpy(out, s->data.data(), s->data.size() * sizeof(float));
+}
+
+void MV_HostStoreAddAll(void* h, const float* delta) {
+  auto* s = static_cast<HostStore*>(h);
+  const float sign = s->sign;
+  float* data = s->data.data();
+  const int64_t cols = s->cols;
+  ForRows(s->rows, cols, [&](int64_t lo, int64_t hi) {
+    const int64_t a = lo * cols, b = hi * cols;
+    for (int64_t i = a; i < b; ++i) data[i] += sign * delta[i];
+  });
+}
+
+// ids UNIQUE and in-range (python pre-combines + validates)
+void MV_HostStoreAddRows(void* h, const int32_t* ids, int64_t n,
+                         const float* deltas) {
+  auto* s = static_cast<HostStore*>(h);
+  const float sign = s->sign;
+  float* data = s->data.data();
+  const int64_t cols = s->cols;
+  ForRows(n, cols, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      float* __restrict row = data + static_cast<int64_t>(ids[r]) * cols;
+      const float* __restrict d = deltas + r * cols;
+      for (int64_t c = 0; c < cols; ++c) row[c] += sign * d[c];
+    }
+  });
+}
+
+void MV_HostStoreGetRows(void* h, const int32_t* ids, int64_t n,
+                         float* out) {
+  auto* s = static_cast<HostStore*>(h);
+  const float* data = s->data.data();
+  const int64_t cols = s->cols;
+  ForRows(n, cols, [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      std::memcpy(out + r * cols,
+                  data + static_cast<int64_t>(ids[r]) * cols,
+                  cols * sizeof(float));
+    }
+  });
+}
+
+}  // extern "C"
