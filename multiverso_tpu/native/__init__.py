@@ -233,9 +233,17 @@ class NativeHostStore:
     def add_all(self, delta: np.ndarray) -> None:
         self._h.MV_HostStoreAddAll(self._ptr, self._check_full(delta))
 
-    def add_rows(self, ids: np.ndarray, deltas: np.ndarray) -> None:
-        """ids must be UNIQUE and validated (caller pre-combines)."""
+    def _check_ids(self, ids: np.ndarray) -> np.ndarray:
+        # the C++ side indexes data + id*cols blindly — an out-of-range
+        # id would be silent heap corruption, not an exception
         ids = np.ascontiguousarray(ids, np.int32)
+        if len(ids) and (int(ids.min()) < 0 or int(ids.max()) >= self.rows):
+            raise ValueError(f"row id out of range [0, {self.rows})")
+        return ids
+
+    def add_rows(self, ids: np.ndarray, deltas: np.ndarray) -> None:
+        """ids must be UNIQUE and in-range (caller pre-combines)."""
+        ids = self._check_ids(ids)
         deltas = np.ascontiguousarray(deltas, np.float32)
         if deltas.size != len(ids) * self.cols:
             raise ValueError(f"expected {len(ids)}x{self.cols} delta "
@@ -243,7 +251,7 @@ class NativeHostStore:
         self._h.MV_HostStoreAddRows(self._ptr, ids, len(ids), deltas)
 
     def get_rows(self, ids: np.ndarray) -> np.ndarray:
-        ids = np.ascontiguousarray(ids, np.int32)
+        ids = self._check_ids(ids)
         out = np.empty((len(ids), self.cols), np.float32)
         self._h.MV_HostStoreGetRows(self._ptr, ids, len(ids), out)
         return out
