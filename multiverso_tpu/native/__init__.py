@@ -106,6 +106,16 @@ def _configure_signatures(h: ctypes.CDLL) -> None:
     h.MV_HostStoreAddAll.argtypes = [ctypes.c_void_p, f32p]
     h.MV_HostStoreAddRows.argtypes = [ctypes.c_void_p, i32p, i64, f32p]
     h.MV_HostStoreGetRows.argtypes = [ctypes.c_void_p, i32p, i64, f32p]
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    h.MV_KvIndexNew.restype = ctypes.c_void_p
+    h.MV_KvIndexNew.argtypes = [i64]
+    h.MV_KvIndexFree.argtypes = [ctypes.c_void_p]
+    h.MV_KvIndexSize.restype = i64
+    h.MV_KvIndexSize.argtypes = [ctypes.c_void_p]
+    h.MV_KvIndexLookup.argtypes = [ctypes.c_void_p, i64p, i64, i32p]
+    h.MV_KvIndexInsert.argtypes = [ctypes.c_void_p, i64p, i64, i32p]
+    h.MV_KvIndexItems.argtypes = [ctypes.c_void_p, i64p, i32p]
+    h.MV_KvIndexSetItems.argtypes = [ctypes.c_void_p, i64p, i32p, i64]
 
 
 def parse_libsvm(text: bytes, weighted: bool = False
@@ -255,3 +265,60 @@ class NativeHostStore:
         out = np.empty((len(ids), self.cols), np.float32)
         self._h.MV_HostStoreGetRows(self._ptr, ids, len(ids), out)
         return out
+
+
+class KvIndex:
+    """Native int64 -> int32 slot index (native/src/kv_index.cc): linear
+    probing with the splitmix64 finalizer. Batch insert assigns slots in
+    BATCH ORDER (the KV multihost contract: identical key streams produce
+    identical indices on every host). Single-writer."""
+
+    def __init__(self, handle: ctypes.CDLL, cap_hint: int):
+        self._h = handle
+        self._ptr = handle.MV_KvIndexNew(cap_hint)
+        if not self._ptr:
+            raise MemoryError("MV_KvIndexNew failed")
+
+    @classmethod
+    def create(cls, cap_hint: int = 1024) -> Optional["KvIndex"]:
+        handle = lib()
+        if handle is None:
+            return None
+        return cls(handle, cap_hint)
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr:
+            self._h.MV_KvIndexFree(ptr)
+
+    def __len__(self) -> int:
+        return int(self._h.MV_KvIndexSize(self._ptr))
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.empty(len(keys), np.int32)
+        self._h.MV_KvIndexLookup(self._ptr, keys, len(keys), out)
+        return out
+
+    def insert(self, keys: np.ndarray) -> np.ndarray:
+        """Missing keys get size++ in batch order; returns all slots."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.empty(len(keys), np.int32)
+        self._h.MV_KvIndexInsert(self._ptr, keys, len(keys), out)
+        return out
+
+    def items(self):
+        """-> (keys i64[n], slots i32[n]), arbitrary order."""
+        n = len(self)
+        keys = np.empty(max(n, 1), np.int64)
+        slots = np.empty(max(n, 1), np.int32)
+        self._h.MV_KvIndexItems(self._ptr, keys, slots)
+        return keys[:n], slots[:n]
+
+    def set_items(self, keys: np.ndarray, slots: np.ndarray) -> None:
+        """Replace contents (keys must be unique)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        slots = np.ascontiguousarray(slots, np.int32)
+        if len(keys) != len(slots):
+            raise ValueError("keys/slots length mismatch")
+        self._h.MV_KvIndexSetItems(self._ptr, keys, slots, len(keys))

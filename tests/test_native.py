@@ -319,3 +319,44 @@ class TestNativeReader:
             native.parse_libsvm(b"1 abc:2\n")
         with pytest.raises(ValueError):
             native.parse_libsvm(b"xyz 1:2\n")
+
+
+class TestKvIndex:
+    def _ix(self, cap=1024):
+        from multiverso_tpu import native
+        if native.lib() is None:
+            pytest.skip("native toolchain unavailable")
+        return native.KvIndex.create(cap)
+
+    def test_batch_order_assignment_and_dups(self):
+        ix = self._ix()
+        keys = np.array([50, -3, 50, 7, 2**62, -3], np.int64)
+        slots = ix.insert(keys)
+        # batch order, duplicates share the first assignment
+        assert slots.tolist() == [0, 1, 0, 2, 3, 1]
+        assert len(ix) == 4
+        # lookup hits what insert assigned; missing -> -1
+        got = ix.lookup(np.array([7, 99, -3], np.int64))
+        assert got.tolist() == [2, -1, 1]
+
+    def test_growth_keeps_assignments(self):
+        ix = self._ix(cap=4)
+        keys = np.arange(10_000, dtype=np.int64) * 7 - 31
+        slots = ix.insert(keys)
+        assert slots.tolist() == list(range(10_000))
+        again = ix.lookup(keys)
+        np.testing.assert_array_equal(again, slots)
+
+    def test_items_set_items_roundtrip(self):
+        ix = self._ix()
+        keys = np.array([9, -1, 123456789012345], np.int64)
+        ix.insert(keys)
+        ks, ss = ix.items()
+        order = np.argsort(ss)
+        np.testing.assert_array_equal(ks[order], keys)
+        ix2 = self._ix()
+        ix2.set_items(ks, ss)
+        assert len(ix2) == 3
+        np.testing.assert_array_equal(ix2.lookup(keys), [0, 1, 2])
+        # inserts continue after the loaded slots
+        assert ix2.insert(np.array([777], np.int64)).tolist() == [3]
