@@ -79,6 +79,12 @@ class KVServerTable(ServerTable):
         # them to 32 bits without global x64 mode, and scalar counters are
         # control-plane data with no business on the device anyway.
         self._host_backed = self.dtype.itemsize == 8
+        # CPU-backend host mirror state (f32 branch only; see _np_values).
+        # Initialized before any _values assignment — the property setter
+        # below consults these.
+        self._values_np = None
+        self._np_dirty = False
+        self._host_values_ok = False
         if self._host_backed:
             self._values = np.zeros(self.capacity, self.dtype)
 
@@ -94,6 +100,15 @@ class KVServerTable(ServerTable):
             return
         self._values = ctx.place(jnp.zeros((self.capacity,), self.dtype),
                                  self._sharding)
+        # CPU-backend host mirror for the f32 values (same coherence
+        # pattern as the matrix table's native mirror): host verbs apply
+        # with numpy at vector speed instead of per-op jit dispatches
+        # (~6ms/pair measured); device-plane reads sync pending host
+        # writes back, ANY assignment to ``_values`` (the property
+        # setter) drops the mirror. A live mirror is ALWAYS fresh;
+        # ``_np_dirty`` marks device-side staleness only.
+        self._host_values_ok = (jax.default_backend() == "cpu"
+                                and multihost.process_count() <= 1)
 
         def _scatter_add(values, slots, deltas):
             return values.at[slots].add(deltas)
@@ -104,6 +119,48 @@ class KVServerTable(ServerTable):
             return values[slots]
 
         self._gather = jax.jit(_gather)
+
+    # -- CPU host mirror (f32 values) ---------------------------------------
+
+    @property
+    def _values(self):
+        return self._values_arr
+
+    @_values.setter
+    def _values(self, arr) -> None:
+        # safety by construction (the matrix-table state-setter pattern):
+        # ANY assignment makes the new array authoritative, so a code
+        # path that replaces the values can never leave a stale mirror
+        # serving host Gets
+        self._values_arr = arr
+        self._values_np = None
+        self._np_dirty = False
+
+    def _np_values(self):
+        """The live host mirror, or None when ineligible (TPU backend,
+        multihost, or the 64-bit host-backed branch which IS host)."""
+        if self._host_backed or not self._host_values_ok:
+            return None
+        if self._values_np is None:
+            self._values_np = np.asarray(
+                self._zoo.mesh_ctx.fetch(self._values_arr)).copy()
+        return self._values_np
+
+    def _synced_values(self):
+        """The jax values with pending host-mirror writes applied."""
+        if self._np_dirty:
+            # direct attr write: the mirror stays live (both sides fresh)
+            self._values_arr = self._zoo.mesh_ctx.place(
+                jnp.asarray(self._values_np), self._sharding)
+            self._np_dirty = False
+        return self._values_arr
+
+    def _host_snapshot(self) -> np.ndarray:
+        if self._host_backed:
+            return self._values
+        if self._values_np is not None:
+            return self._values_np
+        return self._zoo.mesh_ctx.fetch(self._values)
 
     # -- slot management ----------------------------------------------------
 
@@ -187,13 +244,18 @@ class KVServerTable(ServerTable):
         ctx = self._zoo.mesh_ctx
         new_cap = pad_to_multiple(new_cap, ctx.num_servers)
         host = np.zeros(new_cap, self.dtype)
-        host[: self.capacity] = (self._values if self._host_backed
-                                 else ctx.fetch(self._values))
+        host[: self.capacity] = self._host_snapshot()
         self.capacity = new_cap
         if self._host_backed:
             self._values = host
-        else:
-            self._values = ctx.place(jnp.asarray(host), self._sharding)
+            return
+        if self._values_np is not None:
+            # keep the host mirror authoritative; the device copy
+            # rebuilds lazily on the next device-plane read
+            self._values_np = host
+            self._np_dirty = True
+            return
+        self._values = ctx.place(jnp.asarray(host), self._sharding)
 
     def _pad_slots(self, slots: np.ndarray,
                    bucket: Optional[int] = None) -> np.ndarray:
@@ -220,6 +282,13 @@ class KVServerTable(ServerTable):
         # all hosts (identity single-process)
         keys, deltas = multihost.merge_collective_add(option, keys, deltas)
         slots = self._slots_for(keys, create=True)
+        npv = self._np_values()
+        if npv is not None:
+            # mirror path needs no bucket padding (that exists for jit
+            # shape stability only); create=True slots are all valid
+            np.add.at(npv, slots, deltas)
+            self._np_dirty = True
+            return
         padded = self._pad_slots(slots)
         pad_deltas = np.zeros(len(padded), self.dtype)
         pad_deltas[: len(slots)] = deltas
@@ -245,6 +314,11 @@ class KVServerTable(ServerTable):
             u_out[union_slots < 0] = 0
             return u_out[np.searchsorted(union, keys)]
         slots = self._slots_for(keys, create=False)
+        npv = self._np_values()
+        if npv is not None:
+            out = npv[np.where(slots < 0, 0, slots)]
+            out[slots < 0] = 0   # absent keys read as 0 (no padding pass)
+            return out
         padded = self._pad_slots(slots)
         if self._host_backed:
             vals = self._gather(self._values, padded)
@@ -331,12 +405,14 @@ class KVServerTable(ServerTable):
 
     def device_values(self) -> jax.Array:
         """The live sharded values array (hand it through your scan
-        carry; write it back with device_set_values). Host-plane Adds
-        DONATE this buffer (the jit'd scatter-add is in-place) — a
-        reference held across an interleaved engine Add is a deleted
-        array; take it fresh after any host-plane write."""
+        carry; write it back with device_set_values). Take it FRESH
+        after any host-plane write: on the TPU path host Adds DONATE
+        this buffer (a stale reference is a deleted array — loud), and
+        on the CPU mirror path they land in the host mirror (a stale
+        reference silently misses them and device_set_values would
+        then discard them) — either way the contract is the same."""
         self._check_device_plane()
-        return self._values
+        return self._synced_values()
 
     def device_set_values(self, values: jax.Array) -> None:
         self._check_device_plane()
@@ -345,7 +421,7 @@ class KVServerTable(ServerTable):
         CHECK(values.dtype == self.dtype,
               f"values dtype {values.dtype} != table dtype {self.dtype} "
               f"(a drifted carry dtype would corrupt Store/Load and Gets)")
-        self._values = values
+        self._values = values   # property setter drops the host mirror
 
     def device_gather_slots(self, values, padded_slots):
         """Traceable: values[slots] (mask trash lanes yourself). Accepts a
@@ -380,9 +456,7 @@ class KVServerTable(ServerTable):
             slots = np.fromiter(self._index.values(), np.int64,
                                 len(self._index))
         if len(keys):
-            host_vals = (self._values if self._host_backed
-                         else self._zoo.mesh_ctx.fetch(self._values))
-            vals = host_vals[slots]
+            vals = self._host_snapshot()[slots]
         else:
             vals = np.empty(0, self.dtype)
         stream.WriteInt(len(keys))

@@ -1086,3 +1086,60 @@ class TestNativeHostMirror:
         table.AddRows(ids, np.full((16, 4), 9.0, np.float32))
         srv.Load(Stream(_io.BytesIO(buf.getvalue())))
         np.testing.assert_allclose(table.GetRows(ids), 5.0, rtol=1e-6)
+
+
+class TestKVHostMirror:
+    """CPU-backend host mirror for the f32 KV values: host verbs apply
+    with numpy; device-plane reads sync, device-plane writes drop it."""
+
+    def test_mirror_interleaves_with_device_plane(self, mv_env):
+        import jax.numpy as jnp
+        kv = mv_env.MV_CreateTable(KVTableOption())
+        srv = kv.server()
+        keys = np.arange(100, dtype=np.int64) * 13
+        kv.Add(keys, np.full(100, 2.0, np.float32))     # host (mirror)
+        assert srv._values_np is not None and srv._np_dirty
+        # device-plane read syncs pending host writes
+        slots = srv.device_slots(keys[:10])
+        vals = srv.device_values()
+        assert not srv._np_dirty
+        got = np.asarray(srv.device_gather_slots(vals, jnp.asarray(slots)))
+        np.testing.assert_allclose(got[:10], 2.0)
+        # device-plane write drops the mirror; later host Get rebuilds
+        pad_d = np.zeros(len(slots), np.float32)
+        pad_d[:10] = 1.0
+        srv.device_set_values(srv.device_scatter_add_slots(
+            vals, jnp.asarray(slots), jnp.asarray(pad_d)))
+        assert srv._values_np is None
+        np.testing.assert_allclose(kv.Get(keys[:10]), 3.0)
+        np.testing.assert_allclose(kv.Get(keys[10:]), 2.0)
+
+    def test_checkpoint_with_dirty_mirror(self, mv_env):
+        import io as _io
+        from multiverso_tpu.utils.io import Stream
+        kv = mv_env.MV_CreateTable(KVTableOption())
+        srv = kv.server()
+        keys = np.array([5, -17, 2**40], np.int64)
+        kv.Add(keys, np.array([1.0, 2.0, 3.0], np.float32))
+        assert srv._np_dirty or srv._values_np is None  # mirror or no-lib
+        buf = _io.BytesIO()
+        srv.Store(Stream(buf))
+        kv.Add(keys, np.full(3, 50.0, np.float32))
+        srv.Load(Stream(_io.BytesIO(buf.getvalue())))
+        np.testing.assert_allclose(kv.Get(keys), [1.0, 2.0, 3.0])
+
+    def test_growth_keeps_mirror_authoritative(self, mv_env):
+        kv = mv_env.MV_CreateTable(KVTableOption(init_capacity=64))
+        srv = kv.server()
+        rng = np.random.default_rng(3)
+        oracle = {}
+        for _ in range(6):
+            keys = rng.integers(0, 10**9, 500)
+            vals = rng.standard_normal(500).astype(np.float32)
+            kv.Add(keys, vals)
+            for k, v in zip(keys.tolist(), vals.tolist()):
+                oracle[k] = oracle.get(k, 0.0) + v
+        probe = np.fromiter(oracle.keys(), np.int64, len(oracle))
+        expect = np.array([oracle[int(k)] for k in probe], np.float32)
+        np.testing.assert_allclose(kv.Get(probe), expect, rtol=1e-4,
+                                   atol=1e-5)
