@@ -355,6 +355,11 @@ def host_allgather_bytes(data: bytes) -> list:
     cap = int(lens.max())
     if cap == 0:
         return [b""] * process_count()
+    # quantize the padded capacity to the pow2 ladder: process_allgather
+    # compiles per SHAPE, so exact-max caps mint a fresh XLA program for
+    # every distinct payload size (a perf-killing compile per op on
+    # varying batches); the ladder bounds the program set to log2(sizes)
+    cap = max(1024, 1 << (cap - 1).bit_length())
     buf = np.zeros(cap, np.uint8)
     if data:
         buf[:len(data)] = np.frombuffer(data, np.uint8)
