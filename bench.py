@@ -905,7 +905,10 @@ def main() -> int:
                 "share grows; the touched-rows sparse step was measured "
                 "SLOWER at this vocab (1.97 vs 4.0 M pairs/s - random-"
                 "gather bw loses to streaming until tables far exceed "
-                "VMEM-friendly sizes, hence device_pairs._SPARSE_BYTES)")
+                "VMEM-friendly sizes, hence device_pairs._SPARSE_BYTES). "
+                "bf16 embedding tables measured 1.14x (4.0->4.5) with "
+                "visibly degraded convergence (tiny adagrad updates "
+                "round away) - evaluated r4, not adopted")
 
     def fill_we_app(wps):
         out["we_app_words_per_sec"] = round(wps)

@@ -5,3 +5,6 @@
 set -e
 python gen_data.py
 python -m multiverso_tpu.models.logreg.main mnist.config
+# the same files through the PS + the r4 on-chip device plane
+# (mnist_device_plane.config adds use_ps/device_plane/sync_frequency)
+python -m multiverso_tpu.models.logreg.main mnist_device_plane.config
