@@ -244,3 +244,142 @@ class TestRound3Oracle:
                                        rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(table.Get(), oracle, rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestRound4Oracle:
+    """Random walks over the round-4 surfaces: the native host mirror
+    interleaved with every other plane, and the LR device-plane window
+    programs — all against numpy models."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_mirror_interleaved_walk_matches_numpy(self, mv_env, seed):
+        """Host verbs (native mirror), device verbs (jax state), engine
+        bursts, and Store/Load interleave randomly; every read and the
+        final state must match the numpy oracle exactly — the coherence
+        protocol has no step where the two sides may disagree."""
+        import io as _io
+        from multiverso_tpu.utils.io import Stream
+        from multiverso_tpu.zoo import Zoo
+        rng = np.random.default_rng(seed + 60)
+        R, C = int(rng.integers(24, 100)), int(rng.integers(2, 12))
+        table = mv_env.MV_CreateTable(MatrixTableOption(num_rows=R,
+                                                        num_cols=C))
+        srv = table.server()
+        oracle = np.zeros((R, C), np.float32)
+        snapshot = None
+        for _ in range(40):
+            op = rng.integers(0, 6)
+            k = int(rng.integers(1, R + 1))
+            ids = np.unique(rng.integers(0, R, k)).astype(np.int32)
+            if op == 0:     # host add (mirror)
+                d = rng.standard_normal((len(ids), C)).astype(np.float32)
+                table.AddRows(ids, d)
+                np.add.at(oracle, ids, d)
+            elif op == 1:   # host get (mirror)
+                np.testing.assert_allclose(table.GetRows(ids), oracle[ids],
+                                           rtol=1e-4, atol=1e-5)
+            elif op == 2:   # device write (drops mirror)
+                # direct server calls bypass the engine: drain queued
+                # fire-and-forget adds first (the checkpoint.py:139 /
+                # device-plane ownership convention)
+                Zoo.Get().DrainServer()
+                d = rng.standard_normal((len(ids), C)).astype(np.float32)
+                srv.device_apply_rows(ids, d)
+                np.add.at(oracle, ids, d)
+            elif op == 3:   # device read (syncs mirror back)
+                Zoo.Get().DrainServer()
+                rows = np.asarray(srv.device_fetch_rows(ids))
+                np.testing.assert_allclose(rows, oracle[ids], rtol=1e-4,
+                                           atol=1e-5)
+            elif op == 4:   # fire-and-forget burst (engine window merge)
+                for _ in range(int(rng.integers(2, 5))):
+                    d = rng.standard_normal((len(ids), C)).astype(
+                        np.float32)
+                    table.AddFireForget(d, row_ids=ids)
+                    np.add.at(oracle, ids, d)
+            elif snapshot is not None and rng.random() < 0.5:
+                # restore an OLDER snapshot (mutations happened since):
+                # Load must discard everything after it, incl. any
+                # native-mirror state
+                Zoo.Get().DrainServer()
+                blob, osnap = snapshot
+                srv.Load(Stream(_io.BytesIO(blob)))
+                oracle = osnap.copy()
+            else:           # take a snapshot through the engine state
+                Zoo.Get().DrainServer()
+                buf = _io.BytesIO()
+                srv.Store(Stream(buf))
+                snapshot = (buf.getvalue(), oracle.copy())
+        np.testing.assert_allclose(table.Get(), oracle, rtol=1e-4,
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("sparse", [False, True])
+    def test_lr_device_windows_match_numpy(self, mv_env, sparse):
+        """The LR device-plane window program against a from-scratch
+        numpy model of the PS protocol: window-start weight cache,
+        per-batch lr-scaled grads summed, one sgd application."""
+        from multiverso_tpu.models.logreg.configure import Configure
+        from multiverso_tpu.models.logreg.data import WindowReader
+        import tempfile
+
+        rng = np.random.default_rng(11)
+        D, B, NB = 6, 8, 3
+        n = B * NB * 4
+        X = rng.normal(size=(n, D)).astype(np.float32)
+        y = (X @ rng.normal(size=D) > 0).astype(int)
+        with tempfile.TemporaryDirectory() as td:
+            path = f"{td}/d.data"
+            with open(path, "w") as f:
+                for row, lab in zip(X, y):
+                    if sparse:
+                        f.write(f"{lab} " + " ".join(
+                            f"{j}:{row[j]:.5f}" for j in range(D)) + "\n")
+                    else:
+                        f.write(f"{lab} " + " ".join(
+                            f"{v:.5f}" for v in row) + "\n")
+            cfg = Configure(input_size=D, output_size=1, sparse=sparse,
+                            objective_type="sigmoid", updater_type="sgd",
+                            learning_rate=0.3, train_epoch=1,
+                            minibatch_size=B, sync_frequency=NB,
+                            use_ps=True, device_plane=True, pipeline=False,
+                            show_time_per_sample=10 ** 9, train_file=path,
+                            test_file="", output_file="",
+                            output_model_file="", cache_data=False)
+            # numpy oracle of the same protocol over the same windows
+            W = np.zeros((D, 1), np.float64)
+            reader = WindowReader(path, cfg, NB)
+            from multiverso_tpu.models.logreg.updater import (
+                ClientSGDUpdater)
+            upd = ClientSGDUpdater(cfg)
+            while True:
+                w = reader.next_window()
+                if w is None:
+                    break
+                Wc = W.copy()            # window-start cache
+                delta = np.zeros_like(W)
+                for b in w.batches:
+                    lr = upd.learning_rate()
+                    upd.tick()
+                    if sparse:
+                        x = np.zeros((B, D), np.float64)
+                        for i in range(B):
+                            x[i, b.keys[i][b.mask[i] > 0]] = \
+                                b.values[i][b.mask[i] > 0]
+                    else:
+                        x = b.dense.astype(np.float64)
+                    act = 1 / (1 + np.exp(-(x @ Wc)))
+                    onehot = (b.labels == 1).astype(np.float64)[:, None]
+                    diff = (act - onehot) * b.weights[:, None]
+                    count = max((b.weights > 0).sum(), 1)
+                    grad = x.T @ diff / count
+                    delta += lr * grad
+                W = W - delta            # server sgd applies the sum
+            # drive the real thing over the same file
+            from multiverso_tpu.models.logreg.logreg import LogReg
+            app = LogReg(cfg)
+            try:
+                app.Train()
+                got = app.model.weights()
+            finally:
+                app.close()
+            np.testing.assert_allclose(got, W, rtol=2e-3, atol=1e-5)
