@@ -43,9 +43,12 @@ semantics physically shorten sentences (windows then reach farther),
 which requires compaction — a data-dependent shape. It is one
 vectorized pass over the tokens and rides the loader thread.
 
-Single-process/single-writer (the device-plane ownership contract).
 All four mode combinations (skipgram/cbow x NEG/HS) ride the fused
-path (round 4; rounds 2-3 covered skipgram+NEG only).
+path, and multi-process worlds train COLLECTIVELY: per-process token
+shards merge as one batch-sharded global vector whose gradients sum
+inside the traced program (round 4; rounds 2-3 covered
+skipgram+NEG, single-process only). Within a process the caller owns
+the tables while training (the device-plane single-writer contract).
 """
 
 from __future__ import annotations
@@ -55,7 +58,6 @@ from typing import Optional
 import numpy as np
 
 from multiverso_tpu.parallel.mesh import next_bucket
-from multiverso_tpu.utils.log import CHECK
 
 
 class _LazyStats:
@@ -157,9 +159,6 @@ class DevicePairsTrainer:
 
     def __init__(self, opt, comm, counts, huffman=None):
         import jax.numpy as jnp
-        from multiverso_tpu.parallel import multihost
-        CHECK(multihost.process_count() <= 1,
-              "-device_pairs is single-process (device-plane ownership)")
         self.opt = opt
         self.comm = comm
         self._block_counter = 0
@@ -369,32 +368,87 @@ class DevicePairsTrainer:
     # -- per-block entry ----------------------------------------------------
 
     def train_block(self, token_ids: np.ndarray, token_sent: np.ndarray,
-                    lr: float):
+                    lr: float, agreed=None):
         """One block: upload the (tiny) token stream, run the fused
         generate+train program in place on the tables. Returns DEVICE
         scalars (loss_sum, pair_count) — harvest them lazily so dispatch
-        overlaps the next block's host prep."""
+        overlaps the next block's host prep.
+
+        Multi-process (round 4): COLLECTIVE, lockstep blocks (every
+        process calls train_block once per logical block — the same
+        contract as every multi-process device-plane verb). Each
+        process's padded token stream becomes one shard of a global
+        batch-sharded vector (place_parts); per-process sentence ids
+        offset into disjoint ranges so the program's segment pass sees
+        the process boundary as a sentence break; the dense grads (or
+        deduped touched-row updates) SUM across processes inside the
+        traced program (GSPMD inserts the collectives — the reference's
+        every-worker's-Add-accumulates, the collective-merge contract
+        of matrix_table's parts round), and the identical update
+        applies everywhere. The returned stats are GLOBAL (all
+        processes' pairs)."""
         import jax
         import jax.numpy as jnp
+
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.parallel.mesh import place_parts
+
+        nproc = multihost.process_count()
         T = len(token_ids)
-        if T == 0:
+        if nproc > 1:
+            from multiverso_tpu.parallel.mesh import (local_device_count,
+                                                      parts_bucket)
+            # the shared local bucket (must divide evenly over this
+            # process's devices — the checked parts_bucket helper every
+            # parts verb uses, floored at 1024 like the single-process
+            # bucket so tail blocks don't mint fresh program shapes) and
+            # the global sentence-id span (subsampling keeps ORIGINAL
+            # sentence indices, so max(token_sent) routinely exceeds T —
+            # the offset must come from the gathered max, not the
+            # bucket). ``agreed`` carries both from the driver's single
+            # per-block allgather; a direct caller pays one here.
+            if agreed is None:
+                local_max_sent = int(token_sent.max(initial=-1)) + 1
+                parts = multihost.host_allgather_objects(
+                    (T, local_max_sent))
+                agreed = (max(p[0] for p in parts),
+                          max(p[1] for p in parts))
+            mesh = self.comm.input_table.server()._mesh
+            t_pad = parts_bucket(max(1024, agreed[0]),
+                                 local_device_count(mesh))
+            sent_span = max(agreed[1], 1)
+        else:
+            t_pad = next_bucket(T, min_bucket=1024)
+        if nproc <= 1 and T == 0:
             return jnp.float32(0.0), jnp.int32(0)
-        t_pad = next_bucket(T, min_bucket=1024)
         ids = np.full(t_pad, -1, np.int32)
         ids[:T] = token_ids
         sent = np.full(t_pad, -1, np.int32)
-        sent[:T] = token_sent
-        P = t_pad if self.opt.cbow else 2 * self.opt.window_size * t_pad
+        rank = multihost.process_index()
+        if nproc > 1:
+            # disjoint per-process sentence ranges: offset by the GLOBAL
+            # max sentence id so shards can never merge across the
+            # process boundary in the concatenated vector
+            sent[:T] = token_sent + rank * sent_span
+            ids_g = place_parts(mesh, ids, nproc)
+            sent_g = place_parts(mesh, sent, nproc)
+            n_total = nproc * t_pad
+        else:
+            sent[:T] = token_sent
+            ids_g, sent_g = jnp.asarray(ids), jnp.asarray(sent)
+            n_total = t_pad
+        P = n_total if self.opt.cbow \
+            else 2 * self.opt.window_size * n_total
         nb = next_bucket(-(-P // self.opt.pair_batch_size), min_bucket=4)
-        program = self._program(t_pad, nb)
+        program = self._program(n_total, nb)
         self._block_counter += 1
         key = jax.random.fold_in(jax.random.PRNGKey(self.opt.seed),
                                  self._block_counter)
         aux = ((self._hs_points, self._hs_labels, self._hs_mask)
                if self.opt.hs else (self._slots,))
         states, stats = program(
-            self._take_states(), aux, jnp.asarray(ids),
-            jnp.asarray(sent), key, jnp.float32(lr))
+            self._take_states(), aux, ids_g, sent_g, key,
+            jnp.float32(lr))
         self._put_states(states)
         # stats is a (2,) int32 device array; one np.asarray in the
         # harvest fetches both scalars (lane 0 is the bitcast f32 loss)

@@ -109,12 +109,61 @@ class DistributedWordEmbedding:
                 # scalar (the program derives the pairs); int() fetches it
                 self.total_pairs += int(pairs)
 
-        current = queue.pop()
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.utils.log import CHECK
+        multiproc = multihost.process_count() > 1
+
+        def pop_block():
+            """queue.pop, made multi-process-safe: per-block table verbs
+            are COLLECTIVE, so a rank whose shard ran out must not stop
+            calling them while peers continue (a silent distributed
+            hang). ONE allgather per block agrees on global completion
+            and, for -device_pairs, on the shared token bucket and
+            sentence-id span — finished ranks then keep participating
+            with EMPTY filler blocks until everyone is done. The other
+            planes cannot run an empty block through their row verbs, so
+            ragged shard streams fail LOUDLY there instead (shard
+            corpora evenly, or use -device_pairs)."""
+            block = queue.pop()
+            if not multiproc:
+                return block
+            T = len(block.tokens) if (block is not None
+                                      and block.tokens is not None) else 0
+            max_sent = (int(block.token_sent.max(initial=-1)) + 1
+                        if block is not None and block.token_sent is not None
+                        else 0)
+            parts = multihost.host_allgather_objects(
+                (block is None, T, max_sent))
+            if all(p[0] for p in parts):
+                return None
+            if any(p[0] for p in parts):
+                # the gathered flags are REPLICATED knowledge: every rank
+                # raises together, so the failure is loud on all of them
+                # instead of stranding the live ranks in the next
+                # collective behind one dead peer
+                CHECK(opt.device_pairs,
+                      "multi-process WE with unequal per-rank block "
+                      "streams needs -device_pairs (empty filler blocks); "
+                      "host/device-plane rounds cannot run empty — shard "
+                      "the corpora evenly")
+            if block is None:
+                block = DataBlock(word_count=0,
+                                  tokens=np.empty(0, np.int32),
+                                  token_sent=np.empty(0, np.int32))
+            if opt.device_pairs:
+                # hand the agreed statics to train_block: the shared
+                # bucket and the global sentence span (one allgather per
+                # block total)
+                block._dp_agreed = (max(p[1] for p in parts),
+                                    max(p[2] for p in parts))
+            return block
+
+        current = pop_block()
         prefetch = None
         next_block: Optional[DataBlock] = None
         while current is not None:
             if opt.is_pipeline:
-                next_block = queue.pop()
+                next_block = pop_block()
                 # host-plane prefetch only: the device plane's fetch is an
                 # async dispatch already (nothing to overlap by hand)
                 if (next_block is not None and next_block.pair_count
@@ -138,7 +187,7 @@ class DistributedWordEmbedding:
                         prefetch)
                 current, prefetch = next_block, None
             else:
-                current = queue.pop()
+                current = pop_block()
         harvest(force=True)
         loader.join()
         return self.total_loss / max(self.total_pairs, 1)
@@ -184,9 +233,9 @@ class DistributedWordEmbedding:
         the dispatch overlaps the next block's prep)."""
         if self.opt.device_pairs and block.tokens is not None:
             # fused generate+train: the tiny token stream is the upload
-            return self._dp_trainer.train_block(block.tokens,
-                                                block.token_sent,
-                                                self._current_lr())
+            return self._dp_trainer.train_block(
+                block.tokens, block.token_sent, self._current_lr(),
+                agreed=getattr(block, "_dp_agreed", None))
         if not block.pair_count:
             return 0.0, 0
         import jax.numpy as jnp

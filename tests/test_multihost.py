@@ -525,12 +525,17 @@ from multiverso_tpu.models.wordembedding.distributed import (
 os.chdir(workdir)
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2"])
-device_plane = len(sys.argv) > 4 and sys.argv[4] == "device"
+mode = sys.argv[4] if len(sys.argv) > 4 else ""
+# pairs mode shrinks the block so unevenly-sized shards produce UNEQUAL
+# block counts (exercising the ragged lockstep protocol)
+extra = {"device": ["-device_plane", "1"],
+         "pairs": ["-device_pairs", "1", "-data_block_size", "2000"]}.get(
+    mode, [])
 opt = Option.parse_args([
     "-train_file", f"corpus_{rank}.txt", "-output", f"vectors_{rank}.txt",
     "-size", "16", "-epoch", "2", "-negative", "3", "-min_count", "1",
     "-read_vocab", "vocab.txt", "-data_block_size", "20000",
-    "-is_pipeline", "0"] + (["-device_plane", "1"] if device_plane else []))
+    "-is_pipeline", "0"] + extra)
 dwe = DistributedWordEmbedding(opt)
 dwe.run()
 mv.MV_Barrier()
@@ -648,3 +653,50 @@ class TestCrossReduceHook:
         [t.join(timeout=10) for t in ts]
         np.testing.assert_allclose(outs[0], 3.0)
         np.testing.assert_allclose(outs[1], 3.0)
+
+
+class TestTwoProcessDevicePairs:
+    """-device_pairs 1 across two processes (round 4): each process's
+    padded token shard becomes one shard of a global batch-sharded
+    vector; the fused program's gradients sum across processes inside
+    the trace. Lockstep blocks (equal shard sizes here); both processes
+    must save IDENTICAL embeddings (the PS state is one SPMD array)."""
+
+    def test_we_device_pairs_across_two_processes(self, tmp_path):
+        # topics 0-1 appear ONLY in shard 0, topics 2-3 only in shard 1:
+        # topic structure for ALL FOUR topics in the saved vectors proves
+        # both processes' gradients landed in the one PS state
+        words = [f"w{i}" for i in range(20)]
+
+        def gen(path, seed, sents, topics):
+            r = np.random.default_rng(seed)
+            with open(path, "w") as f:
+                for _ in range(sents):
+                    t = topics[r.integers(len(topics))]
+                    f.write(" ".join(f"w{t * 5 + r.integers(5)}"
+                                     for _ in range(10)) + "\n")
+
+        # UNEQUAL shard sizes: rank 0 has more blocks than rank 1, so the
+        # ragged-block protocol (finished ranks keep joining collectives
+        # with empty filler blocks) is what keeps this from deadlocking
+        gen(tmp_path / "corpus_0.txt", 5, 400, [0, 1])   # 2 blocks/epoch
+        gen(tmp_path / "corpus_1.txt", 6, 150, [2, 3])   # 1 block/epoch
+        with open(tmp_path / "vocab.txt", "w") as f:
+            for w in words:
+                f.write(f"{w} 100\n")
+        run_two_process(_WE_CHILD, tmp_path, tmp_path, "pairs",
+                        expect="WE OK")
+        v0 = (tmp_path / "vectors_0.txt").read_text()
+        v1 = (tmp_path / "vectors_1.txt").read_text()
+        assert v0 == v1, "processes saved different embeddings"
+        vecs = {l.split()[0]: np.array(l.split()[1:], float)
+                for l in v0.splitlines()[1:]}
+
+        def cos(a, b):
+            return a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9)
+
+        for t in range(4):      # incl. topics only the OTHER shard saw
+            same = np.mean([cos(vecs[f"w{5*t}"], vecs[f"w{5*t + k}"])
+                            for k in range(1, 5)])
+            cross = cos(vecs[f"w{5*t}"], vecs[f"w{(5*t + 7) % 20}"])
+            assert same > cross, f"topic {t} not learned: {same} {cross}"
