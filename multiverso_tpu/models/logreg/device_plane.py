@@ -41,9 +41,14 @@ Loss scalars stay ON DEVICE: ``train_window`` returns a 0-d jax array
 so the driver's accumulation never forces a tunnel round-trip; the
 periodic log line / epoch summary forces one fetch when it formats.
 
-Single-process/single-writer (the device-plane ownership contract, as
-WE); dense + sparse objectives (FTRL keeps the host path — its KV
-state rides host-control verbs by design, SURVEY.md §2b).
+Dense + sparse objectives (FTRL keeps the host path — its KV state
+rides host-control verbs by design, SURVEY.md §2b). Multi-process
+worlds train COLLECTIVELY (round 4): per-process window tensors shard
+one global scan axis (dense) or ride the *_parts row round (sparse),
+the summed lr-scaled deltas being exactly the host plane's merged
+collective Add; ragged shard streams run on filler windows (inert
+weight-0 batches). Within a process the caller owns the tables while
+training (the device-plane single-writer contract).
 """
 
 from __future__ import annotations
@@ -64,12 +69,9 @@ class DeviceWindowTrainer:
     ``config.device_plane`` is set."""
 
     def __init__(self, config, model):
-        from multiverso_tpu.parallel import multihost
         CHECK(not model.ftrl,
               "device_plane covers dense/sparse LR (ftrl rides the host "
               "path: KV state is host-control by design)")
-        CHECK(multihost.process_count() <= 1,
-              "device_plane is single-process (device-plane ownership)")
         self.config = config
         self.model = model
         self.table = model.table
@@ -77,11 +79,40 @@ class DeviceWindowTrainer:
 
     # -- host-side window staging -------------------------------------------
 
-    def train_window(self, window):
+    def train_window(self, window, agreed=None):
         """One Window as one donated program dispatch; returns the summed
-        window loss as a DEVICE scalar (fetch-on-format)."""
+        window loss as a DEVICE scalar (fetch-on-format).
+
+        Multi-process (round 4): COLLECTIVE, lockstep windows (the
+        driver's pop protocol feeds finished ranks empty filler windows).
+        Per-process window tensors become shards of batch-sharded global
+        arrays (place_parts); the linear per-batch deltas sum across all
+        processes' batches inside the traced program — exactly the host
+        plane's collective merged Add — and the identical update applies
+        everywhere. ``agreed`` carries the driver-allgathered sparse
+        statics (shared K and key bucket)."""
         cfg = self.config
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.parallel.mesh import (local_device_count,
+                                                  pad_to_multiple)
         nb = max(1, cfg.sync_frequency)
+        nproc = multihost.process_count()
+        if nproc > 1:
+            # multi-process windows are COLLECTIVE with lockstep pops:
+            # the guard fails fast when a caller bypasses the driver's
+            # pop protocol (LogReg._train pop_window), whose absence
+            # would otherwise surface as a silent distributed hang on
+            # ragged shard streams
+            CHECK(agreed is not None,
+                  "multi-process device_plane windows must come through "
+                  "the collective pop protocol (LogReg._train attaches "
+                  "the allgathered statics); direct train_window calls "
+                  "would hang on ragged shard streams")
+            # the stacked batch axis shards P(server) over the WHOLE
+            # mesh: pad the per-process batch count to a local-device
+            # multiple with inert (weight 0, lr 0) batches
+            mesh = self.table.server()._zoo.mesh_ctx.mesh
+            nb = pad_to_multiple(nb, local_device_count(mesh))
         batches = window.batches
         # per-batch decayed lr, ticking ONLY real batches (pad batches get
         # lr 0 -> their whole delta contribution is scaled out)
@@ -92,14 +123,19 @@ class DeviceWindowTrainer:
         self.model._batch_count += len(batches)
         self.model.compute_count += len(batches)
         if cfg.sparse:
-            return self._train_sparse(window, nb, lrs)
+            return self._train_sparse(window, nb, lrs, agreed)
         return self._train_dense(window, nb, lrs)
 
     def _train_dense(self, window, nb: int, lrs: np.ndarray):
         import jax.numpy as jnp
+
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.parallel.mesh import place_parts
         cfg = self.config
+        nproc = multihost.process_count()
+        srv = self.table.server()
         staged = getattr(window, "_staged_dense", None)
-        if staged is None or staged[0] != nb:
+        if staged is None or staged[0] != (nb, nproc):
             B = cfg.minibatch_size
             cdt = jnp.dtype(cfg.compute_type)
             X = np.zeros((nb, B, cfg.input_size), cdt)
@@ -109,36 +145,75 @@ class DeviceWindowTrainer:
                 X[i] = b.dense
                 labels[i] = b.labels
                 weights[i] = b.weights
+            if nproc > 1:
+                # every process's window batches stack into one
+                # batch-sharded scan axis: the summed lr-scaled grads ARE
+                # the collective merged Add (linear server rule)
+                mesh = srv._zoo.mesh_ctx.mesh
+                parts = (place_parts(mesh, X, nproc),
+                         place_parts(mesh, labels, nproc),
+                         place_parts(mesh, weights, nproc))
+            else:
+                parts = (jnp.asarray(X), jnp.asarray(labels),
+                         jnp.asarray(weights))
             # DEVICE-staged: with the epoch cache replaying windows, later
             # epochs skip the host staging AND the upload (lrs re-upload
             # per call — the decay schedule moves)
-            staged = (nb, jnp.asarray(X), jnp.asarray(labels),
-                      jnp.asarray(weights))
+            staged = ((nb, nproc),) + parts
             window._staged_dense = staged
-        srv = self.table.server()
-        program = self._dense_program(nb)
+        if nproc > 1:
+            lrs_g = place_parts(srv._zoo.mesh_ctx.mesh, lrs, nproc)
+            n_total = nproc * nb
+        else:
+            lrs_g = jnp.asarray(lrs)
+            n_total = nb
+        program = self._dense_program(n_total)
         new_state, loss = program(srv.device_state(), staged[1], staged[2],
-                                  staged[3], jnp.asarray(lrs))
+                                  staged[3], lrs_g)
         srv.device_set_state(new_state)
         loss.copy_to_host_async()   # the lagged epoch log finds it landed
         return loss
 
-    def _train_sparse(self, window, nb: int, lrs: np.ndarray):
+    def _train_sparse(self, window, nb: int, lrs: np.ndarray, agreed=None):
         import jax.numpy as jnp
+
+        from multiverso_tpu.parallel import multihost
+        from multiverso_tpu.parallel.mesh import (local_device_count,
+                                                  parts_bucket, place_parts)
         cfg = self.config
         B = cfg.minibatch_size
+        srv = self.table.server()
+        nproc = multihost.process_count()
         keys = window.keys                       # unique, sorted (np.unique)
-        if keys.size == 0:
-            return jnp.float32(0.0)
-        bucket = next_bucket(len(keys))
-        K = max(b.keys.shape[1] for b in window.batches)
+        if nproc > 1:
+            if agreed is None:
+                parts = multihost.host_allgather_objects(
+                    (max((b.keys.shape[1] for b in window.batches),
+                         default=1), len(keys)))
+                agreed = (max(p[0] for p in parts),
+                          max(max(p[1] for p in parts), 1))
+            K = agreed[0]
+            bucket = parts_bucket(agreed[1], local_device_count(srv._mesh))
+            # a filler/empty window still joins the collective round: one
+            # real key (row 0) with all-zero deltas is inert
+            if keys.size == 0:
+                keys = np.zeros(1, np.int64)
+        else:
+            if keys.size == 0:
+                return jnp.float32(0.0)
+            bucket = next_bucket(len(keys))
+            K = max(b.keys.shape[1] for b in window.batches)
         staged = getattr(window, "_staged_sparse", None)
-        if staged is None or staged[0] != (nb, K, bucket):
+        if staged is None or staged[0] != (nb, K, bucket, nproc):
             # window-local remap + K-lane padding on the host (the
             # reader's batches already pad ragged samples with key 0 /
             # mask 0; the window-level K extension uses the same
             # convention so the device program sees exactly the host
-            # path's lane set)
+            # path's lane set). Multi-process, the remapped indices
+            # address THIS process's slice of the global gathered row
+            # block: lane = rank*bucket + local_index.
+            rank = multihost.process_index()
+            base = rank * bucket if nproc > 1 else 0
             bkeys = np.zeros((nb, B, K), np.int32)
             values = np.zeros((nb, B, K), np.float32)
             mask = np.zeros((nb, B, K), np.float32)
@@ -146,24 +221,40 @@ class DeviceWindowTrainer:
             weights = np.zeros((nb, B), np.float32)
             for i, b in enumerate(window.batches):
                 kb = b.keys.shape[1]
-                bkeys[i, :, :kb] = np.searchsorted(keys, b.keys)
-                bkeys[i, :, kb:] = np.searchsorted(keys, 0)
+                bkeys[i, :, :kb] = base + np.searchsorted(keys, b.keys)
+                bkeys[i, :, kb:] = base + np.searchsorted(keys, 0)
                 values[i, :, :kb] = b.values
                 mask[i, :, :kb] = b.mask
                 labels[i] = b.labels
                 weights[i] = b.weights
-            ids = np.full(bucket, -1, np.int32)
-            ids[: len(keys)] = keys.astype(np.int32)
-            staged = ((nb, K, bucket), jnp.asarray(ids), jnp.asarray(bkeys),
-                      jnp.asarray(values), jnp.asarray(mask),
-                      jnp.asarray(labels), jnp.asarray(weights))
+            if nproc > 1:
+                gids = srv.device_place_batch(keys.astype(np.int32),
+                                              bucket=bucket)
+                mesh = srv._mesh
+                arrs = (gids, place_parts(mesh, bkeys, nproc),
+                        place_parts(mesh, values, nproc),
+                        place_parts(mesh, mask, nproc),
+                        place_parts(mesh, labels, nproc),
+                        place_parts(mesh, weights, nproc))
+            else:
+                ids = np.full(bucket, -1, np.int32)
+                ids[: len(keys)] = keys.astype(np.int32)
+                arrs = (jnp.asarray(ids), jnp.asarray(bkeys),
+                        jnp.asarray(values), jnp.asarray(mask),
+                        jnp.asarray(labels), jnp.asarray(weights))
+            staged = ((nb, K, bucket, nproc),) + arrs
             window._staged_sparse = staged
-        srv = self.table.server()
-        program = self._sparse_program(nb, B, K, bucket)
+        if nproc > 1:
+            lrs_g = place_parts(srv._mesh, lrs, nproc)
+            nb_total = nproc * nb
+        else:
+            lrs_g = jnp.asarray(lrs)
+            nb_total = nb
+        program = self._sparse_program(nb_total, B, K,
+                                       bucket * max(nproc, 1), nproc > 1)
         state = dict(srv.state)
         new_state, loss = program(state, staged[1], staged[2], staged[3],
-                                  staged[4], staged[5], staged[6],
-                                  jnp.asarray(lrs))
+                                  staged[4], staged[5], staged[6], lrs_g)
         srv.state = new_state
         loss.copy_to_host_async()   # the lagged epoch log finds it landed
         return loss
@@ -213,10 +304,15 @@ class DeviceWindowTrainer:
         _PROGRAM_CACHE[key] = compiled
         return compiled
 
-    def _sparse_program(self, nb: int, B: int, K: int, bucket: int):
+    def _sparse_program(self, nb: int, B: int, K: int, bucket: int,
+                        parts: bool = False):
+        """``bucket`` is the GLOBAL gathered-row count (nproc * per-rank
+        bucket when ``parts``); ``parts`` switches the gather/update to
+        the collective *_parts verbs (cross-process duplicate keys
+        combine by sum inside the trace)."""
         cfg = self.config
         srv = self.table.server()
-        key = ("lr_sparse", nb, B, K, bucket, cfg.output_size,
+        key = ("lr_sparse", nb, B, K, bucket, parts, cfg.output_size,
                srv.block_rows, srv.store_cols, srv.num_rows,
                type(srv.updater).__name__, cfg.objective_type,
                cfg.regular_type, cfg.regular_coef)
@@ -232,8 +328,12 @@ class DeviceWindowTrainer:
         opt = self._opt
 
         def program(state, ids, bkeys, values, mask, labels, weights, lrs):
-            W_rows = srv.device_gather_rows(state["data"], state["aux"],
-                                            ids)   # (bucket, out)
+            if parts:
+                W_rows = srv.device_gather_rows_parts(
+                    state["data"], state["aux"], ids)  # (nproc*bucket, out)
+            else:
+                W_rows = srv.device_gather_rows(state["data"], state["aux"],
+                                                ids)   # (bucket, out)
 
             def body(acc, x):
                 k, v, m, lab, wt, lr = x
@@ -243,6 +343,9 @@ class DeviceWindowTrainer:
             delta, losses = lax.scan(
                 body, jnp.zeros((bucket, n_out), jnp.float32),
                 (bkeys, values, mask, labels, weights, lrs))
+            if parts:
+                return (srv.device_update_rows_parts(state, ids, delta,
+                                                     opt), jnp.sum(losses))
             return (srv.device_update_rows(state, ids, delta, opt),
                     jnp.sum(losses))
 

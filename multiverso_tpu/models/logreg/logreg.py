@@ -69,6 +69,53 @@ class LogReg:
         if cfg.cache_data:
             from multiverso_tpu.models.logreg.data import WindowCache
             cache = WindowCache(cfg.cache_data_mb)
+        from multiverso_tpu.parallel import multihost
+        collective = (cfg.device_plane and cfg.use_ps
+                      and multihost.process_count() > 1
+                      and getattr(self.model, "_device_trainer",
+                                  None) is not None)
+
+        filler_window = None
+
+        def pop_window(reader):
+            """reader.next_window, multi-process device-plane safe: the
+            window programs are COLLECTIVE, so finished ranks keep
+            joining with empty filler windows (inert: weight-0 batches,
+            lr 0; ONE filler object is reused so its device-staged zero
+            tensors upload once) until every rank's shard is done. One
+            allgather per window also agrees the sparse statics (shared
+            K, key count) and the GLOBAL sample count — the window loss
+            the collective program returns is global, so the per-sample
+            metrics must divide by global samples."""
+            nonlocal filler_window
+            w = reader.next_window()
+            if not collective:
+                return w
+            local_n = (sum(b.count for b in w.batches)
+                       if w is not None else 0)
+            if cfg.sparse:
+                kmax = (max((b.keys.shape[1] for b in w.batches),
+                            default=1) if w is not None else 1)
+                nk = len(w.keys) if w is not None else 0
+            else:
+                kmax = nk = 0
+            parts = multihost.host_allgather_objects(
+                (w is None, kmax, nk, local_n))
+            if all(p[0] for p in parts):
+                return None
+            if w is None:
+                if filler_window is None:
+                    from multiverso_tpu.models.logreg.data import Window
+                    import numpy as np
+                    filler_window = Window(batches=[],
+                                           keys=np.empty(0, np.int64))
+                w = filler_window
+            w._dp_agreed = ((max(p[1] for p in parts),
+                             max(max(p[2] for p in parts), 1))
+                            if cfg.sparse else ())
+            w._global_count = sum(p[3] for p in parts)
+            return w
+
         for epoch in range(cfg.train_epoch):
             reader = (cache.reader(files, cfg, cfg.sync_frequency)
                       if cache is not None
@@ -78,11 +125,14 @@ class LogReg:
             loss_sum = 0.0
             next_report = cfg.show_time_per_sample
             while True:
-                window = reader.next_window()
+                window = pop_window(reader)
                 if window is None:
                     break
                 loss_sum += self.model.train_window(window)
-                samples += sum(b.count for b in window.batches)
+                # collective mode: the returned loss is GLOBAL (all
+                # processes' batches), so count global samples too
+                samples += (window._global_count if collective
+                            else sum(b.count for b in window.batches))
                 if samples >= next_report:
                     Log.Info("[logreg] epoch %d: %d samples, "
                              "%.1f samples/s, avg loss %.5f", epoch, samples,

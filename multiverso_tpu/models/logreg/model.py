@@ -263,7 +263,8 @@ class PSModel(Model):
     def train_window(self, window: Window) -> float:
         if self._device_trainer is not None:
             # whole window in HBM; returns a DEVICE loss scalar
-            return self._device_trainer.train_window(window)
+            return self._device_trainer.train_window(
+                window, agreed=getattr(window, "_dp_agreed", None))
         if self.ftrl:
             return self._train_window_ftrl(window)
         if self.config.sparse:

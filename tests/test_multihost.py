@@ -700,3 +700,73 @@ class TestTwoProcessDevicePairs:
                             for k in range(1, 5)])
             cross = cos(vecs[f"w{5*t}"], vecs[f"w{(5*t + 7) % 20}"])
             assert same > cross, f"topic {t} not learned: {same} {cross}"
+
+
+_LR_DEVICE_CHILD = r'''
+import os, sys
+rank, port, workdir, sparse = (int(sys.argv[1]), sys.argv[2], sys.argv[3],
+                               sys.argv[4] == "sparse")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg.configure import Configure
+from multiverso_tpu.models.logreg.logreg import LogReg
+
+os.chdir(workdir)
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+cfg = Configure(input_size=16, output_size=1, objective_type="sigmoid",
+                updater_type="sgd", learning_rate=0.3, train_epoch=3,
+                minibatch_size=32, use_ps=True, sync_frequency=2,
+                sparse=sparse, device_plane=True, pipeline=False,
+                train_file=f"train_{rank}.data", test_file="test.data",
+                output_model_file="", output_file="",
+                show_time_per_sample=10**9)
+lr = LogReg(cfg)
+lr.Train()
+acc = lr.Test()
+np.save(f"W_{rank}.npy", lr.model.weights())
+mv.MV_Barrier()
+mv.MV_ShutDown()
+assert acc > 0.85, acc
+print(f"child {rank} LRDEV acc {acc:.3f} OK", flush=True)
+'''
+
+
+class TestTwoProcessLogRegDevicePlane:
+    """The LR device plane across two processes (round 4): per-process
+    window tensors shard one global scan axis (dense) or ride the
+    collective *_parts row round (sparse); summed lr-scaled deltas ARE
+    the merged collective Add. Unequal shard sizes exercise the ragged
+    filler-window protocol. Both ranks must end with IDENTICAL weights."""
+
+    @pytest.mark.parametrize("mode", ["dense", "sparse"])
+    def test_lr_device_plane_two_processes(self, tmp_path, mode):
+        rng = np.random.default_rng(0)
+        w_true = rng.normal(size=16)
+
+        def write(path, n, seed):
+            r = np.random.default_rng(seed)
+            X = r.normal(size=(n, 16)).astype(np.float32)
+            y = (X @ w_true > 0).astype(int)
+            with open(path, "w") as f:
+                for row, lab in zip(X, y):
+                    if mode == "sparse":
+                        nz = np.nonzero(row)[0]
+                        f.write(f"{lab} " + " ".join(
+                            f"{k}:{row[k]:.5f}" for k in nz) + "\n")
+                    else:
+                        f.write(f"{lab} " + " ".join(
+                            f"{v:.5f}" for v in row) + "\n")
+
+        write(tmp_path / "train_0.data", 640, 1)
+        write(tmp_path / "train_1.data", 256, 2)   # RAGGED: fewer windows
+        write(tmp_path / "test.data", 400, 3)
+        run_two_process(_LR_DEVICE_CHILD, tmp_path, tmp_path, mode,
+                        expect="LRDEV acc")
+        W0 = np.load(tmp_path / "W_0.npy")
+        W1 = np.load(tmp_path / "W_1.npy")
+        np.testing.assert_array_equal(W0, W1)
