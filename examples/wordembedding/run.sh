@@ -9,3 +9,9 @@ python -m multiverso_tpu.models.wordembedding.distributed \
     -train_file corpus.txt -output vectors.txt \
     -size 64 -epoch 3 -negative 5 -min_count 1 \
     -data_block_size 100000 -is_pipeline 1
+# the TPU-native fused path: pairs derived ON DEVICE from the token
+# stream (all four mode combos; the 6.8x head-to-head configuration)
+python -m multiverso_tpu.models.wordembedding.distributed \
+    -train_file corpus.txt -output vectors_dp.txt \
+    -size 64 -epoch 3 -negative 5 -min_count 1 \
+    -data_block_size 4000000 -is_pipeline 0 -device_pairs 1
