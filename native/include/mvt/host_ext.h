@@ -1,0 +1,36 @@
+// C ABI of the round-4 host-side extensions: the threaded f32 row store
+// (host_store.cc) and the int64 KV slot index (kv_index.cc). ONE
+// declaration site — the sources and the selftest both include this, so
+// a signature change breaks the build instead of silently linking
+// against stale prototypes (C linkage would).
+#ifndef MVT_HOST_EXT_H_
+#define MVT_HOST_EXT_H_
+
+#include <cstdint>
+
+extern "C" {
+
+void* MV_HostStoreNew(int64_t rows, int64_t cols, float sign);
+void MV_HostStoreFree(void* h);
+void MV_HostStoreLoad(void* h, const float* src);
+void MV_HostStoreGetAll(void* h, float* out);
+void MV_HostStoreAddAll(void* h, const float* delta);
+void MV_HostStoreAddRows(void* h, const int32_t* ids, int64_t n,
+                         const float* deltas);
+void MV_HostStoreGetRows(void* h, const int32_t* ids, int64_t n,
+                         float* out);
+
+void* MV_KvIndexNew(int64_t cap_hint);
+void MV_KvIndexFree(void* h);
+int64_t MV_KvIndexSize(void* h);
+void MV_KvIndexLookup(void* h, const int64_t* keys, int64_t n,
+                      int32_t* out);
+void MV_KvIndexInsert(void* h, const int64_t* keys, int64_t n,
+                      int32_t* out);
+void MV_KvIndexItems(void* h, int64_t* out_keys, int32_t* out_slots);
+void MV_KvIndexSetItems(void* h, const int64_t* keys,
+                        const int32_t* slots, int64_t n);
+
+}  // extern "C"
+
+#endif  // MVT_HOST_EXT_H_

@@ -14,6 +14,8 @@
 // (sign +1 default / -1 sgd). Aux-carrying updaters keep the python/XLA
 // path — their state lives in the jax aux pytree.
 
+#include "mvt/host_ext.h"
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>

@@ -11,6 +11,8 @@
 // Single-writer (the engine thread) — no locking. Empty buckets are
 // marked by slot == -1 (keys may be any int64 value).
 
+#include "mvt/host_ext.h"
+
 #include <cstdint>
 #include <cstring>
 #include <vector>

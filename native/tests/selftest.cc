@@ -247,6 +247,72 @@ static void test_io_and_serializable() {
   std::printf("io + serializable OK\n");
 }
 
+// round-4 surfaces: the threaded host row store (pool barrier logic —
+// the section TSAN cares about) and the KV hash index
+#include "mvt/host_ext.h"
+
+static void test_host_store() {
+  // rows*cols large enough to cross the kParallelBytes threshold so the
+  // worker POOL actually runs (the TSAN-relevant path)
+  const int64_t R = 20000, C = 32;
+  void* h = MV_HostStoreNew(R, C, -1.0f);   // sgd sign
+  std::vector<float> full(R * C, 1.0f);
+  MV_HostStoreLoad(h, full.data());
+  std::vector<int32_t> ids(R / 2);
+  for (int64_t i = 0; i < R / 2; ++i) ids[i] = static_cast<int32_t>(2 * i);
+  std::vector<float> deltas(ids.size() * C, 0.5f);
+  MV_HostStoreAddRows(h, ids.data(), ids.size(), deltas.data());
+  std::vector<float> out(ids.size() * C);
+  MV_HostStoreGetRows(h, ids.data(), ids.size(), out.data());
+  for (float v : out) assert(v == 0.5f);           // 1 - 0.5
+  std::vector<float> row1(C);
+  int32_t one = 1;
+  MV_HostStoreGetRows(h, &one, 1, row1.data());
+  for (float v : row1) assert(v == 1.0f);          // untouched row
+  std::vector<float> all(R * C, 0.25f);
+  MV_HostStoreAddAll(h, all.data());
+  MV_HostStoreGetRows(h, &one, 1, row1.data());
+  for (float v : row1) assert(v == 0.75f);         // 1 - 0.25
+  MV_HostStoreFree(h);
+  std::printf("host store (threaded pool) OK\n");
+}
+
+static void test_kv_index() {
+  void* ix = MV_KvIndexNew(4);
+  std::vector<int64_t> keys = {42, -7, 42, 1LL << 60, 0};
+  std::vector<int32_t> slots(keys.size());
+  MV_KvIndexInsert(ix, keys.data(), keys.size(), slots.data());
+  assert(slots[0] == 0 && slots[1] == 1 && slots[2] == 0 &&
+         slots[3] == 2 && slots[4] == 3);           // batch order, dups share
+  assert(MV_KvIndexSize(ix) == 4);
+  // growth keeps assignments
+  std::vector<int64_t> many(5000);
+  std::vector<int32_t> mslots(many.size());
+  for (size_t i = 0; i < many.size(); ++i) many[i] = 1000 + 3 * i;
+  MV_KvIndexInsert(ix, many.data(), many.size(), mslots.data());
+  std::vector<int32_t> again(many.size());
+  MV_KvIndexLookup(ix, many.data(), many.size(), again.data());
+  for (size_t i = 0; i < many.size(); ++i) assert(again[i] == mslots[i]);
+  int64_t missing = 999999999;
+  int32_t miss_slot;
+  MV_KvIndexLookup(ix, &missing, 1, &miss_slot);
+  assert(miss_slot == -1);
+  // items/set_items roundtrip
+  const int64_t n = MV_KvIndexSize(ix);
+  std::vector<int64_t> ik(n);
+  std::vector<int32_t> is(n);
+  MV_KvIndexItems(ix, ik.data(), is.data());
+  void* ix2 = MV_KvIndexNew(4);
+  MV_KvIndexSetItems(ix2, ik.data(), is.data(), n);
+  assert(MV_KvIndexSize(ix2) == n);
+  std::vector<int32_t> again2(keys.size());
+  MV_KvIndexLookup(ix2, keys.data(), keys.size(), again2.data());
+  for (size_t i = 0; i < keys.size(); ++i) assert(again2[i] == slots[i]);
+  MV_KvIndexFree(ix);
+  MV_KvIndexFree(ix2);
+  std::printf("kv index OK\n");
+}
+
 int main() {
   test_utils();
   test_async_tables();
@@ -254,6 +320,8 @@ int main() {
   test_updaters();
   test_reader();
   test_io_and_serializable();
+  test_host_store();
+  test_kv_index();
   std::printf("ALL NATIVE TESTS OK\n");
   return 0;
 }

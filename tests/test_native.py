@@ -46,9 +46,10 @@ class TestSanitizers:
         if build.returncode != 0 and ("sanitize" in err or "asan" in err):
             pytest.skip(f"toolchain lacks ASan: {build.stderr[-200:]}")
         assert build.returncode == 0, build.stderr[-2000:]
+        env = dict(os.environ, MVT_HOST_STORE_THREADS="8")
         result = subprocess.run(
             [os.path.join(native_build, "mvt_selftest_asan")],
-            capture_output=True, text=True, timeout=240)
+            capture_output=True, text=True, timeout=240, env=env)
         assert result.returncode == 0, result.stdout + result.stderr
         assert "ALL NATIVE TESTS OK" in result.stdout
 
@@ -66,9 +67,13 @@ class TestSanitizers:
             # this target" / missing libtsan — environment, not a failure
             pytest.skip(f"toolchain lacks TSAN: {build.stderr[-200:]}")
         assert build.returncode == 0, build.stderr[-2000:]
+        # force the host store's worker pool on (hardware_concurrency is 1
+        # on this host, which would leave the pool-barrier code — the part
+        # TSAN exists to check — unexercised)
+        env = dict(os.environ, MVT_HOST_STORE_THREADS="8")
         result = subprocess.run(
             [os.path.join(native_build, "mvt_selftest_tsan")],
-            capture_output=True, text=True, timeout=240)
+            capture_output=True, text=True, timeout=240, env=env)
         assert result.returncode == 0, result.stdout + result.stderr
         assert "WARNING: ThreadSanitizer" not in result.stderr
         assert "ALL NATIVE TESTS OK" in result.stdout
