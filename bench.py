@@ -912,6 +912,14 @@ def main() -> int:
 
     def fill_we_app(wps):
         out["we_app_words_per_sec"] = round(wps)
+        if out.get("platform") == "tpu":
+            out["we_app_note"] = (
+                "on the axon tunnel the app is UPLOAD-bound: each "
+                "block's token stream crosses the measured 4-9 MB/s "
+                "tunnel link (~0.2-0.5s for this corpus's one block), "
+                "bounding the app at roughly 300-600k words/s whatever "
+                "the device does — run-to-run spread (280-590k observed) "
+                "tracks tunnel load, not device speed")
 
     def fill_lr_app(sps):
         out["lr_app_samples_per_sec"] = round(sps)
