@@ -1027,16 +1027,20 @@ def _cpu_backend_host_numbers() -> dict:
             out[key.replace("_Melem_s", "_cpu_Melem_s")] = val
         elif key.endswith("_x"):
             out[key.replace("_x", "_cpu_x")] = val
+        elif key == "lr_app_samples_per_sec":
+            out["lr_app_cpu_samples_per_sec"] = val
         elif key == "host_scaling_config":
             out[key] = val
     return out
 
 
 def host_section_main() -> int:
-    """MVT_BENCH_SECTION=host: host-plane protocol metrics only (runs on
-    the CPU backend via MVT_BENCH_CPU=1). KV and sparse-matrix twins ride
-    along so their protocol cost is separable from the tunnel RTT the
-    TPU-run numbers fold in."""
+    """MVT_BENCH_SECTION=host: the CPU-backend comparison subprocess
+    (MVT_BENCH_CPU=1) — host-plane protocol metrics plus the KV,
+    sparse-matrix, and LR-app twins, so each TPU-run number's tunnel
+    cost is separable from its protocol cost. The app twin trains the
+    real model and is therefore guarded: its failure must not discard
+    the protocol numbers computed before it."""
     _init_jax_guarded()
     import numpy as np
     rng = np.random.default_rng(0)
@@ -1049,6 +1053,13 @@ def host_section_main() -> int:
                                               1)
     kv_host_me, _ = bench_kv_table(np, rng, device=False)
     out["kv_push_pull_Melem_s"] = round(kv_host_me, 1)
+    try:
+        out["lr_app_samples_per_sec"] = round(bench_lr_app(np, rng))
+    except SystemExit:      # bench_lr_app's _fail: record, don't discard
+        out.setdefault("section_errors", []).append(
+            "lr_app (cpu): convergence/bench failure")
+    except Exception as exc:  # pragma: no cover - env hiccups
+        out.setdefault("section_errors", []).append(f"lr_app (cpu): {exc!r}")
     print(json.dumps(out))
     return 0
 
