@@ -63,7 +63,9 @@ class Configure:
     # TPU-native extension 3: train whole windows as one jit'd program
     # consuming the PS tables' HBM storage directly (the WE -device_pairs
     # pattern; models/logreg/device_plane.py). Requires use_ps; dense and
-    # sparse objectives; single-process.
+    # sparse objectives. Multi-process worlds train COLLECTIVELY:
+    # lockstep windows with filler for ragged shard streams
+    # (device_plane.py docstring).
     device_plane: bool = False
     # TPU-native extension 4: parse-once epoch cache (data.py WindowCache)
     # — epoch 2+ replay the identical window sequence from memory instead

@@ -159,7 +159,12 @@ def _iter_samples_dense_fast(path: str, config) -> Iterator:
             continue
         rows = None
         try:
-            rows = np.loadtxt(io.BytesIO(text), dtype=np.float32, ndmin=2)
+            # comments=None: '#' must not act as a comment delimiter — a
+            # truncated-at-'#' line whose prefix still has width columns
+            # would silently parse differently from parse_line; with
+            # comments off such lines raise and take the fallback
+            rows = np.loadtxt(io.BytesIO(text), dtype=np.float32, ndmin=2,
+                              comments=None)
         except ValueError:
             pass                       # ragged chunk: precise path below
         if rows is not None and rows.shape[1] == width:
@@ -168,6 +173,10 @@ def _iter_samples_dense_fast(path: str, config) -> Iterator:
                 yield (int(labels[i]), 1.0, _EMPTY_KEYS, rows[i, 1:])
         else:
             for line in text.decode().splitlines():
+                if line.lstrip().startswith("#"):
+                    continue   # full-line comments skip (loadtxt's old
+                               # behavior); a mid-line '#' still errors
+                               # precisely in parse_line
                 parsed = parse_line(line, config.input_size, False, False)
                 if parsed is not None:
                     yield parsed

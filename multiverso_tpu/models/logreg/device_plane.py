@@ -76,6 +76,87 @@ class DeviceWindowTrainer:
         self.model = model
         self.table = model.table
         self._opt = AddOption().as_jnp()
+        # Device-staging budget: windows cache their uploaded sample
+        # tensors on the Window objects the host-side WindowCache keeps
+        # alive across epochs — those bytes are pinned in ACCELERATOR
+        # memory, which the host-side cache_data_mb budget says nothing
+        # about. Track them separately (weakly keyed by window, so
+        # transient windows that die release their accounting and a
+        # replaced attachment replaces its bytes) and stop attaching past
+        # a budget derived from THIS process's device capacity (overflow
+        # windows simply re-upload each epoch, like a budget-blown host
+        # cache streams).
+        # id-keyed (Window is unhashable); weakref.finalize releases an
+        # entry when its window dies; a running total keeps the budget
+        # check O(1) per attach
+        self._staged_live: dict = {}
+        self._staged_total = 0
+        self._staged_budget = self._device_staging_budget()
+
+    @property
+    def _staged_bytes(self) -> int:
+        """Per-device bytes currently pinned by LIVE window attachments."""
+        return self._staged_total
+
+    def _release_staged(self, wid: int) -> None:
+        """Drop a window's accounting entry (finalizer + decline path);
+        idempotent — a window may register two finalizers across a
+        release/re-attach cycle."""
+        n = self._staged_live.pop(wid, None)
+        if n:
+            self._staged_total -= n
+
+    @staticmethod
+    def _device_staging_budget() -> int:
+        """Per-device bytes the epoch cache may pin: a quarter of this
+        process's device memory, or a conservative 1GB when the backend
+        doesn't report (CPU backend reports nothing; real HBM dwarfs
+        1GB). local_devices: in a multi-process world jax.devices()[0]
+        may be another process's non-addressable chip."""
+        import jax
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                return max(limit // 4, 64 << 20)
+        except Exception:
+            pass
+        return 1 << 30
+
+    @staticmethod
+    def _per_device_bytes(a) -> int:
+        """Bytes ONE device holds of ``a``: global arrays spread nbytes
+        over their device set (the budget is per-device HBM), replicated
+        arrays cost full size per device."""
+        nbytes = getattr(a, "nbytes", 0)
+        try:
+            if not a.is_fully_replicated:
+                return nbytes // max(1, len(a.sharding.device_set))
+        except Exception:
+            pass
+        return nbytes
+
+    def _attach_staged(self, window, attr: str, staged: tuple) -> None:
+        """Pin ``staged`` on the window for epoch replay only while the
+        device-staging budget holds; past it the window trains from the
+        local arrays and re-uploads next epoch."""
+        import weakref
+        nbytes = sum(self._per_device_bytes(a) for a in staged[1:])
+        wid = id(window)
+        prev = self._staged_live.get(wid, 0)
+        if self._staged_total - prev + nbytes <= self._staged_budget:
+            setattr(window, attr, staged)
+            if wid not in self._staged_live:
+                weakref.finalize(window, self._release_staged, wid)
+            self._staged_total += nbytes - prev
+            self._staged_live[wid] = nbytes
+        elif prev:
+            # declined REPLACEMENT (meta drifted, e.g. the shared filler
+            # window's per-slot K): the stale attachment is unusable dead
+            # weight — actually release it so 'overflow re-uploads' holds
+            self._release_staged(wid)
+            if hasattr(window, attr):
+                delattr(window, attr)
 
     # -- host-side window staging -------------------------------------------
 
@@ -158,9 +239,10 @@ class DeviceWindowTrainer:
                          jnp.asarray(weights))
             # DEVICE-staged: with the epoch cache replaying windows, later
             # epochs skip the host staging AND the upload (lrs re-upload
-            # per call — the decay schedule moves)
+            # per call — the decay schedule moves); attachment is bounded
+            # by the device-staging budget (_attach_staged)
             staged = ((nb, nproc),) + parts
-            window._staged_dense = staged
+            self._attach_staged(window, "_staged_dense", staged)
         if nproc > 1:
             lrs_g = place_parts(srv._zoo.mesh_ctx.mesh, lrs, nproc)
             n_total = nproc * nb
@@ -243,7 +325,7 @@ class DeviceWindowTrainer:
                         jnp.asarray(values), jnp.asarray(mask),
                         jnp.asarray(labels), jnp.asarray(weights))
             staged = ((nb, K, bucket, nproc),) + arrs
-            window._staged_sparse = staged
+            self._attach_staged(window, "_staged_sparse", staged)
         if nproc > 1:
             lrs_g = place_parts(srv._mesh, lrs, nproc)
             nb_total = nproc * nb

@@ -166,7 +166,10 @@ class LogReg:
             mv.MV_Barrier()
         if self.output_model_file:
             self.SaveModel()
-        return avg_loss
+        # API boundary: device_plane windows return 0-d jax arrays, so
+        # avg_loss may be a device scalar here — convert (one already-
+        # landed copy; the harvest threads overlapped the fetch)
+        return float(avg_loss)
 
     def Test(self, test_file: Optional[str] = None) -> float:
         """Score the test set; writes per-sample predictions to

@@ -49,8 +49,9 @@ class Option:
     # the block uploads only the subsampled token stream (~80x smaller
     # than the stacked pair tensors) and one fused program expands
     # windows/negatives and trains in place on the tables
-    # (device_pairs.py). All four mode combos (skipgram/cbow x NEG/HS);
-    # single-process.
+    # (device_pairs.py). All four mode combos (skipgram/cbow x NEG/HS).
+    # Multi-process worlds train COLLECTIVELY: lockstep blocks with
+    # filler for ragged shard streams (device_pairs.py docstring).
     device_pairs: bool = False
     # force a jax platform ("cpu"/"tpu"); "" = jax default. Applied by
     # main() before the first backend touch (env JAX_PLATFORMS is not

@@ -316,9 +316,16 @@ class KvIndex:
         return keys[:n], slots[:n]
 
     def set_items(self, keys: np.ndarray, slots: np.ndarray) -> None:
-        """Replace contents (keys must be unique)."""
+        """Replace contents (keys must be unique; slots must be a
+        permutation of 0..n-1 — the native side tracks one next-slot
+        counter, so gapped slot sets would make items() return
+        uninitialized tail entries)."""
         keys = np.ascontiguousarray(keys, np.int64)
         slots = np.ascontiguousarray(slots, np.int32)
         if len(keys) != len(slots):
             raise ValueError("keys/slots length mismatch")
+        if len(slots) and not np.array_equal(
+                np.sort(slots), np.arange(len(slots), dtype=np.int32)):
+            raise ValueError("set_items slots must be a permutation of "
+                             "0..n-1 (native used counter is next-slot)")
         self._h.MV_KvIndexSetItems(self._ptr, keys, slots, len(keys))
