@@ -221,9 +221,17 @@ class PSModel(Model):
         self._pending_get: Optional[int] = None   # pipelined pull handle
         self._device_trainer = None
         if config.device_plane:
-            from multiverso_tpu.models.logreg.device_plane import (
-                DeviceWindowTrainer)
-            self._device_trainer = DeviceWindowTrainer(config, self)
+            from multiverso_tpu.parallel import multihost
+            if self.ftrl and multihost.process_count() > 1:
+                # ftrl's two-table KV window program is single-process;
+                # multi-process worlds ride the collective host verbs
+                # (which already merge across ranks)
+                Log.Info("ftrl device_plane: multi-process world rides "
+                         "the collective host KV verbs")
+            else:
+                from multiverso_tpu.models.logreg.device_plane import (
+                    DeviceWindowTrainer)
+                self._device_trainer = DeviceWindowTrainer(config, self)
         if config.init_model_file:
             self.Load(config.init_model_file)
             self._push_initial_model()

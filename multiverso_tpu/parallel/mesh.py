@@ -213,4 +213,7 @@ class MeshContext:
         if arr.is_fully_addressable or arr.is_fully_replicated:
             return np.asarray(arr)
         from jax.experimental import multihost_utils
+
+        from multiverso_tpu.parallel import multihost
+        multihost.note_collective()
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

@@ -93,16 +93,54 @@ class ArrayServer(ServerTable):
         # multihost: one logical Add is issued collectively by every
         # process; summing the per-process deltas first gives the reference
         # semantics (every worker's Add accumulates, src/server.cpp:48-58)
-        # — identity in a single-process job
+        # — identity in a single-process job. (The windowed engine routes
+        # multi-process Adds through ProcessAddParts instead — this
+        # collective remains for the BSP engine and direct callers.)
         values = multihost.sum_collective_add(option, values)
+        self._apply_summed(values, option)
+
+    def _apply_summed(self, values: np.ndarray, option: AddOption) -> None:
         if self.padded != self.size:
             values = np.pad(values, (0, self.padded - self.size))
         delta = self._zoo.mesh_ctx.place(values, self._sharding)
         self.state = self._update(self.state, delta, option.as_jnp())
 
+    def ProcessAddParts(self, parts, my_rank: int) -> None:
+        """Windowed-engine collective Add: every rank's payload arrived
+        through the one window exchange — sum them here with NO further
+        host collective (multihost.py sum_collective_add semantics)."""
+        opts = [p.get("option") for p in parts]
+        CHECK(all(o == opts[0] for o in opts),
+              f"collective Add options diverge across processes: {opts}")
+        vals = []
+        for p in parts:
+            v = np.asarray(p["values"], self.dtype).ravel()
+            CHECK(v.size == self.size, "Add size mismatch")
+            vals.append(v)
+        summed = np.sum(vals, axis=0).astype(self.dtype)
+        self._apply_summed(summed, opts[my_rank] or AddOption())
+
     def ProcessGet(self, option: GetOption) -> np.ndarray:
+        if multihost.process_count() > 1:
+            # replicate through XLA (ICI) so every rank reads the full
+            # table locally — no host-collective reassembly round
+            return self._replicated_full()[: self.size].copy()
         out = self._access(self.state, None)
         return self._zoo.mesh_ctx.fetch(out)[: self.size]
+
+    def _replicated_full(self) -> np.ndarray:
+        if not hasattr(self, "_access_repl"):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._access_repl = jax.jit(
+                self.device_access,
+                out_shardings=NamedSharding(self._zoo.mesh_ctx.mesh, P()))
+        return np.asarray(self._access_repl(self.state, None))
+
+    def ProcessGetWindowParts(self, positions, my_rank: int):
+        """Every array Get is the whole table: one replicated read serves
+        the whole window segment (cross-rank get-dedup)."""
+        full = self._replicated_full()[: self.size]
+        return [full.copy() for _ in positions]
 
     def ProcessGetAsync(self, option: GetOption = None):
         if multihost.process_count() > 1:

@@ -87,6 +87,47 @@ class ServerTable:
         this method fails the whole run, with no per-message fallback."""
         return False
 
+    # -- multi-process WINDOW protocol hooks (sync/server.py windowed
+    # engine, round 5): the engine exchanges a whole window of verbs in
+    # ONE host collective and hands every rank's payloads down, so table
+    # code on every rank sees identical merged data and must NOT issue
+    # its own host collectives inside these hooks (device programs —
+    # shard_map/psum over the global mesh — are fine and expected).
+    # DETERMINISM CONTRACT: given identical ``parts``, every rank must
+    # make identical mutate-or-raise decisions, or replicated/sharded
+    # state diverges. The defaults fall back to the table's own
+    # single-verb processing of THIS rank's payload — safe for custom
+    # tables because the engine calls the hooks in lockstep positions,
+    # so any collectives such a table issues internally still match.
+
+    def ProcessAddParts(self, parts, my_rank: int) -> None:
+        """Apply ONE logical collective Add given every rank's payload
+        dict in rank order (``parts[my_rank]`` is this rank's own)."""
+        self.ProcessAdd(**parts[my_rank])
+
+    def ProcessGetParts(self, parts, my_rank: int):
+        """Serve ONE logical collective Get for THIS rank given every
+        rank's payload dict in rank order; returns this rank's result."""
+        return self.ProcessGet(**parts[my_rank])
+
+    def ProcessAddRunParts(self, positions, my_rank: int) -> bool:
+        """Cross-rank add-coalescing: ``positions`` is a list over window
+        positions of per-rank payload-dict lists (one logical collective
+        Add each). Apply them ALL as merged dispatch(es) and return True,
+        or False to decline (the engine then runs ProcessAddParts per
+        position). Same validate-before-mutate contract as
+        ProcessAddRun."""
+        return False
+
+    def ProcessGetWindowParts(self, positions, my_rank: int):
+        """Cross-rank get-dedup: serve a window segment's Gets to this
+        table in one shot. ``positions`` is a list over window positions
+        of per-rank payload-dict lists. Return a list of this rank's
+        results (one per position; an Exception entry fails that
+        position's request only), or None to decline (per-position
+        ProcessGetParts then runs)."""
+        return None
+
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:
         raise NotImplementedError

@@ -106,9 +106,12 @@ class KVServerTable(ServerTable):
         # (~6ms/pair measured); device-plane reads sync pending host
         # writes back, ANY assignment to ``_values`` (the property
         # setter) drops the mirror. A live mirror is ALWAYS fresh;
-        # ``_np_dirty`` marks device-side staleness only.
-        self._host_values_ok = (jax.default_backend() == "cpu"
-                                and multihost.process_count() <= 1)
+        # ``_np_dirty`` marks device-side staleness only. Multi-process
+        # (round 5): the mirror is REPLICATED per rank — every host verb
+        # reaches it as identically merged (keys, deltas) through the
+        # windowed engine's parts paths / merge_collective_add, so the
+        # replicas evolve in lockstep and Gets serve locally.
+        self._host_values_ok = jax.default_backend() == "cpu"
 
         def _scatter_add(values, slots, deltas):
             return values.at[slots].add(deltas)
@@ -279,8 +282,29 @@ class KVServerTable(ServerTable):
         # multihost: merge every process's (keys, values) of this
         # collective Add — concatenation order is process order, so slot
         # creation (and therefore the whole index) evolves identically on
-        # all hosts (identity single-process)
+        # all hosts (identity single-process; the windowed engine routes
+        # multi-process Adds through ProcessAddParts instead)
         keys, deltas = multihost.merge_collective_add(option, keys, deltas)
+        self._apply_merged_kv(keys, deltas)
+
+    def ProcessAddParts(self, parts, my_rank: int) -> None:
+        """Windowed-engine collective Add: rank-order concatenation of
+        the exchanged per-rank (keys, values) — the same index evolution
+        merge_collective_add produced, with no collective here."""
+        opts = [p.get("option") for p in parts]
+        CHECK(all(o == opts[0] for o in opts),
+              f"collective Add options diverge across processes: {opts}")
+        all_keys, all_deltas = [], []
+        for p in parts:
+            k = np.asarray(p["keys"], np.int64).ravel()
+            d = np.asarray(p["values"], self.dtype).ravel()
+            CHECK(k.size == d.size, "kv add size mismatch")
+            all_keys.append(k)
+            all_deltas.append(d)
+        self._apply_merged_kv(np.concatenate(all_keys),
+                              np.concatenate(all_deltas))
+
+    def _apply_merged_kv(self, keys: np.ndarray, deltas: np.ndarray) -> None:
         slots = self._slots_for(keys, create=True)
         npv = self._np_values()
         if npv is not None:
@@ -299,17 +323,31 @@ class KVServerTable(ServerTable):
                                              jnp.asarray(pad_deltas))
 
     def ProcessGet(self, keys: np.ndarray,
-                   option: Optional[GetOption] = None) -> np.ndarray:
+                   option: Optional[GetOption] = None,
+                   _union: Optional[np.ndarray] = None) -> np.ndarray:
+        """``_union``: a caller that already knows every process's key
+        set of this collective Get (the windowed engine's parts hooks)
+        passes the precomputed union so no key collective runs here."""
         keys = np.asarray(keys, np.int64).ravel()
-        union = (multihost.union_collective_ids(keys)
-                 if not self._host_backed else None)
+        npv = self._np_values()
+        if npv is not None and multihost.process_count() > 1:
+            # replicated mirror: serve locally — no union round, no
+            # device program (the mirror evolves in lockstep everywhere)
+            slots = self._slots_for(keys, create=False)
+            out = npv[np.where(slots < 0, 0, slots)]
+            out[slots < 0] = 0
+            return out
+        union = _union
+        if union is None:
+            union = (multihost.union_collective_ids(keys)
+                     if not self._host_backed else None)
         if union is not None:
             # collective Get over possibly different key sets: gather the
-            # union with one identical device program, slice ours out
+            # union with one identical device program (replicated out —
+            # the fetch is local), slice ours out
             union_slots = self._slots_for(union, create=False)
             padded = self._pad_slots(union_slots)
-            vals = self._zoo.mesh_ctx.fetch(
-                self._gather(self._values, jnp.asarray(padded)))
+            vals = np.asarray(self._gather_replicated(padded))
             u_out = vals[: len(union_slots)].copy()
             u_out[union_slots < 0] = 0
             return u_out[np.searchsorted(union, keys)]
@@ -328,6 +366,58 @@ class KVServerTable(ServerTable):
         out = vals[: len(slots)].copy()
         out[slots < 0] = 0  # absent keys read as default-constructed (0)
         return out
+
+    def _gather_replicated(self, padded_slots: np.ndarray):
+        """values[slots] with a REPLICATED output — every host reads the
+        result locally (XLA moves the bytes over ICI; no host-collective
+        reassembly)."""
+        if not hasattr(self, "_gather_repl"):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def _gather(values, slots):
+                return values[slots]
+
+            self._gather_repl = jax.jit(
+                _gather, out_shardings=NamedSharding(
+                    self._zoo.mesh_ctx.mesh, P()))
+        return self._gather_repl(self._synced_values(),
+                                 jnp.asarray(padded_slots))
+
+    def ProcessGetParts(self, parts, my_rank: int):
+        """One collective Get from exchanged parts: union known locally."""
+        if self._host_backed:
+            return self.ProcessGet(**parts[my_rank])
+        all_keys = [np.asarray(p["keys"], np.int64).ravel() for p in parts]
+        union = np.unique(np.concatenate(all_keys))
+        return self.ProcessGet(all_keys[my_rank],
+                               parts[my_rank].get("option"), _union=union)
+
+    def ProcessGetWindowParts(self, positions, my_rank: int):
+        """Cross-rank get-dedup: one union gather (or the replicated
+        mirror) serves every Get position of the window segment."""
+        if self._host_backed:
+            return None     # host-resident values: per-position is local
+        npv = self._np_values()
+        if npv is not None and multihost.process_count() > 1:
+            out = []
+            for parts in positions:
+                keys = np.asarray(parts[my_rank]["keys"], np.int64).ravel()
+                slots = self._slots_for(keys, create=False)
+                vals = npv[np.where(slots < 0, 0, slots)]
+                vals[slots < 0] = 0
+                out.append(vals)
+            return out
+        pos_keys = [[np.asarray(p["keys"], np.int64).ravel() for p in parts]
+                    for parts in positions]
+        union = np.unique(np.concatenate(
+            [k for rank_keys in pos_keys for k in rank_keys]))
+        union_slots = self._slots_for(union, create=False)
+        padded = self._pad_slots(union_slots)
+        vals = np.asarray(self._gather_replicated(padded))
+        u_out = vals[: len(union_slots)].copy()
+        u_out[union_slots < 0] = 0
+        return [u_out[np.searchsorted(union, rank_keys[my_rank])]
+                for rank_keys in pos_keys]
 
     # -- device plane (matrix_table device_* counterpart) -------------------
     # A mesh-resident worker resolves its key batch ONCE on host

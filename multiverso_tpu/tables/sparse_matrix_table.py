@@ -148,15 +148,18 @@ class SparseMatrixServerTable(MatrixServerTable):
         # the inherited matrix fast path would bypass the dirty protocol
         return None
 
-    def ProcessGet(self, option: GetOption,
-                   row_ids=None) -> Tuple[np.ndarray, np.ndarray]:
-        """Returns (row_ids, rows) — the server decides which rows move."""
+    def ProcessGet(self, option: GetOption, row_ids=None,
+                   _parts=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (row_ids, rows) — the server decides which rows move.
+        ``_parts``: every rank's (worker_id, ids) when the windowed
+        engine already exchanged them (no collective here then)."""
         worker_id = option.worker_id if option is not None else -1
         ids = None if row_ids is None else np.asarray(row_ids, np.int64)
         out_ids = None
         part_outs = []
-        for rank, (wid, part_ids) in enumerate(
-                self._allgather_parts((worker_id, ids))):
+        if _parts is None:
+            _parts = self._allgather_parts((worker_id, ids))
+        for rank, (wid, part_ids) in enumerate(_parts):
             gwid = self._gwid(rank, wid)
             part_out = self._update_get_state(-1 if gwid is None else gwid,
                                               part_ids)
@@ -170,6 +173,35 @@ class SparseMatrixServerTable(MatrixServerTable):
         rows = super().ProcessGet(GetOption(worker_id=worker_id),
                                   row_ids=out_ids, _union=union)
         return out_ids, rows
+
+    # -- windowed-engine parts hooks (round 5) ------------------------------
+
+    def ProcessGetParts(self, parts, my_rank: int):
+        """Run the freshness protocol from the exchanged parts — the
+        same every-rank-in-rank-order transitions, no collective."""
+        decoded = []
+        for q in parts:
+            qopt = q.get("option")
+            qids = q.get("row_ids")
+            decoded.append((qopt.worker_id if qopt is not None else -1,
+                            None if qids is None
+                            else np.asarray(qids, np.int64)))
+        p = parts[my_rank]
+        return self.ProcessGet(p.get("option"), row_ids=p.get("row_ids"),
+                               _parts=decoded)
+
+    def ProcessGetWindowParts(self, positions, my_rank: int):
+        """Sparse Gets MUTATE the freshness bits, so a window segment's
+        Gets serve strictly in position order (each from its exchanged
+        parts — still zero host collectives; the data gathers are the
+        replicated-out row programs)."""
+        out = []
+        for parts in positions:
+            try:
+                out.append(self.ProcessGetParts(parts, my_rank))
+            except Exception as exc:
+                out.append(exc)
+        return out
 
 
 class SparseMatrixWorkerTable(MatrixWorkerTable):

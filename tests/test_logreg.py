@@ -340,15 +340,25 @@ class TestDevicePlane:
                                      sync_frequency=2, device_plane=True)
         assert acc > 0.85
 
-    def test_ftrl_rejected(self, sparse_binary):
-        from multiverso_tpu.utils.log import FatalError
-        from multiverso_tpu.zoo import Zoo
-        cfg = _config(sparse_binary, input_size=50, output_size=1,
-                      use_ps=True, objective_type="ftrl",
-                      device_plane=True)
-        with pytest.raises(FatalError):
-            LogReg(cfg)
-        assert not Zoo.Get().started   # guard brought the world down
+    def test_ftrl_matches_host_plane(self, sparse_binary):
+        """FTRL device plane (round 5): the two-table (z, n) KV window
+        program must track the host KV-verb path — same window-start
+        state convention, same negated-accumulator pushes."""
+        W_h, acc_h = self._final_weights(sparse_binary, input_size=50,
+                                         output_size=1, sparse=True,
+                                         objective_type="ftrl",
+                                         alpha=1.0, beta=1.0,
+                                         lambda1=0.01, lambda2=0.01,
+                                         sync_frequency=5)
+        W_d, acc_d = self._final_weights(sparse_binary, input_size=50,
+                                         output_size=1, sparse=True,
+                                         objective_type="ftrl",
+                                         alpha=1.0, beta=1.0,
+                                         lambda1=0.01, lambda2=0.01,
+                                         sync_frequency=5,
+                                         device_plane=True)
+        np.testing.assert_allclose(W_d, W_h, rtol=1e-4, atol=1e-6)
+        assert acc_d > 0.8 and abs(acc_d - acc_h) < 0.02
 
 
 class TestReaderFastPaths:

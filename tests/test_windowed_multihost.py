@@ -1,0 +1,243 @@
+"""Windowed multi-process engine protocol (round 5; sync/server.py).
+
+The r4 engine took the strict path for any ``nproc > 1`` world: every
+table verb ran its own host collective (~2 allgather rounds per verb)
+and every single-process window optimization (add-coalescing, get-dedup,
+merged runs, native mirror) was disabled. The windowed protocol
+exchanges a whole engine window in ONE allgather and re-enables all of
+them across ranks. These tests drive the new surface with 2-process
+jax.distributed worlds (tests/test_multihost.py run_two_process
+pattern):
+
+* burst coalescing — fire-and-forget Add bursts from both ranks merge
+  into few dispatches; the result matches the sequential oracle;
+* the collective-count contract itself — host collective rounds per
+  verb must sit far below the r4 cost of ~2/verb (the round-5 VERDICT
+  metric);
+* the replicated native mirror — CPU-backend matrix tables ride the
+  GIL-free host store in 2-process worlds now;
+* compressed wire across processes — a 2-proc sparse-compressed Add
+  stream applies bit-identically to an uncompressed twin (VERDICT #3);
+* deterministic failure — an invalid payload at one rank fails that
+  collective position on BOTH ranks (the r4 design would deadlock: the
+  bad rank replied early while the good rank entered the merge
+  allgather alone).
+"""
+
+import numpy as np
+
+from tests.test_multihost import run_two_process
+
+_BURST_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.zoo import Zoo
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+R, C, K, ROUNDS = 500, 8, 40, 12
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+arr = mv.MV_CreateTable(ArrayTableOption(size=32))
+
+rng = np.random.default_rng(7 + rank)
+ids_pool = [np.sort(rng.choice(R, K, replace=False)).astype(np.int32)
+            for _ in range(ROUNDS)]
+deltas_pool = [rng.standard_normal((K, C)).astype(np.float32)
+               for _ in range(ROUNDS)]
+
+# warm one verb of each kind, then count collectives over the burst
+mat.AddRows(ids_pool[0], deltas_pool[0])
+mat.GetRows(ids_pool[0])
+arr.Add(np.ones(32, np.float32))
+arr.Get()
+base = dict(multihost.STATS)
+verbs = 0
+# burst: interleaved fire-and-forget adds + async gets on two tables —
+# the engine windows coalesce them; strict r4 would pay ~2 collectives
+# per verb
+handles = []
+for i in range(1, ROUNDS):
+    mat.AddFireForget(deltas_pool[i], row_ids=ids_pool[i])
+    arr.AddFireForget(np.full(32, 0.5, np.float32))
+    handles.append(mat.GetAsyncHandle(row_ids=ids_pool[i]))
+    verbs += 3
+for h in handles:
+    mat.Wait(h)
+final_rows = mat.GetRows(np.arange(R, dtype=np.int32)); verbs += 1
+final_arr = arr.Get(); verbs += 1
+used = multihost.STATS["host_collective_rounds"] - base["host_collective_rounds"]
+per_verb = used / verbs
+# r4 strict cost ~2/verb; the windowed protocol must be at least 4x off
+assert per_verb < 0.5, (used, verbs, per_verb)
+
+# oracle: both ranks' adds all land (sum over ranks and rounds)
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(7 + r)
+    oids = [np.sort(orng.choice(R, K, replace=False)).astype(np.int32)
+            for _ in range(ROUNDS)]
+    odeltas = [orng.standard_normal((K, C)).astype(np.float32)
+               for _ in range(ROUNDS)]
+    for i in range(ROUNDS):
+        np.add.at(oracle, oids[i], odeltas[i])
+np.testing.assert_allclose(final_rows, oracle, rtol=1e-4, atol=1e-4)
+assert np.allclose(final_arr, 1.0 * 2 + 0.5 * 2 * (ROUNDS - 1))
+
+# the engine actually windowed: exchanges < verbs processed
+srv = Zoo.Get().server_engine
+assert srv.mh_window_verbs >= verbs, (srv.mh_window_verbs, verbs)
+assert srv.mh_window_exchanges < srv.mh_window_verbs, (
+    srv.mh_window_exchanges, srv.mh_window_verbs)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} BURST OK per_verb={per_verb:.3f}", flush=True)
+'''
+
+
+_MIRROR_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.native import NativeHostStore
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=64, num_cols=4))
+srv = mat.server()
+ids = np.array([rank, 10 + rank, 30], np.int32)
+mat.AddRows(ids, np.full((3, 4), float(rank + 1), np.float32))
+if NativeHostStore.create(4, 4, 1.0) is not None:
+    # toolchain present: the replicated mirror must actually be serving
+    assert srv._nat_store is not None, "mirror did not engage 2-proc"
+rows = mat.GetRows(np.array([0, 1, 10, 11, 30], np.int32))
+assert np.allclose(rows[[0, 2]], 1.0), rows
+assert np.allclose(rows[[1, 3]], 2.0), rows
+assert np.allclose(rows[4], 3.0), rows          # both ranks on row 30
+# device plane after mirror writes: state property syncs collectively
+dev = np.asarray(srv.device_fetch_rows(np.array([30], np.int32)))
+assert np.allclose(dev[0, :4], 3.0), dev
+# ...and a device-path write drops the mirror, host Get still right
+srv.device_apply_rows(np.array([30], np.int32),
+                      np.ones((1, 4), np.float32))
+rows = mat.GetRows(np.array([30], np.int32))
+assert np.allclose(rows, 3.0 + 2.0), rows       # +1 from each rank
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} MIRROR OK", flush=True)
+'''
+
+
+_COMPRESS_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+R, C = 128, 16
+comp = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C,
+                                           compress="sparse"))
+plain = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+rng = np.random.default_rng(3 + rank)
+for step in range(6):
+    ids = np.sort(rng.choice(R, 12, replace=False)).astype(np.int32)
+    deltas = np.zeros((12, C), np.float32)
+    # >50% zeros on even steps (compresses); dense on odd (per-rank
+    # dense fallback mixes with the peer's compressed payload)
+    nz = 3 if step % 2 == 0 else C
+    deltas[:, :nz] = rng.standard_normal((12, nz)).astype(np.float32)
+    comp.AddRows(ids, deltas)
+    plain.AddRows(ids, deltas)
+got_c = comp.GetRows(np.arange(R, dtype=np.int32))
+got_p = plain.GetRows(np.arange(R, dtype=np.int32))
+# sparse compression is EXACT: bit-identical to the uncompressed twin
+np.testing.assert_array_equal(got_c, got_p)
+# the compressed wire actually engaged (even steps compressed)
+ws = comp.server().wire_stats
+assert ws["dense_bytes"] > 0 and ws["payload_bytes"] > 0, ws
+assert ws["payload_bytes"] < ws["dense_bytes"], ws
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} COMPRESS OK", flush=True)
+'''
+
+
+_BADADD_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=16, num_cols=2))
+# rank 1 pushes an OUT-OF-RANGE row id at the same collective position
+# as rank 0's valid add: the position must fail DETERMINISTICALLY on
+# both ranks (r4's design deadlocked here — the bad rank replied before
+# its collective, stranding the good rank in the allgather)
+ids = np.array([1, 99 if rank == 1 else 2], np.int32)
+try:
+    mat.AddRows(ids, np.ones((2, 2), np.float32))
+    failed = False
+except Exception:
+    failed = True
+assert failed, "invalid collective add did not raise"
+# the world is still alive and consistent afterwards
+mat.AddRows(np.array([3], np.int32), np.ones((1, 2), np.float32))
+rows = mat.GetRows(np.array([1, 2, 3], np.int32))
+assert np.allclose(rows[0], 0.0) and np.allclose(rows[2], 2.0), rows
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} BADADD OK", flush=True)
+'''
+
+
+class TestWindowedProtocol:
+    def test_burst_coalescing_and_collective_budget(self, tmp_path):
+        """Interleaved 2-rank bursts: result equals the oracle AND the
+        host-collective cost per verb sits far below r4's ~2/verb."""
+        run_two_process(_BURST_CHILD, tmp_path, expect="BURST OK",
+                        timeout=280)
+
+    def test_native_mirror_rides_two_process_worlds(self, tmp_path):
+        """The CPU-backend native host store is replicated per rank and
+        serves 2-proc host verbs; device-plane reads sync it back."""
+        run_two_process(_MIRROR_CHILD, tmp_path, expect="MIRROR OK")
+
+    def test_compressed_wire_across_processes(self, tmp_path):
+        """compress='sparse' Adds from two ranks (mixed with per-rank
+        dense fallbacks) apply bit-identically to an uncompressed twin
+        (VERDICT #3: the bandwidth saver now works exactly where bytes
+        cross nodes)."""
+        run_two_process(_COMPRESS_CHILD, tmp_path, expect="COMPRESS OK")
+
+    def test_invalid_position_fails_on_both_ranks(self, tmp_path):
+        """An invalid payload at one rank fails that collective position
+        deterministically on BOTH ranks instead of deadlocking, and the
+        world keeps working."""
+        run_two_process(_BADADD_CHILD, tmp_path, expect="BADADD OK")
