@@ -46,6 +46,7 @@ same code paths.
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Optional
 
 import numpy as np
@@ -79,7 +80,11 @@ _owns_runtime = False   # True only when WE called jax.distributed.initialize
 #: (bench two_proc_collectives_per_op). XLA-level collectives (psum
 #: etc. inside jit programs) ride ICI and are deliberately not counted
 #: — they are the fast path, not the protocol cost.
-STATS = {"host_collective_rounds": 0}
+STATS = {"host_collective_rounds": 0,
+         #: wall seconds spent inside capped_exchange (the windowed
+         #: engine's one host-collective path) — lets the bench decompose
+         #: the 2-proc cost into protocol rounds vs shared-core compute
+         "exchange_seconds": 0.0}
 
 
 def note_collective(n: int = 1) -> None:
@@ -408,7 +413,8 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     from jax.experimental import multihost_utils
 
     from multiverso_tpu.parallel.mesh import next_bucket
-    need = len(blob) + 9
+    _t0 = _time.perf_counter()   # after imports: first-call module-import
+    need = len(blob) + 9         # cost must not be charged as exchange
     cap = caps.get(key, 4096)
     buf = np.zeros(cap, np.uint8)
     buf[0] = 1 if need <= cap else 0
@@ -424,6 +430,7 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     fits = [bool(gathered[i, 0]) for i in range(process_count())]
     caps[key] = next_bucket(max(lens) + 9, min_bucket=4096)
     if all(fits):
+        STATS["exchange_seconds"] += _time.perf_counter() - _t0
         return [gathered[i, 9:9 + lens[i]].tobytes()
                 for i in range(process_count())]
     # overflow: one more round at the (now agreed) ladder cap
@@ -435,6 +442,7 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     gathered2 = np.asarray(
         multihost_utils.process_allgather(buf2)).reshape(process_count(),
                                                          big)
+    STATS["exchange_seconds"] += _time.perf_counter() - _t0
     return [gathered2[i, : lens[i]].tobytes()
             for i in range(process_count())]
 
