@@ -528,7 +528,11 @@ def bench_lr_app_ftrl(np, rng, tmpdir="/tmp/mvt_bench_lr_ftrl"):
     cfg.input_size, cfg.output_size = features, 1
     cfg.objective_type = "ftrl"
     cfg.sparse = True
-    cfg.alpha, cfg.beta = 0.05, 1.0
+    # alpha tuned for the minibatch-FTRL regime (this framework batches
+    # FTRL per minibatch/window; the reference steps per sample — the
+    # same alpha=2.0 is ALSO the reference's best on this dataset:
+    # ref test error 0.027 vs ours 0.015 acc-equivalent, baseline_ref)
+    cfg.alpha, cfg.beta = 2.0, 1.0
     cfg.lambda1, cfg.lambda2 = 0.01, 0.01
     cfg.train_epoch = epochs
     cfg.use_ps = True
@@ -544,7 +548,7 @@ def bench_lr_app_ftrl(np, rng, tmpdir="/tmp/mvt_bench_lr_ftrl"):
         loss = float(app.Train())
         secs = min(secs, time.perf_counter() - t0)
         app.close()
-    if not (loss == loss and loss < 0.25):
+    if not (loss == loss and loss < 0.1):
         _fail("lr_app_ftrl_samples_per_sec", f"bad final loss {loss}")
     return n_train * epochs / secs
 
@@ -985,9 +989,10 @@ def main() -> int:
         out["lr_app_ftrl_samples_per_sec"] = round(sps)
         out["lr_app_ftrl_config"] = (
             "sparse sigmoid FTRL (1000 features, 30 nz/sample), 6000 "
-            "samples, 6 epochs, PS z/n KVTables + device_plane windows "
-            "(sync=50) — round 5: the last LR mode without an on-chip "
-            "path")
+            "samples, 6 epochs, alpha=2.0, PS z/n KVTables + "
+            "device_plane windows (sync=50) — round 5: the last LR mode "
+            "without an on-chip path; head-to-head vs the reference FTRL "
+            "app in baseline_ref/README.md")
 
     def fill_matrix(res):
         out.update(res)

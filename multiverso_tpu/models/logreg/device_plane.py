@@ -41,14 +41,20 @@ Loss scalars stay ON DEVICE: ``train_window`` returns a 0-d jax array
 so the driver's accumulation never forces a tunnel round-trip; the
 periodic log line / epoch summary forces one fetch when it formats.
 
-Dense + sparse objectives (FTRL keeps the host path — its KV state
-rides host-control verbs by design, SURVEY.md §2b). Multi-process
-worlds train COLLECTIVELY (round 4): per-process window tensors shard
-one global scan axis (dense) or ride the *_parts row round (sparse),
-the summed lr-scaled deltas being exactly the host plane's merged
-collective Add; ragged shard streams run on filler windows (inert
-weight-0 batches). Within a process the caller owns the tables while
-training (the device-plane single-writer contract).
+All three objectives ride the plane: dense (ArrayTable), sparse
+(MatrixTable), and — round 5 — FTRL, whose whole window gathers the
+(z, n) rows from BOTH KVTables' HBM values, scans the batches at the
+window-start state, and scatters the summed negated deltas back
+(``_train_ftrl``; reference ftrl_sparse_table.h + ftrl_updater.h
+behavior through the KV += rule). Multi-process worlds train
+COLLECTIVELY (round 4): per-process window tensors shard one global
+scan axis (dense) or ride the *_parts row round (sparse), the summed
+lr-scaled deltas being exactly the host plane's merged collective Add;
+ragged shard streams run on filler windows (inert weight-0 batches).
+FTRL's two-table program is single-process — multi-process FTRL rides
+the collective host KV verbs (PSModel gates construction). Within a
+process the caller owns the tables while training (the device-plane
+single-writer contract).
 """
 
 from __future__ import annotations
@@ -273,9 +279,9 @@ class DeviceWindowTrainer:
         keys = window.keys                       # unique, sorted (np.unique)
         if nproc > 1:
             if agreed is None:
-                parts = multihost.host_allgather_objects(
+                parts = multihost.host_allgather_objects_capped(
                     (max((b.keys.shape[1] for b in window.batches),
-                         default=1), len(keys)))
+                         default=1), len(keys)), "lr_dp_agreed")
                 agreed = (max(p[0] for p in parts),
                           max(max(p[1] for p in parts), 1))
             K = agreed[0]
@@ -367,12 +373,22 @@ class DeviceWindowTrainer:
         R = len(keys)
         flat = model._flat_keys(keys)               # (R*out,) unique
         K = max(b.keys.shape[1] for b in window.batches)
-        # resolve slots BEFORE taking device_values (create may grow and
-        # swap the backing arrays — kv_table.py device-plane contract)
-        zslots = zsrv.device_slots(flat, create=True)
-        nslots = nsrv.device_slots(flat, create=True)
+        # Slot vectors stage WITH the window (the key covers the table
+        # capacities: growth moves the pad slot, so stale uploads
+        # re-stage) — on the tunnel the per-window slot upload AND the
+        # O(R*out) host resolution are real wall time, so a staged hit
+        # skips BOTH: the window's keys were created at staging time and
+        # KV slots are append-only, so unchanged capacities mean
+        # unchanged slots.
         staged = getattr(window, "_staged_ftrl", None)
-        if staged is None or staged[0] != (nb, K, R):
+        if staged is None or staged[0] != (nb, K, R, zsrv.capacity,
+                                           nsrv.capacity):
+            # resolve BEFORE taking device_values (create may grow and
+            # swap the backing arrays — kv_table.py device-plane
+            # contract); re-read capacities after (growth during create)
+            zslots = zsrv.device_slots(flat, create=True)
+            nslots = nsrv.device_slots(flat, create=True)
+            skey = (nb, K, R, zsrv.capacity, nsrv.capacity)
             bkeys = np.zeros((nb, B, K), np.int32)
             values = np.zeros((nb, B, K), np.float32)
             mask = np.zeros((nb, B, K), np.float32)
@@ -385,16 +401,16 @@ class DeviceWindowTrainer:
                 mask[i, :, :kb] = b.mask
                 labels[i] = b.labels
                 weights[i] = b.weights
-            staged = ((nb, K, R), jnp.asarray(bkeys), jnp.asarray(values),
+            staged = (skey, jnp.asarray(zslots), jnp.asarray(nslots),
+                      jnp.asarray(bkeys), jnp.asarray(values),
                       jnp.asarray(mask), jnp.asarray(labels),
                       jnp.asarray(weights))
             self._attach_staged(window, "_staged_ftrl", staged)
-        program = self._ftrl_program(nb, B, K, R, len(zslots),
-                                     len(nslots), zsrv.capacity,
+        program = self._ftrl_program(nb, B, K, R, staged[1].shape[0],
+                                     staged[2].shape[0], zsrv.capacity,
                                      nsrv.capacity)
         new_z, new_n, loss = program(
-            zsrv.device_values(), nsrv.device_values(),
-            jnp.asarray(zslots), jnp.asarray(nslots), *staged[1:])
+            zsrv.device_values(), nsrv.device_values(), *staged[1:])
         zsrv.device_set_values(new_z)
         nsrv.device_set_values(new_n)
         loss.copy_to_host_async()   # the lagged epoch log finds it landed

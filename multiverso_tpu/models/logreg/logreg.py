@@ -99,8 +99,8 @@ class LogReg:
                 nk = len(w.keys) if w is not None else 0
             else:
                 kmax = nk = 0
-            parts = multihost.host_allgather_objects(
-                (w is None, kmax, nk, local_n))
+            parts = multihost.host_allgather_objects_capped(
+                (w is None, kmax, nk, local_n), "lr_pop")
             if all(p[0] for p in parts):
                 return None
             if w is None:

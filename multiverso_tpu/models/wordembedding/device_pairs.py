@@ -409,8 +409,8 @@ class DevicePairsTrainer:
             # per-block allgather; a direct caller pays one here.
             if agreed is None:
                 local_max_sent = int(token_sent.max(initial=-1)) + 1
-                parts = multihost.host_allgather_objects(
-                    (T, local_max_sent))
+                parts = multihost.host_allgather_objects_capped(
+                    (T, local_max_sent), "we_dp_agreed")
                 agreed = (max(p[0] for p in parts),
                           max(p[1] for p in parts))
             mesh = self.comm.input_table.server()._mesh

@@ -132,8 +132,8 @@ class DistributedWordEmbedding:
             max_sent = (int(block.token_sent.max(initial=-1)) + 1
                         if block is not None and block.token_sent is not None
                         else 0)
-            parts = multihost.host_allgather_objects(
-                (block is None, T, max_sent))
+            parts = multihost.host_allgather_objects_capped(
+                (block is None, T, max_sent), "we_pop")
             if all(p[0] for p in parts):
                 return None
             if any(p[0] for p in parts):

@@ -167,9 +167,15 @@ def net_connect(ranks, endpoints) -> int:
 
 
 def net_reset() -> None:
-    """Forget explicit wiring (tests / MV_ShutDown symmetry)."""
+    """Forget explicit wiring (tests / MV_ShutDown symmetry). Also
+    clears the standing exchange caps: a NEW world may mix reused
+    interpreters (evolved caps) with fresh ranks (defaults), and
+    mismatched caps mean mismatched allgather buffer shapes — caps must
+    restart from defaults on every world, like the engine's per-instance
+    _mh_caps do."""
     global _net_rank, _net_endpoint, _net_world
     _net_rank = _net_endpoint = _net_world = None
+    _OBJ_CAPS.clear()
 
 
 def net_finalize() -> None:
@@ -445,6 +451,25 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     STATS["exchange_seconds"] += _time.perf_counter() - _t0
     return [gathered2[i, : lens[i]].tobytes()
             for i in range(process_count())]
+
+
+#: standing caps for host_allgather_objects(key=...) — lockstep callers
+#: that tag their exchange get the capped 1-round path (caps evolve
+#: identically everywhere because every tagged call site is collective)
+_OBJ_CAPS: dict = {}
+
+
+def host_allgather_objects_capped(obj, key) -> list:
+    """host_allgather_objects through the standing-cap 1-round exchange
+    (capped_exchange). ``key`` must be a value every rank passes
+    identically at this lockstep call site — e.g. a call-site label —
+    or buffer shapes diverge and the world hangs. Use for small,
+    latency-sensitive agreements (the device planes' bucket rounds)."""
+    if process_count() <= 1:
+        return [obj]
+    import pickle
+    return [pickle.loads(b) for b in
+            capped_exchange(pickle.dumps(obj), _OBJ_CAPS, key)]
 
 
 def host_allgather_objects(obj) -> list:

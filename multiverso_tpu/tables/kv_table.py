@@ -458,7 +458,8 @@ class KVServerTable(ServerTable):
             # same allgather carries the per-process counts the shared
             # bucket needs. An explicit bucket with create=False is the
             # promised collective-free fast path.
-            parts = multihost.host_allgather_objects(keys)
+            parts = multihost.host_allgather_objects_capped(keys,
+                                                            "kv_slots")
             if create:
                 self._slots_for(np.concatenate(parts), create=True)
             if bucket is None:

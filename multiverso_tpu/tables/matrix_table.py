@@ -1243,7 +1243,8 @@ class MatrixServerTable(ServerTable):
         local_dev = local_device_count(self._mesh)
         if bucket is None:
             bucket = parts_bucket(max(
-                multihost.host_allgather_objects(len(ids))), local_dev)
+                multihost.host_allgather_objects_capped(
+                    len(ids), "matrix_dpb")), local_dev)
         CHECK(len(ids) <= bucket,
               f"device_place_batch: batch {len(ids)} exceeds bucket {bucket}")
         CHECK(bucket % local_dev == 0,

@@ -128,7 +128,8 @@ class SparseMatrixServerTable(MatrixServerTable):
         if self._procs <= 1:
             return [part]
         from multiverso_tpu.parallel import multihost
-        return multihost.host_allgather_objects(part)
+        return multihost.host_allgather_objects_capped(part,
+                                                       "sparse_parts")
 
     def _note_add_parts(self, option: AddOption, parts) -> None:
         """Parent hook: fires after the collective Add applied, with every

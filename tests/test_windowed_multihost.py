@@ -217,7 +217,47 @@ print(f"child {rank} BADADD OK", flush=True)
 '''
 
 
+_DIVERGE_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+arr = mv.MV_CreateTable(ArrayTableOption(size=8))
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=8, num_cols=2))
+# CONTRACT VIOLATION: rank 0 Adds to table 0 while rank 1 Adds to table
+# 1 at the same global position — the windowed engine must detect the
+# divergent descriptors and raise on BOTH ranks (not corrupt, not hang;
+# the r4 strict path would have silently merged mismatched tables)
+try:
+    if rank == 0:
+        arr.Add(np.ones(8, np.float32))
+    else:
+        mat.AddRows(np.array([1], np.int32), np.ones((1, 2), np.float32))
+    print(f"child {rank} NO ERROR", flush=True)
+except Exception as e:
+    print(f"child {rank} DIVERGE RAISED {type(e).__name__}", flush=True)
+os._exit(0)
+'''
+
+
 class TestWindowedProtocol:
+    def test_divergent_verb_streams_raise_on_every_rank(self, tmp_path):
+        """Mismatched verb sequences across ranks are a contract
+        violation: the windowed engine's prefix CHECK must raise loudly
+        on BOTH ranks instead of corrupting state or hanging."""
+        outs = run_two_process(_DIVERGE_CHILD, tmp_path,
+                               expect="DIVERGE RAISED")
+        for out in outs:
+            assert "NO ERROR" not in out
+
     def test_burst_coalescing_and_collective_budget(self, tmp_path):
         """Interleaved 2-rank bursts: result equals the oracle AND the
         host-collective cost per verb sits far below r4's ~2/verb."""
