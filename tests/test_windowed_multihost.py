@@ -217,6 +217,41 @@ print(f"child {rank} BADADD OK", flush=True)
 '''
 
 
+_CKPT_BURST_CHILD = r'''
+import os, sys
+rank, port, ckpt = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=4))
+ids = np.array([rank, 10 + rank], np.int32)
+# burst of fire-and-forget adds, then a checkpoint save: the StoreLoad
+# message BARRIERS the collective window at a lockstep position (its
+# fetch is itself collective), so the snapshot must contain exactly the
+# adds acknowledged-or-enqueued before it on BOTH ranks
+for _ in range(5):
+    mat.AddFireForget(np.ones((2, 4), np.float32), row_ids=ids)
+mv.MV_SaveCheckpoint(ckpt)
+# more adds AFTER the snapshot, then restore: they must be wiped
+for _ in range(3):
+    mat.AddFireForget(np.ones((2, 4), np.float32), row_ids=ids)
+mv.MV_LoadCheckpoint(ckpt)
+rows = mat.GetRows(np.array([0, 1, 10, 11], np.int32))
+assert np.allclose(rows[[0, 2]], 5.0), rows   # rank 0's burst only
+assert np.allclose(rows[[1, 3]], 5.0), rows   # rank 1's burst only
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} CKPT BURST OK", flush=True)
+'''
+
+
 _DIVERGE_CHILD = r'''
 import os, sys
 rank, port = int(sys.argv[1]), sys.argv[2]
@@ -275,6 +310,14 @@ class TestWindowedProtocol:
         (VERDICT #3: the bandwidth saver now works exactly where bytes
         cross nodes)."""
         run_two_process(_COMPRESS_CHILD, tmp_path, expect="COMPRESS OK")
+
+    def test_checkpoint_barriers_windows_across_ranks(self, tmp_path):
+        """A StoreLoad inside a 2-proc fire-and-forget burst barriers the
+        collective window at a lockstep position: the snapshot holds
+        exactly the pre-barrier adds, and post-snapshot adds restore
+        away cleanly on both ranks."""
+        run_two_process(_CKPT_BURST_CHILD, tmp_path,
+                        f"file://{tmp_path}/ck.mvt", expect="CKPT BURST OK")
 
     def test_invalid_position_fails_on_both_ranks(self, tmp_path):
         """An invalid payload at one rank fails that collective position
