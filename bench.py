@@ -1025,15 +1025,17 @@ def main() -> int:
             "it saves and wider batching cannot help a per-element cost, "
             "so ~148 Melem/s IS the achievable ceiling for this metric")
         out["kv_device_note"] = (
-            "r5 regression check (r4 VERDICT #5, 120.6 -> 113.4): three "
-            "same-session TPU runs measure 113.1-113.4 Melem/s (stable "
-            "to ±0.3%) with the native slot index AND 107.6-112.2 with "
-            "r3's python index path forced — the r4 slot-cache change "
-            "is NOT the cause (slot values are batch-order identical on "
-            "both paths and the timed region is a pure device scan over "
-            "pre-resolved slots). The r3-vs-r4 delta is SESSION-level "
-            "chip/tunnel variance (~±6% across sessions), within the "
-            "documented shared-chip noise")
+            "r5 regression check (r4 VERDICT #5, 120.6 -> 113.4): within "
+            "ONE session the number is stable to ±0.3% (three runs "
+            "113.1-113.4), and forcing r3's python slot-index path "
+            "measures the same or lower (107.6-112.2) — the r4 "
+            "slot-cache change is NOT the cause (slot values are "
+            "batch-order identical on both paths and the timed region "
+            "is a pure device scan over pre-resolved slots). ACROSS "
+            "sessions the number swings ~±6% (a later r5 session "
+            "measured 120.2 = 81.5% of bound, back at the r3 level) — "
+            "session-level chip/tunnel variance, within the documented "
+            "shared-chip noise")
 
     def fill_scaling(d):
         out["host_scaling_Melem_s"] = d
