@@ -972,7 +972,7 @@ def main() -> int:
                 "on the axon tunnel the app is UPLOAD-bound: each "
                 "block's token stream crosses the measured 4-9 MB/s "
                 "tunnel link (~0.2-0.5s for this corpus's one block), "
-                "bounding the app at roughly 300-600k words/s whatever "
+                "bounding the app at roughly 250-600k words/s whatever "
                 "the device does — run-to-run spread (280-590k observed) "
                 "tracks tunnel load, not device speed")
 

@@ -176,6 +176,26 @@ np.testing.assert_array_equal(got_c, got_p)
 ws = comp.server().wire_stats
 assert ws["dense_bytes"] > 0 and ws["payload_bytes"] > 0, ws
 assert ws["payload_bytes"] < ws["dense_bytes"], ws
+
+# 1bit across processes: LOSSY (sign bits + row means, per-rank error
+# feedback) — repeated constant per-rank deltas to disjoint rows must
+# track the uncompressed twin closely (feedback cancels the rounding)
+one = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C,
+                                          compress="1bit"))
+ptwin = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+my_rows = np.arange(8, dtype=np.int32) + rank * 16
+const = np.tile(np.linspace(-1.0, 1.0, C, dtype=np.float32), (8, 1))
+for _ in range(8):
+    one.AddRows(my_rows, const)
+    ptwin.AddRows(my_rows, const)
+both = np.concatenate([np.arange(8), np.arange(8) + 16]).astype(np.int32)
+a = one.GetRows(both)     # OWN rows AND the peer's: cross-rank 1bit
+b = ptwin.GetRows(both)   # delivery must decode correctly too
+assert np.abs(b).max() > 0, "twin rows empty — adds never landed"
+assert np.abs(a - b).max() < 0.35 * np.abs(b).max(), (
+    np.abs(a - b).max(), np.abs(b).max())
+ws1 = one.server().wire_stats
+assert ws1["payload_bytes"] < ws1["dense_bytes"], ws1
 mv.MV_Barrier()
 mv.MV_ShutDown()
 print(f"child {rank} COMPRESS OK", flush=True)
