@@ -22,11 +22,11 @@ import numpy as np
 import pytest
 
 
-def run_two_process(child_src: str, tmp_path, *child_args,
-                    timeout: int = 280, expect: str = "OK") -> list:
-    """Launch two jax.distributed subprocesses running ``child_src`` (argv:
-    rank, coordinator-port, *child_args); assert both exit 0 and print
-    ``child <rank> ... {expect}``. Returns both outputs."""
+def run_n_process(child_src: str, tmp_path, *child_args, nproc: int = 2,
+                  timeout: int = 280, expect: str = "OK") -> list:
+    """Launch ``nproc`` jax.distributed subprocesses running ``child_src``
+    (argv: rank, coordinator-port, *child_args); assert all exit 0 and
+    print ``child <rank> ... {expect}``. Returns all outputs."""
     child = tmp_path / "child.py"
     child.write_text(child_src)
     s = socket.socket()
@@ -39,7 +39,7 @@ def run_two_process(child_src: str, tmp_path, *child_args,
         [sys.executable, str(child), str(r), str(port),
          *[str(a) for a in child_args]],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True) for r in range(2)]
+        text=True) for r in range(nproc)]
     outs = []
     for r, p in enumerate(procs):
         try:
@@ -48,11 +48,17 @@ def run_two_process(child_src: str, tmp_path, *child_args,
             for q in procs:
                 q.kill()
             out, _ = p.communicate()
-            pytest.fail(f"2-process run hung:\n{out[-2000:]}")
+            pytest.fail(f"{nproc}-process run hung:\n{out[-2000:]}")
         assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
         assert f"child {r}" in out and expect in out, out[-500:]
         outs.append(out)
     return outs
+
+
+def run_two_process(child_src: str, tmp_path, *child_args,
+                    timeout: int = 280, expect: str = "OK") -> list:
+    return run_n_process(child_src, tmp_path, *child_args, nproc=2,
+                         timeout=timeout, expect=expect)
 
 
 class TestSingleProcessDegradation:

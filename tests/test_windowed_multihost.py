@@ -344,3 +344,57 @@ class TestWindowedProtocol:
         deterministically on BOTH ranks instead of deadlocking, and the
         world keeps working."""
         run_two_process(_BADADD_CHILD, tmp_path, expect="BADADD OK")
+
+
+_THREE_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=3"])
+assert mv.MV_Size() == 3
+arr = mv.MV_CreateTable(ArrayTableOption(size=12))
+arr.Add(np.full(12, float(rank + 1), np.float32))
+assert np.allclose(arr.Get(), 6.0)          # 1+2+3
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=30, num_cols=4))
+ids = np.array([rank, 10 + rank, 20], np.int32)   # 20 shared by ALL
+mat.AddRows(ids, np.full((3, 4), float(rank + 1), np.float32))
+rows = mat.GetRows(np.array([0, 1, 2, 10, 11, 12, 20], np.int32))
+assert np.allclose(rows[:3], [[1] * 4, [2] * 4, [3] * 4]), rows
+assert np.allclose(rows[6], 6.0), rows
+kv = mv.MV_CreateTable(KVTableOption())
+kv.Add(np.array([100 + rank, 999], np.int64), np.ones(2, np.float32))
+assert np.allclose(kv.Get(np.array([100, 101, 102, 999], np.int64)),
+                   [1, 1, 1, 3.0])
+# fire-and-forget burst through the windowed engine, 3 ranks
+hs = []
+for _ in range(5):
+    mat.AddFireForget(np.ones((3, 4), np.float32), row_ids=ids)
+    hs.append(mat.GetAsyncHandle(row_ids=ids))
+for h in hs:
+    mat.Wait(h)
+assert np.allclose(mat.GetRows(np.array([20], np.int32)),
+                   6.0 + 3 * 5), "3-rank burst merge wrong"
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} THREE OK", flush=True)
+'''
+
+
+class TestThreeProcessWorld:
+    """Rank-count generality: nothing in the windowed protocol, the
+    parts merges, or the mirrors is 2-specific — a 3-process world
+    (divergent payloads, a row all ranks share, a coalesced burst)
+    behaves per the same contracts."""
+
+    def test_three_process_tables_and_burst(self, tmp_path):
+        from tests.test_multihost import run_n_process
+        run_n_process(_THREE_CHILD, tmp_path, nproc=3, expect="THREE OK")
