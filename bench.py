@@ -1525,6 +1525,17 @@ def two_proc_numbers() -> dict:
         "(matrix_table_2proc_bsp_*) additionally "
         "disables windows by design (strict clocked protocol), so its "
         "per-verb exchange cost is the floor." + core_note)
+    out["two_proc_bound_note"] = (
+        "decomposed bound for the blocking 2-proc round (Add+Get of "
+        "0.5 Melem) from this host's measured primitives: allgather "
+        "round latency ~1.85ms (any size <=20KB) + ~260 MB/s beyond, so "
+        "one round = Add exchange (~1.85 latency + ~1.25MB padded "
+        "payload ~4ms) + Get exchange (~1.85ms, ids only) + the "
+        "replicated merged apply on the shared core (~4ms: concat + "
+        "dup-split combine + native add_rows) + mirror gather (~0.4ms) "
+        "~= 12-13ms -> ~38-42 Melem/s per process; the measured 29-36 "
+        "is 70-95% of that, the remainder being engine/waiter "
+        "scheduling on one core")
     return out
 
 
