@@ -25,6 +25,7 @@ pattern):
 """
 
 import numpy as np
+import pytest
 
 from tests.test_multihost import run_two_process
 
@@ -398,3 +399,115 @@ class TestThreeProcessWorld:
     def test_three_process_tables_and_burst(self, tmp_path):
         from tests.test_multihost import run_n_process
         run_n_process(_THREE_CHILD, tmp_path, nproc=3, expect="THREE OK")
+
+
+_ORACLE_WALK_CHILD = r'''
+import os, sys
+rank, port, seed = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+R, C, A = 64, 3, 16
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+arr = mv.MV_CreateTable(ArrayTableOption(size=A))
+kv = mv.MV_CreateTable(KVTableOption())
+
+# one SHARED program rng drives the verb sequence (identical on both
+# ranks — the SPMD contract) and per-rank payload rngs drive the data.
+# Verbs mix blocking and fire-and-forget so window boundaries race;
+# the oracle accumulates both ranks' payload streams independently.
+prog = np.random.default_rng(seed)
+pay = [np.random.default_rng(1000 * seed + r) for r in range(2)]
+o_mat = np.zeros((R, C), np.float32)
+o_arr = np.zeros(A, np.float32)
+o_kv = {}
+
+for step in range(60):
+    verb = prog.integers(6)
+    datas = []
+    for r in range(2):
+        if verb == 0:      # matrix row add (maybe duplicate ids)
+            n = int(pay[r].integers(1, 6))
+            ids = pay[r].integers(0, R, n).astype(np.int32)
+            d = pay[r].standard_normal((n, C)).astype(np.float32)
+            datas.append((ids, d))
+        elif verb == 1:    # matrix whole add
+            datas.append(pay[r].standard_normal((R, C)).astype(np.float32))
+        elif verb == 2:    # matrix row get
+            n = int(pay[r].integers(1, 6))
+            datas.append(np.unique(pay[r].integers(0, R, n)).astype(np.int32))
+        elif verb == 3:    # array add
+            datas.append(pay[r].standard_normal(A).astype(np.float32))
+        elif verb == 4:    # kv add
+            n = int(pay[r].integers(1, 5))
+            keys = pay[r].integers(0, 40, n).astype(np.int64)
+            vals = pay[r].standard_normal(n).astype(np.float32)
+            datas.append((keys, vals))
+        else:              # kv get
+            datas.append(np.unique(pay[r].integers(0, 40,
+                         int(pay[r].integers(1, 5)))).astype(np.int64))
+    mine = datas[rank]
+    if verb == 0:
+        if prog.integers(2):
+            mat.AddRows(*mine)
+        else:
+            mat.AddFireForget(mine[1], row_ids=mine[0])
+        for ids, d in datas:
+            np.add.at(o_mat, ids, d)
+    elif verb == 1:
+        mat.Add(mine)
+        for d in datas:
+            o_mat += d
+    elif verb == 2:
+        got = mat.GetRows(mine)
+        assert got.shape == (len(mine), C)
+    elif verb == 3:
+        if prog.integers(2):
+            arr.Add(mine)
+        else:
+            arr.AddFireForget(mine)
+        for d in datas:
+            o_arr += d
+    elif verb == 4:
+        kv.Add(*mine)
+        for keys, vals in datas:
+            for k, v in zip(keys.tolist(), vals.tolist()):
+                o_kv[k] = o_kv.get(k, 0.0) + v
+    else:
+        got = kv.Get(mine)
+        assert got.shape == mine.shape
+
+# final state must equal the oracle exactly on BOTH ranks (linear f32
+# sums are order-insensitive only up to rounding -> loose tolerance)
+np.testing.assert_allclose(mat.GetRows(np.arange(R, dtype=np.int32)),
+                           o_mat, rtol=2e-4, atol=2e-4)
+np.testing.assert_allclose(arr.Get(), o_arr, rtol=2e-4, atol=2e-4)
+all_keys = np.array(sorted(o_kv), np.int64)
+np.testing.assert_allclose(kv.Get(all_keys),
+                           [o_kv[int(k)] for k in all_keys],
+                           rtol=2e-4, atol=2e-4)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} WALK OK", flush=True)
+'''
+
+
+class TestWindowedOracleWalk:
+    """Randomized 2-proc verb walks (mixed tables, blocking and
+    fire-and-forget, whole-table and row/key payloads, within-batch
+    duplicates) against a host oracle: whatever window boundaries the
+    engines race into, the merged state must equal the sum of both
+    ranks' payload streams."""
+
+    @pytest.mark.parametrize("seed", [11, 23])
+    def test_randomized_walk_matches_oracle(self, tmp_path, seed):
+        run_two_process(_ORACLE_WALK_CHILD, tmp_path, seed,
+                        expect="WALK OK")
