@@ -1520,9 +1520,11 @@ def two_proc_numbers() -> dict:
         "amortize even that). The residual 2-proc-vs-1-proc gap "
         "decomposes MEASURED: matrix_table_2proc_host_exchange_wall_pct "
         "is the fraction of blocking-round wall spent inside the host "
-        "collective rounds; the remainder is table compute duplicated "
-        "on the shared core(s) — see host_cores. BSP "
-        "(matrix_table_2proc_bsp_*) additionally "
+        "collective rounds (an UPPER bound on protocol cost — on a "
+        "shared core the blocked rank's wait overlaps the peer's "
+        "compute, so peer-wait lands in this bucket); the remainder is "
+        "table compute duplicated on the shared core(s) — see "
+        "host_cores. BSP (matrix_table_2proc_bsp_*) additionally "
         "disables windows by design (strict clocked protocol), so its "
         "per-verb exchange cost is the floor." + core_note)
     out["two_proc_bound_note"] = (

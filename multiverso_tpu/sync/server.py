@@ -109,9 +109,6 @@ class Server(Actor):
         #: through collective windows / window exchanges issued
         self.mh_window_verbs = 0
         self.mh_window_exchanges = 0
-        #: verbs drained locally but beyond the agreed prefix — retained
-        #: for the next exchange (strictly FIFO ahead of the mailbox)
-        self._mh_pending: Deque[Message] = collections.deque()
         #: standing exchange capacities per window-head descriptor
         #: (multihost.capped_exchange) — evolves identically on every
         #: rank, keeping steady exchanges to ONE collective round
@@ -289,11 +286,12 @@ class Server(Actor):
 
     def _mh_windows(self, batch) -> None:
         """Process drained messages through collective windows until
-        nothing retained remains (blocking in the exchange while peers
-        catch up is the protocol's flow control, exactly as the r4
-        per-verb collectives blocked)."""
-        pending = self._mh_pending
-        pending.extend(batch)
+        nothing remains (blocking in the exchange while peers catch up
+        is the protocol's flow control, exactly as the r4 per-verb
+        collectives blocked). Verbs beyond an exchange's agreed prefix
+        stay in the local deque and lead the NEXT exchange — the loop
+        always drains fully before returning."""
+        pending: Deque[Message] = collections.deque(batch)
         while pending:
             head = pending[0]
             if head.msg_type not in (MsgType.Request_Add,
@@ -315,6 +313,24 @@ class Server(Actor):
             for _ in range(done):
                 pending.popleft()
 
+    #: byte budget for one exchange's packed payloads: verbs beyond it
+    #: wait for the next exchange. Bounds the re-ship cost when ranks
+    #: drain raggedly (a short peer prefix would otherwise make every
+    #: retry re-pickle + re-transmit the whole pending run — O(W^2)
+    #: bytes for a W-verb burst of large payloads).
+    MH_WINDOW_BYTES = 4 << 20
+
+    @staticmethod
+    def _payload_bytes(payload) -> int:
+        total = 0
+        for v in payload.values():
+            if isinstance(v, np.ndarray):
+                total += v.nbytes
+            elif isinstance(v, dict):     # compressed payloads
+                total += sum(a.nbytes for a in v.values()
+                             if isinstance(a, np.ndarray))
+        return total
+
     def _mh_collective_window(self, verbs) -> int:
         """One collective window: exchange, agree on the common prefix,
         execute it from the exchanged parts. Returns how many of this
@@ -323,6 +339,13 @@ class Server(Actor):
 
         from multiverso_tpu.parallel import multihost
         my_rank = multihost.process_index()
+        # byte-budget the packed run (always >= 1 verb)
+        packed = 0
+        for i, m in enumerate(verbs):
+            packed += self._payload_bytes(m.payload)
+            if packed > self.MH_WINDOW_BYTES and i > 0:
+                verbs = verbs[:i]
+                break
         local = [("A" if m.msg_type is MsgType.Request_Add else "G",
                   m.table_id, m.payload) for m in verbs]
         # standing-cap exchange keyed by the window HEAD verb: the head

@@ -141,7 +141,10 @@ class KVServerTable(ServerTable):
 
     def _np_values(self):
         """The live host mirror, or None when ineligible (TPU backend,
-        multihost, or the 64-bit host-backed branch which IS host)."""
+        or the 64-bit host-backed branch which IS host). Multi-process
+        worlds ARE eligible since round 5 — the mirror is replicated
+        per rank and every host verb reaches it as identically merged
+        data (see _host_values_ok above)."""
         if self._host_backed or not self._host_values_ok:
             return None
         if self._values_np is None:
@@ -385,7 +388,10 @@ class KVServerTable(ServerTable):
 
     def ProcessGetParts(self, parts, my_rank: int):
         """One collective Get from exchanged parts: union known locally."""
-        if self._host_backed:
+        if self._host_backed or self._np_values() is not None:
+            # host values / replicated mirror serve locally — skip the
+            # cross-rank union entirely (ProcessGet's mirror branch
+            # never reads it)
             return self.ProcessGet(**parts[my_rank])
         all_keys = [np.asarray(p["keys"], np.int64).ravel() for p in parts]
         union = np.unique(np.concatenate(all_keys))

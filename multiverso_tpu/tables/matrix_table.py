@@ -1155,7 +1155,8 @@ class MatrixServerTable(ServerTable):
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
         if nat is not None:
-            # single-process by eligibility: no union round needed
+            # the store serves locally (multi-process: it is REPLICATED
+            # per rank since round 5) — no union round needed
             return nat.get_rows(ids)
         union = (_union if _union is not None
                  else multihost.union_collective_ids(ids))
