@@ -60,6 +60,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed across jax releases (TPUMemorySpace -> MemorySpace); resolve
+# whichever this jax ships
+_MemorySpace = getattr(pltpu, "MemorySpace", None) \
+    or pltpu.TPUMemorySpace
+
 
 def _contig(vals):
     """Traced predicate: the chunk's ids are strictly consecutive
@@ -157,7 +162,7 @@ def pallas_gather_rows(data: jax.Array, ids: jax.Array,
         num_scalar_prefetch=1,
         grid=(n // chunk,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
+            pl.BlockSpec(memory_space=_MemorySpace.ANY),  # data: HBM
         ],
         out_specs=pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),
         scratch_shapes=[pltpu.SemaphoreType.DMA((chunk,))],
@@ -231,9 +236,9 @@ def pallas_scatter_set_rows(data: jax.Array, ids: jax.Array,
         grid=(n // chunk,),
         in_specs=[
             pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),   # rows: VMEM
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),  # data: HBM
+            pl.BlockSpec(memory_space=_MemorySpace.ANY),  # data: HBM
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        out_specs=pl.BlockSpec(memory_space=_MemorySpace.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA((chunk,))],
     )
     return pl.pallas_call(
@@ -347,9 +352,9 @@ def pallas_update_rows(data: jax.Array, ids: jax.Array, deltas: jax.Array,
         grid=(n // chunk,),
         in_specs=[
             pl.BlockSpec((chunk, cols), lambda i, ids: (i, 0)),  # deltas
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),    # data: HBM
+            pl.BlockSpec(memory_space=_MemorySpace.ANY),    # data: HBM
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        out_specs=pl.BlockSpec(memory_space=_MemorySpace.ANY),
         scratch_shapes=[pltpu.VMEM((chunk, cols), data.dtype),
                         pltpu.SemaphoreType.DMA((chunk,)),
                         pltpu.SemaphoreType.DMA((chunk,))],

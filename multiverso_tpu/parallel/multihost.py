@@ -84,7 +84,13 @@ STATS = {"host_collective_rounds": 0,
          #: wall seconds spent inside capped_exchange (the windowed
          #: engine's one host-collective path) — lets the bench decompose
          #: the 2-proc cost into protocol rounds vs shared-core compute
-         "exchange_seconds": 0.0}
+         "exchange_seconds": 0.0,
+         #: wall seconds the windowed engine spent encoding/decoding
+         #: window blobs (parallel/wire.py flat codec; sync/server.py
+         #: accumulates) — the bench compares these per-window against a
+         #: pickled baseline of the same payloads
+         "wire_encode_seconds": 0.0,
+         "wire_decode_seconds": 0.0}
 
 
 def note_collective(n: int = 1) -> None:
@@ -270,6 +276,31 @@ def _env_says_multiprocess() -> bool:
     return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
+def _enable_cpu_collectives() -> None:
+    """Opt the CPU backend into cross-process collectives (gloo) before
+    the backend exists. jax's default CPU collectives implementation is
+    ``'none'``, under which EVERY multi-process computation — including
+    the ``device_put`` equality check inside table creation — fails with
+    "Multiprocess computations aren't implemented on the CPU backend";
+    a 2-process CPU world (tests, single-host bring-up, the bench's
+    subprocess children) therefore needs gloo. Only applies when the job
+    explicitly targets CPU (``jax_platforms``/``JAX_PLATFORMS``): TPU
+    pods keep their platform default. Best-effort — a jax/jaxlib without
+    the knob (or without gloo) just keeps its default behavior."""
+    import jax
+    try:
+        plats = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS", ""))
+    except AttributeError:  # pragma: no cover - very old jax
+        plats = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in plats.lower().split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # pragma: no cover - jaxlib without gloo
+        Log.Debug("multihost: CPU gloo collectives unavailable (%r)", exc)
+
+
 def maybe_initialize() -> bool:
     """Initialize jax.distributed per flags/env. Returns True when a
     multi-process runtime is (already or newly) up. Idempotent.
@@ -311,6 +342,7 @@ def maybe_initialize() -> bool:
         return True
     import jax
     try:
+        _enable_cpu_collectives()
         if explicit:
             jax.distributed.initialize(coordinator_address=coordinator,
                                        num_processes=size, process_id=rank)
@@ -408,12 +440,14 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     Here each exchange rides a STANDING per-``key`` capacity all ranks
     remember identically (``caps`` evolves only from exchanged data):
     blobs that fit inline in the cap'd buffer (1-byte fit flag + 8-byte
-    length header) complete in one round; if ANY rank overflowed, every
-    rank runs one more round at the ladder cap of the now-known max
-    length. After either path the standing cap snaps to the ladder rung
-    of this exchange's max need, so per-key steady workloads (an engine
-    window headed by the same verb) stay on the 1-round path. Collective;
-    single-process returns ``[blob]``."""
+    little-endian length header — explicit ``'<i8'``, so heterogeneous-
+    endianness worlds can't misread each other's lengths) complete in
+    one round; if ANY rank overflowed, every rank runs one more round
+    at the ladder cap of the now-known max length. After either path
+    the standing cap snaps to the ladder rung of this exchange's max
+    need, so per-key steady workloads (an engine window headed by the
+    same verb) stay on the 1-round path. Collective; single-process
+    returns ``[blob]``."""
     if process_count() <= 1:
         return [blob]
     from jax.experimental import multihost_utils
@@ -424,14 +458,14 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     cap = caps.get(key, 4096)
     buf = np.zeros(cap, np.uint8)
     buf[0] = 1 if need <= cap else 0
-    buf[1:9] = np.frombuffer(np.int64(len(blob)).tobytes(), np.uint8)
+    buf[1:9] = np.array([len(blob)], "<i8").view(np.uint8)
     if need <= cap and blob:
         buf[9:9 + len(blob)] = np.frombuffer(blob, np.uint8)
     note_collective()
     gathered = np.asarray(
         multihost_utils.process_allgather(buf)).reshape(process_count(),
                                                         cap)
-    lens = [int(np.frombuffer(gathered[i, 1:9].tobytes(), np.int64)[0])
+    lens = [int(np.frombuffer(gathered[i, 1:9].tobytes(), "<i8")[0])
             for i in range(process_count())]
     fits = [bool(gathered[i, 0]) for i in range(process_count())]
     caps[key] = next_bucket(max(lens) + 9, min_bucket=4096)

@@ -23,14 +23,17 @@ Selection by the ``sync`` flag mirrors ``Server::GetServer``
 from __future__ import annotations
 
 import collections
+import time as _time
 from typing import Deque, Dict, List
 
 import numpy as np
 
 from multiverso_tpu.actor import Actor, actor_names
 from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.parallel import wire
 from multiverso_tpu.updaters.base import AddOption, GetOption
-from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_bool, MV_DEFINE_int
+from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
+                                            MV_DEFINE_int, MV_DEFINE_string)
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -49,6 +52,29 @@ def _copy_result(result):
 MV_DEFINE_bool("sync", False, "sync or async")
 # Declared-but-dead in the reference (server.cpp:21); kept for flag parity.
 MV_DEFINE_int("backup_worker_ratio", 0, "ratio% of backup workers (dead flag, parity)")
+# Windowed-engine transport selection (the reference picks its allreduce
+# wire adaptively by payload size, allreduce_engine.cpp:31-55). "host":
+# every window payload rides the staging allgather (capped_exchange).
+# "device": eligible Add values never cross the host wire — only their
+# dtype/shape metadata does — and the data moves through the table's
+# device-parts collectives (place_parts + one traced program; on a pod
+# that is ICI at fabric bandwidth). "auto": per-verb by payload size
+# against -window_device_min_bytes. The default threshold sits just
+# above this repo's MEASURED single-host crossover (bench.py transport
+# profile: one host window round costs ~1.6 ms latency + bytes at
+# ~350-410 MB/s, while one device-parts round costs a FIXED ~14-15 ms
+# floor on the CPU backend — per-call jit dispatch + gloo collectives
+# over padded parts buffers — so the device wire only wins past ~4-6 MB
+# per window, which a 4 MB-budget window barely reaches). A POD
+# deployment, where the device wire moves 100+ GB/s with ~us dispatch,
+# should run -window_transport=device (or drop the threshold to ~1 MB)
+# — see docs/BENCHMARK.md "transport selection".
+MV_DEFINE_string("window_transport", "auto",
+                 "windowed-engine Add-value transport: auto / host / device")
+MV_DEFINE_int("window_device_min_bytes", 6 << 20,
+              "auto transport: defer Add values >= this many bytes to "
+              "the device wire (default just above this host's measured "
+              "crossover)")
 
 _INF = float("inf")
 
@@ -109,6 +135,13 @@ class Server(Actor):
         #: through collective windows / window exchanges issued
         self.mh_window_verbs = 0
         self.mh_window_exchanges = 0
+        #: ... and the Add-application economics the burst tests assert:
+        #: dispatches actually issued (merged run = 1), runs that merged
+        #: across positions AND ranks, and positions whose values rode
+        #: the DEVICE wire (transport selection; see -window_transport)
+        self.mh_add_dispatches = 0
+        self.mh_add_run_merged = 0
+        self.mh_device_wire_adds = 0
         #: standing exchange capacities per window-head descriptor
         #: (multihost.capped_exchange) — evolves identically on every
         #: rank, keeping steady exchanges to ONE collective round
@@ -283,6 +316,15 @@ class Server(Actor):
     # barriers, FinishTrain) split the window exactly as before and
     # dispatch in strict global order — their position in the verb
     # stream is lockstep because prefix processing is.
+    #
+    # Round 6 — adaptive transport: the window rides the FLAT BINARY
+    # codec (parallel/wire.py) instead of pickle, and per Add verb the
+    # engine picks the wire the reference's allreduce engine would
+    # (size-adaptive, allreduce_engine.cpp:31-55): small payloads stay
+    # on the host staging allgather; large eligible payloads ship only
+    # their dtype/shape metadata and the VALUES ride the table's
+    # device-parts collectives (-window_transport /
+    # -window_device_min_bytes; bench.py measures the crossover).
 
     def _mh_windows(self, batch) -> None:
         """Process drained messages through collective windows until
@@ -298,7 +340,11 @@ class Server(Actor):
                                      MsgType.Request_Get):
                 # window barrier: strict-order dispatch (may itself run
                 # collectives — matched, every rank hits it at the same
-                # global verb position)
+                # global verb position). The marker exchange makes a
+                # cross-rank head MISMATCH (this rank at a barrier, a
+                # peer exchanging verbs) fail the loud SPMD CHECK
+                # instead of deadlocking in mismatched collectives.
+                self._mh_check_barrier_head(head)
                 pending.popleft()
                 self.window_barrier_splits += 1
                 self._dispatch(head)
@@ -331,31 +377,126 @@ class Server(Actor):
                              if isinstance(a, np.ndarray))
         return total
 
+    def _mh_check_barrier_head(self, head: Message) -> None:
+        """Exchange a head-kind marker for a non-verb window head. Every
+        rank reaches the same barrier at the same stream position in a
+        legal SPMD program, so the markers agree; a divergent program
+        (one rank at a StoreLoad while a peer exchanges verbs) trips the
+        loud CHECK on every rank instead of stranding the verb rank in
+        an unmatched collective. Best-effort when standing caps have
+        already diverged across mismatched keys: the exchange itself
+        then fails at the runtime layer (mismatched buffer shapes) —
+        still an error, not a silent hang."""
+        from multiverso_tpu.parallel import multihost
+        blobs = multihost.capped_exchange(
+            wire.encode_head_barrier(int(head.msg_type)),
+            self._mh_caps, "HEAD_B")
+        kinds = [wire.decode_head_kind(b) for b in blobs]
+        CHECK(all(k == kinds[0] for k in kinds),
+              f"multi-process window heads diverge: {kinds} — every "
+              f"process must reach the same barrier/verb at the same "
+              f"stream position (the SPMD collective contract)")
+
+    def _mh_transport(self) -> str:
+        mode = str(GetFlag("window_transport")).lower()
+        CHECK(mode in ("auto", "host", "device"),
+              f"-window_transport must be auto/host/device, got {mode!r}")
+        return mode
+
+    def _mh_maybe_defer(self, tid: int, payload: dict, mode: str,
+                        min_bytes: int) -> dict:
+        """Transport selection, per Add verb at pack time (the
+        reference's payload-size-adaptive wire pick): when the device
+        wire is selected and the table can apply this payload through
+        its device-parts collectives, replace the ``values`` array with
+        a wire.DeferredArray — the exchange then ships only dtype/shape
+        metadata and the bytes ride the device. The decision is
+        rank-local (peers may differ); the APPLY decision is taken from
+        the exchanged metadata (any rank deferred -> device path), so
+        every rank still runs the identical program. ``mode`` and
+        ``min_bytes`` are parsed ONCE per window by the caller (flags
+        cannot change mid-window)."""
+        if mode == "host":
+            return payload
+        v = payload.get("values")
+        if isinstance(v, wire.DeferredArray):   # re-led window leftover
+            return payload
+        if not isinstance(v, np.ndarray):
+            return payload
+        if not wire.dtype_wire_safe(v.dtype):
+            # extension dtypes (bfloat16 &c) have no flat wire header;
+            # their payloads stay whole on the host pickle fallback
+            return payload
+        if mode == "auto" and v.nbytes < min_bytes:
+            return payload
+        try:
+            table = self.store_[tid]
+        except Exception:
+            return payload      # bad table id: the apply path reports it
+        if not table.device_wire_add_ok(payload):
+            return payload
+        out = dict(payload)
+        out["values"] = wire.DeferredArray.of(v)
+        return out
+
     def _mh_collective_window(self, verbs) -> int:
         """One collective window: exchange, agree on the common prefix,
         execute it from the exchanged parts. Returns how many of this
         rank's ``verbs`` were processed (>= 1)."""
-        import pickle
-
         from multiverso_tpu.parallel import multihost
         my_rank = multihost.process_index()
-        # byte-budget the packed run (always >= 1 verb)
+        mode = self._mh_transport()
+        min_bytes = int(GetFlag("window_device_min_bytes"))
+        # pack + byte-budget in ONE pass (always >= 1 verb): the budget
+        # counts what rides the HOST wire, so values deferred to the
+        # device wire (DeferredArray — dtype/shape header only) cost
+        # ~nothing here and a device-transport burst of large Adds
+        # still coalesces into one exchange
+        local = []
         packed = 0
         for i, m in enumerate(verbs):
-            packed += self._payload_bytes(m.payload)
+            kind = "A" if m.msg_type is MsgType.Request_Add else "G"
+            payload = m.payload
+            if kind == "A":
+                payload = self._mh_maybe_defer(m.table_id, payload,
+                                               mode, min_bytes)
+            packed += self._payload_bytes(payload)
             if packed > self.MH_WINDOW_BYTES and i > 0:
                 verbs = verbs[:i]
                 break
-        local = [("A" if m.msg_type is MsgType.Request_Add else "G",
-                  m.table_id, m.payload) for m in verbs]
+            local.append((kind, m.table_id, payload))
+        # flat binary codec (parallel/wire.py): pickle's object-graph
+        # walk + buffer copies were pure overhead for payloads that are
+        # already contiguous arrays; decode below is zero-copy.
+        # wire_encode_seconds times the CODEC only (bench compares it
+        # against the pickled baseline) — packing/transport selection
+        # above is engine work either wire would pay
+        _t0 = _time.perf_counter()
+        blob = wire.encode_window(local)
+        multihost.STATS["wire_encode_seconds"] += _time.perf_counter() - _t0
         # standing-cap exchange keyed by the window HEAD verb: the head
         # is the same global verb on every rank (FIFO + common-prefix
         # processing), and per-head payload sizes are stable in steady
         # loops — so the exchange stays on the 1-round path
-        blobs = multihost.capped_exchange(
-            pickle.dumps(local), self._mh_caps,
-            (local[0][0], local[0][1]))
-        windows = [pickle.loads(b) for b in blobs]
+        blobs = multihost.capped_exchange(blob, self._mh_caps,
+                                          (local[0][0], local[0][1]))
+        _t0 = _time.perf_counter()
+        windows: list = []
+        for i, b in enumerate(blobs):
+            if i == my_rank:
+                # our own verbs verbatim — no decode round-trip, and
+                # deferred values keep their .local arrays
+                windows.append(local)
+                continue
+            head_kind, head_mt = wire.decode_head_kind(b)
+            CHECK(head_kind == "window",
+                  f"multi-process window heads diverge: rank {i} is at "
+                  f"a non-verb barrier (msg_type {head_mt}) while rank "
+                  f"{my_rank} exchanges verbs — every process must "
+                  f"reach the same stream position (the SPMD collective "
+                  f"contract)")
+            windows.append(wire.decode_window(b))
+        multihost.STATS["wire_decode_seconds"] += _time.perf_counter() - _t0
         self.mh_window_exchanges += 1
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
@@ -398,31 +539,73 @@ class Server(Actor):
                     my_rank: int) -> None:
         """A table's window-worth of collective Adds: merged across
         positions AND ranks when the table accepts, per-position
-        otherwise. Failures reply to this rank's own messages only —
-        every rank reaches identical decisions from identical parts."""
+        otherwise. Positions whose values rode the DEVICE wire (any
+        rank's part holds a DeferredArray — visible identically on
+        every rank from the exchanged metadata) apply through the
+        table's device-parts collectives and never join a host merge —
+        as ONE merged device round when the table offers
+        ProcessAddRunPartsDevice, per position otherwise.
+        Failures reply to this rank's own messages only — every rank
+        reaches identical decisions from identical parts."""
         try:
             table = self.store_[tid]
         except Exception as exc:
             for p in positions:
                 verbs[p].reply(exc)
             return
-        if len(positions) > 1:
+        deferred = {p for p in positions
+                    if any(isinstance(q.get("values"), wire.DeferredArray)
+                           for q in parts_at[p])}
+        # the HOST-wire subset still merges when device-wire positions
+        # share the run — one large deferred Add must not demote the
+        # small-burst positions back to per-position dispatches
+        host_pos = [p for p in positions if p not in deferred]
+        pending = list(positions)
+        if len(host_pos) > 1:
             try:
-                merged = table.ProcessAddRunParts(
-                    [parts_at[p] for p in positions], my_rank)
+                merged = bool(table.ProcessAddRunParts(
+                    [parts_at[p] for p in host_pos], my_rank))
             except Exception as exc:
                 Log.Error("table %d merged parts Add failed: %r", tid, exc)
-                for p in positions:
+                for p in pending:
                     verbs[p].reply(exc)
                 return
             if merged:
-                for p in positions:
+                self.mh_add_dispatches += 1
+                self.mh_add_run_merged += 1
+                for p in host_pos:
                     verbs[p].reply(None)
+                pending = [p for p in pending if p in deferred]
+        # ...and the DEVICE-wire subset merges too: one collective parts
+        # round for the run's deferred positions when the table offers
+        # ProcessAddRunPartsDevice (decisions from exchanged metadata,
+        # so every rank merges or declines identically)
+        dev_pos = [p for p in pending if p in deferred]
+        if len(dev_pos) > 1:
+            try:
+                dev_merged = bool(table.ProcessAddRunPartsDevice(
+                    [parts_at[p] for p in dev_pos], my_rank))
+            except Exception as exc:
+                Log.Error("table %d merged device Add failed: %r", tid, exc)
+                for p in pending:
+                    verbs[p].reply(exc)
                 return
-        for p in positions:
+            if dev_merged:
+                self.mh_add_dispatches += 1
+                self.mh_add_run_merged += 1
+                self.mh_device_wire_adds += len(dev_pos)
+                for p in dev_pos:
+                    verbs[p].reply(None)
+                pending = [p for p in pending if p not in deferred]
+        for p in pending:
             with monitor_region("SERVER_PROCESS_ADD"):
                 try:
-                    table.ProcessAddParts(parts_at[p], my_rank)
+                    if p in deferred:
+                        table.ProcessAddPartsDevice(parts_at[p], my_rank)
+                        self.mh_device_wire_adds += 1
+                    else:
+                        table.ProcessAddParts(parts_at[p], my_rank)
+                    self.mh_add_dispatches += 1
                 except Exception as exc:
                     Log.Error("table %d parts Add failed: %r", tid, exc)
                     verbs[p].reply(exc)

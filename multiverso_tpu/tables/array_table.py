@@ -77,8 +77,16 @@ class ArrayServer(ServerTable):
         # the engine's jitted programs ARE the device-plane bodies —
         # one source of truth for the updater call convention
         self._update = jax.jit(self.device_update, donate_argnums=(0,))
+        self._update_parts_jit = jax.jit(self.device_update_parts,
+                                         donate_argnums=(0,))
         self._access = jax.jit(self.device_access)
         self._has_access = type(self.updater).access is not Updater.access
+        # engine add-run merging (ProcessAddRunParts) is sound for
+        # exactly the LINEAR aux-free updaters — pre-summing a window of
+        # whole-table deltas equals sequential application then (the
+        # matrix table's _merge_adds gate; updaters/base.py combine_scale)
+        self._merge_adds = (self.updater.combine_scale is not None
+                            and not jax.tree.leaves(aux))
 
     def _per_leaf_sharding(self, leaf, ctx):
         """data-shaped leaves shard like data; (num_workers, ...) leaves shard
@@ -108,17 +116,110 @@ class ArrayServer(ServerTable):
     def ProcessAddParts(self, parts, my_rank: int) -> None:
         """Windowed-engine collective Add: every rank's payload arrived
         through the one window exchange — sum them here with NO further
-        host collective (multihost.py sum_collective_add semantics)."""
-        opts = [p.get("option") for p in parts]
-        CHECK(all(o == opts[0] for o in opts),
-              f"collective Add options diverge across processes: {opts}")
+        host collective (multihost.py sum_collective_add semantics).
+        ``option=None`` normalizes to the default AddOption BEFORE the
+        cross-rank equality CHECK (matrix _prep_add_parts parity): a
+        semantically identical None-vs-default mix across ranks must
+        not FatalError the world."""
+        opts = self._check_parts_options(parts)
         vals = []
         for p in parts:
             v = np.asarray(p["values"], self.dtype).ravel()
             CHECK(v.size == self.size, "Add size mismatch")
             vals.append(v)
         summed = np.sum(vals, axis=0).astype(self.dtype)
-        self._apply_summed(summed, opts[my_rank] or AddOption())
+        self._apply_summed(summed, opts[my_rank])
+
+    def ProcessAddRunParts(self, positions, my_rank: int) -> bool:
+        """Cross-rank add-coalescing (tables/base.py contract): a
+        window's whole-table collective Adds pre-sum into ONE apply —
+        sound exactly for linear aux-free updaters (option scalars are
+        ignored by contract then, so per-position options may differ).
+        Declines on any validation doubt so the per-position path
+        reports precise errors."""
+        if not self._merge_adds:
+            return False
+        vals = []
+        for parts in positions:
+            opts = self._norm_parts_options(parts)
+            if not all(o == opts[0] for o in opts):
+                return False
+            for p in parts:
+                v = p.get("values")
+                if not isinstance(v, np.ndarray) or v.size != self.size:
+                    return False
+                vals.append(np.asarray(v, self.dtype).ravel())
+        summed = np.sum(vals, axis=0).astype(self.dtype)
+        self._apply_summed(summed, AddOption())
+        return True
+
+    # -- DEVICE-wire transport (round 6; tables/base.py contract) -----------
+
+    def device_wire_add_ok(self, payload) -> bool:
+        """A whole-table dense delta can ride the device wire: the
+        per-rank deltas stack batch-sharded (device_place_parts_delta)
+        and sum inside ONE traced collective round
+        (device_update_parts) — no host staging of the values."""
+        v = payload.get("values")
+        return isinstance(v, np.ndarray) and v.size == self.size
+
+    def ProcessAddPartsDevice(self, parts, my_rank: int) -> None:
+        """One collective whole-table Add whose values ride the device
+        wire (deferred values are wire.DeferredArray placeholders; ours
+        carries the real array in .local)."""
+        from multiverso_tpu.parallel import wire
+        opts = self._check_parts_options(parts)
+        for p in parts:
+            v = p["values"]
+            size = v.size if isinstance(v, wire.DeferredArray) \
+                else np.asarray(v).size
+            CHECK(size == self.size, "Add size mismatch")
+        mine = parts[my_rank]["values"]
+        local = mine.local if isinstance(mine, wire.DeferredArray) else mine
+        CHECK(local is not None,
+              "device-wire Add lost its local values (engine bug)")
+        gdelta = self.device_place_parts_delta(
+            np.asarray(local, self.dtype).ravel())
+        self.state = self._update_parts_jit(self.state, gdelta,
+                                            opts[0].as_jnp())
+
+    def ProcessAddRunPartsDevice(self, positions, my_rank: int) -> bool:
+        """Merged DEVICE-wire run (tables/base.py contract): a window's
+        deferred whole-table Adds pre-sum THIS rank's local deltas and
+        apply in ONE parts round — sound exactly for linear aux-free
+        updaters (the ProcessAddRunParts contract). Accept/decline is
+        computed from the EXCHANGED metadata, identically on every
+        rank."""
+        if not self._merge_adds:
+            return False
+        from multiverso_tpu.parallel import wire
+        my_vals = []
+        for parts in positions:
+            opts = self._norm_parts_options(parts)
+            if not all(o == opts[0] for o in opts):
+                return False
+            for r, p in enumerate(parts):
+                v = p.get("values")
+                if isinstance(v, wire.DeferredArray):
+                    size = v.size
+                elif isinstance(v, np.ndarray):
+                    size = v.size
+                else:
+                    return False
+                if size != self.size:
+                    return False
+                if r == my_rank:
+                    local = v.local if isinstance(v, wire.DeferredArray) \
+                        else v
+                    CHECK(local is not None,
+                          "device-wire Add lost its local values "
+                          "(engine bug)")
+                    my_vals.append(np.asarray(local, self.dtype).ravel())
+        summed = np.sum(my_vals, axis=0).astype(self.dtype)
+        gdelta = self.device_place_parts_delta(summed)
+        self.state = self._update_parts_jit(self.state, gdelta,
+                                            AddOption().as_jnp())
+        return True
 
     def ProcessGet(self, option: GetOption) -> np.ndarray:
         if multihost.process_count() > 1:

@@ -105,6 +105,23 @@ class ServerTable:
         dict in rank order (``parts[my_rank]`` is this rank's own)."""
         self.ProcessAdd(**parts[my_rank])
 
+    @staticmethod
+    def _norm_parts_options(parts) -> list:
+        """Every rank's Add option in rank order, ``None`` normalized to
+        the default: cross-rank agreement must compare SEMANTICS — a
+        rank that spelled the default as None is not divergent."""
+        return [p.get("option") or AddOption() for p in parts]
+
+    @classmethod
+    def _check_parts_options(cls, parts) -> list:
+        """Normalized options, CHECK-failing the world when ranks truly
+        diverge (the SPMD collective contract). Sites that prefer to
+        decline a merge instead use _norm_parts_options directly."""
+        opts = cls._norm_parts_options(parts)
+        CHECK(all(o == opts[0] for o in opts),
+              f"collective Add options diverge across processes: {opts}")
+        return opts
+
     def ProcessGetParts(self, parts, my_rank: int):
         """Serve ONE logical collective Get for THIS rank given every
         rank's payload dict in rank order; returns this rank's result."""
@@ -127,6 +144,47 @@ class ServerTable:
         position's request only), or None to decline (per-position
         ProcessGetParts then runs)."""
         return None
+
+    # -- DEVICE-wire transport hooks (round 6; sync/server.py adaptive
+    # transport). When the engine selects the device wire for an Add
+    # (-window_transport, payload-size auto rule), the window exchange
+    # ships only the values' dtype/shape metadata (wire.DeferredArray)
+    # and the bytes move through the table's own device-parts
+    # collectives — on a pod that is ICI at fabric bandwidth instead of
+    # the host staging allgather. A table opts in per payload via
+    # device_wire_add_ok; the engine then routes the position through
+    # ProcessAddPartsDevice on EVERY rank (the deferred flag is visible
+    # in the exchanged metadata, so the decision is lockstep).
+
+    def device_wire_add_ok(self, payload) -> bool:
+        """True when this table can apply ``payload`` as a collective
+        Add whose ``values`` bytes never cross the host wire. Default
+        False — the engine never defers for tables that don't opt in,
+        so ProcessAddPartsDevice stays unreachable for them."""
+        return False
+
+    def ProcessAddPartsDevice(self, parts, my_rank: int) -> None:
+        """Apply ONE logical collective Add whose values ride the
+        device wire: ``parts`` is every rank's payload dict in rank
+        order, where deferred values are wire.DeferredArray placeholders
+        (this rank's placeholder carries the real array in ``.local``).
+        Must run a COLLECTIVE device program (every rank participates)
+        and must not issue host collectives. Only reachable after
+        device_wire_add_ok accepted the payload at pack time."""
+        raise NotImplementedError(
+            "device-wire Add routed to a table without "
+            "ProcessAddPartsDevice (device_wire_add_ok must stay False "
+            "for such tables)")
+
+    def ProcessAddRunPartsDevice(self, positions, my_rank: int) -> bool:
+        """Merged device-wire run: apply a window's deferred collective
+        Adds (``positions`` is a list over window positions of per-rank
+        payload dicts whose values may be wire.DeferredArray) in ONE
+        collective device round and return True, or False to decline
+        (per-position ProcessAddPartsDevice then runs). Same linearity
+        contract as ProcessAddRunParts; every rank must reach the same
+        accept/decline decision from the exchanged metadata."""
+        return False
 
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:

@@ -293,10 +293,12 @@ class KVServerTable(ServerTable):
     def ProcessAddParts(self, parts, my_rank: int) -> None:
         """Windowed-engine collective Add: rank-order concatenation of
         the exchanged per-rank (keys, values) — the same index evolution
-        merge_collective_add produced, with no collective here."""
-        opts = [p.get("option") for p in parts]
-        CHECK(all(o == opts[0] for o in opts),
-              f"collective Add options diverge across processes: {opts}")
+        merge_collective_add produced, with no collective here.
+        ``option=None`` normalizes to the default AddOption BEFORE the
+        cross-rank equality CHECK (matrix _prep_add_parts parity): a
+        semantically identical None-vs-default mix across ranks must
+        not FatalError the world."""
+        opts = self._check_parts_options(parts)
         all_keys, all_deltas = [], []
         for p in parts:
             k = np.asarray(p["keys"], np.int64).ravel()
@@ -306,6 +308,45 @@ class KVServerTable(ServerTable):
             all_deltas.append(d)
         self._apply_merged_kv(np.concatenate(all_keys),
                               np.concatenate(all_deltas))
+
+    def ProcessAddRunParts(self, positions, my_rank: int) -> bool:
+        """Cross-rank add-coalescing (tables/base.py contract): a
+        window's collective KV Adds merge into ONE scatter-add. Always
+        sound — the KV Add is plain ``+=`` with no updater (reference
+        kv_table.h:82-112, option scalars never consulted), and the
+        position-major/rank-major concatenation preserves the exact
+        key-first-sight order sequential per-position applies would
+        produce, so the slot index evolves identically on every rank.
+        Declines on any validation doubt so the per-position path
+        reports precise errors.
+
+        The KV table deliberately does NOT opt into the device wire
+        (device_wire_add_ok stays False): its keys must cross the host
+        exchange anyway (slot creation is host control-plane logic that
+        every rank replays), and the values are the same order of
+        magnitude as the keys — deferring them would halve the wire
+        bytes at best while buying an extra collective device program
+        per position."""
+        all_keys, all_deltas = [], []
+        for parts in positions:
+            opts = self._norm_parts_options(parts)
+            if not all(o == opts[0] for o in opts):
+                return False
+            for p in parts:
+                k = p.get("keys")
+                d = p.get("values")
+                if not isinstance(k, np.ndarray) \
+                        or not isinstance(d, np.ndarray):
+                    return False
+                k = np.asarray(k, np.int64).ravel()
+                d = np.asarray(d, self.dtype).ravel()
+                if k.size != d.size:
+                    return False
+                all_keys.append(k)
+                all_deltas.append(d)
+        self._apply_merged_kv(np.concatenate(all_keys),
+                              np.concatenate(all_deltas))
+        return True
 
     def _apply_merged_kv(self, keys: np.ndarray, deltas: np.ndarray) -> None:
         slots = self._slots_for(keys, create=True)
@@ -617,6 +658,15 @@ class KVWorkerTable(WorkerTable):
         keys = np.asarray(keys, np.int64).ravel()
         vals = np.asarray(values, self.dtype).ravel()
         self.Wait(self.AddAsync({"keys": keys, "values": vals}, option))
+
+    def AddFireForget(self, keys, values,
+                      option: Optional[AddOption] = None) -> None:
+        """Untracked async push — no Waiter/result bookkeeping (the
+        array/matrix AddFireForget contract; bursts of these coalesce
+        into merged dispatches in the engine window)."""
+        keys = np.asarray(keys, np.int64).ravel()
+        vals = np.asarray(values, self.dtype).ravel()
+        self.AddAsync({"keys": keys, "values": vals}, option, track=False)
 
     def raw(self) -> Dict[int, float]:
         """Local cache of last-fetched values (reference kv_table.h:40)."""

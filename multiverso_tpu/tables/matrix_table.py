@@ -44,10 +44,11 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from multiverso_tpu import ops
-from multiverso_tpu.parallel import multihost
+from multiverso_tpu.parallel import multihost, wire
 from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
                                           local_device_count, next_bucket,
                                           parts_bucket, place_parts,
+                                          shard_map,
                                           storage_partition_server)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
@@ -303,7 +304,7 @@ class MatrixServerTable(ServerTable):
                 data, aux = _update_rows_local(state["data"], state["aux"],
                                                ids, deltas, opt)
                 return {"data": data, "aux": aux}
-            data, aux = jax.shard_map(
+            data, aux = shard_map(
                 _update_rows_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
                           P()),
@@ -395,7 +396,7 @@ class MatrixServerTable(ServerTable):
             if single:
                 # 1-server fast path (see _update_rows)
                 return _gather_rows_local(data, aux, ids)
-            return jax.shard_map(
+            return shard_map(
                 _gather_rows_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P()),
                 out_specs=P(),
@@ -449,7 +450,7 @@ class MatrixServerTable(ServerTable):
                 data, aux, rows = _update_gather_local(
                     state["data"], state["aux"], ids, deltas, opt)
                 return {"data": data, "aux": aux}, rows
-            data, aux, rows = jax.shard_map(
+            data, aux, rows = shard_map(
                 _update_gather_local, mesh=self._mesh,
                 in_specs=(P(SERVER_AXIS, None), self._aux_specs, P(), P(),
                           P()),
@@ -813,9 +814,7 @@ class MatrixServerTable(ServerTable):
         """Validate + normalize one collective Add's per-rank payloads ->
         (option, kind, per-rank (ids, deltas)); kind in {'whole','rows'}.
         Compressed payloads are handled by _mh_add_compressed_parts."""
-        opts = [p.get("option") or AddOption() for p in parts]
-        CHECK(all(o == opts[0] for o in opts),
-              f"collective Add options diverge across processes: {opts}")
+        opts = self._check_parts_options(parts)
         whole = [p.get("row_ids") is None and p.get("compressed") is None
                  for p in parts]
         CHECK(all(whole) or not any(whole),
@@ -860,9 +859,7 @@ class MatrixServerTable(ServerTable):
         (update(update(s,a),b) == update(s,a+b)); non-linear updaters
         decompress on host and apply the merged batch (the documented
         duplicate pre-combine contract needs the whole batch at once)."""
-        opts = [p.get("option") or AddOption() for p in parts]
-        CHECK(all(o == opts[0] for o in opts),
-              f"collective Add options diverge across processes: {opts}")
+        opts = self._check_parts_options(parts)
         option = opts[0]
         if self.updater.combine_scale is None:
             # non-linear: host-decompress every rank's payload, merge,
@@ -986,7 +983,7 @@ class MatrixServerTable(ServerTable):
             return False
         all_ids, all_deltas, noted = [], [], []
         for parts in positions:
-            opts = [p.get("option") or AddOption() for p in parts]
+            opts = self._norm_parts_options(parts)
             if not all(o == opts[0] for o in opts):
                 return False
             rank_ids = []
@@ -1022,6 +1019,123 @@ class MatrixServerTable(ServerTable):
         # subclass bookkeeping fires per position in window order with
         # per-rank id sets (SparseMatrixTable freshness needs each add's
         # attribution), exactly like the per-position path
+        for option, rank_ids in noted:
+            self._note_add_parts(option, rank_ids)
+        return True
+
+    # -- DEVICE-wire transport (round 6; tables/base.py contract) -----------
+
+    def device_wire_add_ok(self, payload) -> bool:
+        """Row-set Adds with a plain dense delta can ride the device
+        wire: the ids (tiny) cross the host exchange, the delta block
+        moves through the batch-sharded parts round (place_parts + ONE
+        traced collective update — _update_rows_parts_j, the same
+        program device_apply_rows runs). Whole-table payloads decline
+        (their replicated-sum shape isn't what the parts round models),
+        and COMPRESSED TABLES decline entirely: compression already
+        shrank the host bytes (deferring would forfeit exactly that),
+        and its dense fallback is data-dependent PER RANK — this rank's
+        dense payload may sit at the same position as a peer's
+        compressed one, which only the host path's mixed-parts apply
+        handles."""
+        return (self.compress is None
+                and payload.get("row_ids") is not None
+                and payload.get("compressed") is None
+                and isinstance(payload.get("values"), np.ndarray))
+
+    def ProcessAddPartsDevice(self, parts, my_rank: int) -> None:
+        """One collective row Add whose values ride the device wire.
+        Every rank validates every rank's metadata (ids + declared
+        value shapes) so failures raise identically everywhere; the
+        shared bucket derives from the exchanged shapes — no extra host
+        round. NOTE: on the CPU backend this drops the native host
+        mirror (any device-path write does) — the transport config owns
+        that trade; this host's measured crossover keeps auto mode on
+        the host wire (sync/server.py -window_transport)."""
+        opts = self._check_parts_options(parts)
+        rank_ids = []
+        for p in parts:
+            ids = np.asarray(p["row_ids"], np.int32).ravel()
+            self._check_ids(ids)
+            v = p["values"]
+            size = v.size if isinstance(v, wire.DeferredArray) \
+                else np.asarray(v).size
+            CHECK(size == ids.size * self.num_cols,
+                  "device-wire Add size mismatch")
+            rank_ids.append(ids)
+        mine = parts[my_rank]["values"]
+        local_vals = mine.local if isinstance(mine, wire.DeferredArray) \
+            else mine
+        CHECK(local_vals is not None,
+              "device-wire Add lost its local values (engine bug)")
+        # shared bucket from the EXCHANGED metadata — every rank computes
+        # the same rung, so the collective parts program traces once
+        bucket = parts_bucket(max(len(i) for i in rank_ids),
+                              local_device_count(self._mesh))
+        local_vals = np.asarray(local_vals, self.dtype).reshape(
+            len(rank_ids[my_rank]), self.num_cols)
+        gids, gdeltas = self.device_place_batch(rank_ids[my_rank],
+                                                local_vals, bucket=bucket)
+        self.state = self._update_rows_parts_j(self.state, gids, gdeltas,
+                                               opts[0].as_jnp())
+        self._note_add_parts(opts[0], rank_ids)
+
+    def ProcessAddRunPartsDevice(self, positions, my_rank: int) -> bool:
+        """Merged DEVICE-wire run (tables/base.py contract): a window's
+        deferred row Adds concatenate per rank — from the EXCHANGED
+        metadata, so every rank builds the identical batch — and apply
+        in ONE batch-sharded parts round instead of one traced
+        collective per position (dedup_rows pre-combines duplicate ids
+        across positions AND ranks by summing). Linear aux-free
+        updaters only (the ProcessAddRunParts contract); declines on
+        validation doubt so the per-position device path reports
+        precise errors. Subclass bookkeeping fires per position in
+        window order after the merged apply (the SparseMatrixTable
+        soundness note)."""
+        if not self._merge_adds:
+            return False
+        n_ranks = len(positions[0])
+        cat_ids: list = [[] for _ in range(n_ranks)]
+        my_vals, noted = [], []
+        for parts in positions:
+            opts = self._norm_parts_options(parts)
+            if not all(o == opts[0] for o in opts):
+                return False
+            rank_ids = []
+            for r, p in enumerate(parts):
+                row_ids = p.get("row_ids")
+                if row_ids is None or p.get("compressed") is not None:
+                    return False
+                ids = np.asarray(row_ids, np.int32).ravel()
+                if (ids.size == 0 or int(ids.min()) < 0
+                        or int(ids.max()) >= self.num_rows):
+                    return False
+                v = p.get("values")
+                size = v.size if isinstance(v, wire.DeferredArray) \
+                    else np.asarray(v).size
+                if size != ids.size * self.num_cols:
+                    return False
+                if r == my_rank:
+                    local = v.local if isinstance(v, wire.DeferredArray) \
+                        else v
+                    CHECK(local is not None,
+                          "device-wire Add lost its local values "
+                          "(engine bug)")
+                    my_vals.append(np.asarray(local, self.dtype).reshape(
+                        len(ids), self.num_cols))
+                cat_ids[r].append(ids)
+                rank_ids.append(ids)
+            noted.append((opts[0], rank_ids))
+        cat_ids = [np.concatenate(i) for i in cat_ids]
+        bucket = parts_bucket(max(len(i) for i in cat_ids),
+                              local_device_count(self._mesh))
+        gids, gdeltas = self.device_place_batch(cat_ids[my_rank],
+                                                np.concatenate(my_vals),
+                                                bucket=bucket)
+        # linear contract: option scalars are ignored, exactly like the
+        # merged host run's single default-option apply
+        self.state = self._update_rows_parts_j(self.state, gids, gdeltas,
+                                               AddOption().as_jnp())
         for option, rank_ids in noted:
             self._note_add_parts(option, rank_ids)
         return True

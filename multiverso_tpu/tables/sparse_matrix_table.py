@@ -176,6 +176,17 @@ class SparseMatrixServerTable(MatrixServerTable):
         return out_ids, rows
 
     # -- windowed-engine parts hooks (round 5) ------------------------------
+    # Cross-rank MERGED ADD-RUNS (round 6): this table inherits the
+    # parent's ProcessAddRunParts / ProcessAddPartsDevice unchanged —
+    # the freshness bits PERMIT the merge. Soundness: the data merge is
+    # gated on linear updaters (order-free sums), and the parent fires
+    # _note_add_parts once per position in window order AFTER the one
+    # merged apply; since the engine serves no Get between a run's Add
+    # positions (Gets group into the before/after segments around the
+    # run), "merged data + ordered notes" is observationally identical
+    # to sequential per-position applies — every (worker, row) staleness
+    # transition happens at the same point relative to every Get that
+    # can see it, on every rank.
 
     def ProcessGetParts(self, parts, my_rank: int):
         """Run the freshness protocol from the exchanged parts — the

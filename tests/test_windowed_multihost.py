@@ -347,6 +347,316 @@ class TestWindowedProtocol:
         run_two_process(_BADADD_CHILD, tmp_path, expect="BADADD OK")
 
 
+_ARRAY_BURST_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption
+from multiverso_tpu.zoo import Zoo
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+N, SZ = 16, 64
+arr = mv.MV_CreateTable(ArrayTableOption(size=SZ))
+arr.Add(np.ones(SZ, np.float32))                       # warm
+srv = Zoo.Get().server_engine
+d0, m0 = srv.mh_add_dispatches, srv.mh_add_run_merged
+# fire-and-forget burst: N whole-table adds coalesce into merged
+# dispatches (round 6 extended ProcessAddRunParts to ArrayTable — the
+# engine applies a window's run as ONE pre-summed apply)
+for i in range(N):
+    arr.AddFireForget(np.full(SZ, 0.5, np.float32))
+got = arr.Get()                                        # drains the burst
+used = srv.mh_add_dispatches - d0
+merged = srv.mh_add_run_merged - m0
+# one merged dispatch per window the burst landed in — far fewer
+# dispatches than the 2N cross-rank positions, and >=1 actually merged
+assert merged >= 1, (used, merged)
+assert used <= N // 2, (used, merged)
+# oracle: warm (1.0 x 2 ranks) + burst (0.5 x N x 2 ranks)
+assert np.allclose(got, 2.0 + 0.5 * N * 2), got[:4]
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} ARRBURST OK dispatches={used} merged={merged}",
+      flush=True)
+'''
+
+
+_KV_BURST_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import KVTableOption
+from multiverso_tpu.zoo import Zoo
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+N = 16
+kv = mv.MV_CreateTable(KVTableOption())
+kv.Add(np.array([7], np.int64), np.array([1.0], np.float32))   # warm
+srv = Zoo.Get().server_engine
+d0, m0 = srv.mh_add_dispatches, srv.mh_add_run_merged
+# divergent per-rank key sets incl. keys FIRST SEEN mid-burst: the
+# merged scatter-add must preserve first-sight slot-creation order
+for i in range(N):
+    keys = np.array([(rank + 1) * 100 + i, 7, 50 + i], np.int64)
+    kv.AddFireForget(keys, np.full(3, 1.0, np.float32))
+got = kv.Get(np.array([7], np.int64))                  # drains the burst
+used = srv.mh_add_dispatches - d0
+merged = srv.mh_add_run_merged - m0
+assert merged >= 1, (used, merged)
+assert used <= N // 2, (used, merged)
+# oracle: key 7 = warm (1 x 2 ranks) + burst (1 x N x 2 ranks)
+assert np.allclose(got, 2.0 + N * 2), got
+# per-rank keys and mid-burst keys all landed with consistent slots
+mine = kv.Get(np.arange(N, dtype=np.int64) + (rank + 1) * 100)
+peer = kv.Get(np.arange(N, dtype=np.int64) + (2 - rank) * 100)
+assert np.allclose(mine, 1.0) and np.allclose(peer, 1.0), (mine, peer)
+assert np.allclose(kv.Get(np.arange(N, dtype=np.int64) + 50), 2.0)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} KVBURST OK dispatches={used} merged={merged}",
+      flush=True)
+'''
+
+
+_TRANSPORT_CHILD = r'''
+import os, sys
+rank, port, mode = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                   MatrixTableOption)
+from multiverso_tpu.zoo import Zoo
+
+flags = [f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+         "-dist_size=2"]
+if mode == "auto":
+    # auto with a floor far below these payloads: eligible Add values
+    # must ride the device wire (the pod-deployment configuration)
+    flags += ["-window_transport=auto", "-window_device_min_bytes=1024"]
+else:
+    flags += ["-window_transport=host"]
+mv.MV_Init(flags)
+R, C, K = 256, 16, 32
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+arr = mv.MV_CreateTable(ArrayTableOption(size=2048))
+kv = mv.MV_CreateTable(KVTableOption())
+srv = Zoo.Get().server_engine
+
+rng = np.random.default_rng(11 + rank)
+ids = np.sort(rng.choice(R, K, replace=False)).astype(np.int32)
+deltas = rng.standard_normal((K, C)).astype(np.float32)   # 2KB > floor
+mat.AddRows(ids, deltas)
+arr.Add(np.full(2048, float(rank + 1), np.float32))       # 8KB > floor
+kv.Add(np.array([3, 4], np.int64), np.ones(2, np.float32))  # never defers
+
+dev = srv.mh_device_wire_adds
+if mode == "auto":
+    # matrix row-set + array whole-table rode the device wire; the KV
+    # payload stayed on the host wire (keys must cross it anyway)
+    assert dev == 2, dev
+else:
+    assert dev == 0, dev
+
+# results identical either way: transport must not change semantics
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(11 + r)
+    oids = np.sort(orng.choice(R, K, replace=False)).astype(np.int32)
+    od = orng.standard_normal((K, C)).astype(np.float32)
+    np.add.at(oracle, oids, od)
+np.testing.assert_allclose(mat.GetRows(np.arange(R, dtype=np.int32)),
+                           oracle, rtol=1e-4, atol=1e-4)
+assert np.allclose(arr.Get(), 3.0), arr.Get()[:4]
+assert np.allclose(kv.Get(np.array([3, 4], np.int64)), 2.0)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} TRANSPORT OK dev={dev}", flush=True)
+'''
+
+
+_MIXED_RUN_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.zoo import Zoo
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-window_transport=auto",
+            "-window_device_min_bytes=1024"])
+R, C, ROUNDS, SMALL = 256, 16, 6, 6
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+mat.AddRows(np.array([0], np.int32), np.zeros((1, C), np.float32))  # warm
+srv = Zoo.Get().server_engine
+d0, m0, v0 = (srv.mh_add_dispatches, srv.mh_add_run_merged,
+              srv.mh_device_wire_adds)
+rng = np.random.default_rng(5 + rank)
+big_ids = [np.sort(rng.choice(R, 32, replace=False)).astype(np.int32)
+           for _ in range(ROUNDS)]
+big_deltas = [rng.standard_normal((32, C)).astype(np.float32)
+              for _ in range(ROUNDS)]          # 2KB >= floor: defers
+positions = 0
+for i in range(ROUNDS):
+    mat.AddFireForget(big_deltas[i], row_ids=big_ids[i])
+    positions += 1
+    for j in range(SMALL):
+        # 64B < floor: stays on the host wire
+        mat.AddFireForget(np.ones((1, C), np.float32),
+                          row_ids=np.array([j], np.int32))
+        positions += 1
+got = mat.GetRows(np.arange(R, dtype=np.int32))     # drains the burst
+used = srv.mh_add_dispatches - d0
+merged = srv.mh_add_run_merged - m0
+dev = srv.mh_device_wire_adds - v0
+# the big Adds rode the device wire AND the small host-wire positions
+# still applied as merged dispatches: one deferred position must not
+# demote its run-mates to per-position applies
+assert dev >= 1, (used, merged, dev)
+assert merged >= 1, (used, merged, dev)
+assert used <= positions // 2, (used, positions)
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(5 + r)
+    oids = [np.sort(orng.choice(R, 32, replace=False)).astype(np.int32)
+            for _ in range(ROUNDS)]
+    od = [orng.standard_normal((32, C)).astype(np.float32)
+          for _ in range(ROUNDS)]
+    for i in range(ROUNDS):
+        np.add.at(oracle, oids[i], od[i])
+oracle[:SMALL] += ROUNDS * 2.0          # small burst, both ranks
+np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-4)
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} MIXEDRUN OK used={used} merged={merged} dev={dev}",
+      flush=True)
+'''
+
+
+_DEVICE_BURST_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import ArrayTableOption, MatrixTableOption
+from multiverso_tpu.zoo import Zoo
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-window_transport=auto",
+            "-window_device_min_bytes=512"])
+R, C, N = 256, 16, 8
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+arr = mv.MV_CreateTable(ArrayTableOption(size=512))
+mat.AddRows(np.array([0], np.int32), np.zeros((1, C), np.float32))
+arr.Add(np.zeros(512, np.float32))                    # warm both
+srv = Zoo.Get().server_engine
+d0, m0, v0 = (srv.mh_add_dispatches, srv.mh_add_run_merged,
+              srv.mh_device_wire_adds)
+rng = np.random.default_rng(9 + rank)
+ids = [np.sort(rng.choice(R, 32, replace=False)).astype(np.int32)
+       for _ in range(N)]
+deltas = [rng.standard_normal((32, C)).astype(np.float32)
+          for _ in range(N)]                          # 2KB each: defers
+for i in range(N):
+    mat.AddFireForget(deltas[i], row_ids=ids[i])
+    arr.AddFireForget(np.full(512, 0.5, np.float32))  # 2KB: defers
+got = mat.GetRows(np.arange(R, dtype=np.int32))       # drains the burst
+got_arr = arr.Get()
+used = srv.mh_add_dispatches - d0
+merged = srv.mh_add_run_merged - m0
+dev = srv.mh_device_wire_adds - v0
+# EVERY burst Add rode the device wire, and deferred runs applied as
+# merged device rounds (ProcessAddRunPartsDevice) — far fewer
+# dispatches than the 2N positions per table
+assert dev == 2 * N, (used, merged, dev)
+assert merged >= 1, (used, merged, dev)
+assert used <= N, (used, merged, dev)
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(9 + r)
+    oids = [np.sort(orng.choice(R, 32, replace=False)).astype(np.int32)
+            for _ in range(N)]
+    od = [orng.standard_normal((32, C)).astype(np.float32)
+          for _ in range(N)]
+    for i in range(N):
+        np.add.at(oracle, oids[i], od[i])
+np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-4)
+assert np.allclose(got_arr, 0.5 * N * 2), got_arr[:4]
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} DEVBURST OK used={used} merged={merged} dev={dev}",
+      flush=True)
+'''
+
+
+class TestPerTableBurstsAndTransport:
+    """Round 6: merged add-runs on every table family, and the adaptive
+    window transport (parallel/wire.py codec + -window_transport)."""
+
+    def test_array_burst_merges_dispatches(self, tmp_path):
+        """A 2-proc ArrayTable fire-and-forget burst applies as merged
+        dispatches (ProcessAddRunParts extended beyond MatrixTable):
+        the engine's dispatch counters must show actual cross-position
+        merging, and the summed result must match the oracle."""
+        run_two_process(_ARRAY_BURST_CHILD, tmp_path, expect="ARRBURST OK")
+
+    def test_kv_burst_merges_dispatches(self, tmp_path):
+        """A 2-proc KVTable fire-and-forget burst (divergent key sets,
+        keys first seen mid-burst) applies as merged scatter-adds with
+        the slot index evolving identically on both ranks."""
+        run_two_process(_KV_BURST_CHILD, tmp_path, expect="KVBURST OK")
+
+    def test_device_burst_merges_device_runs(self, tmp_path):
+        """A 2-proc burst whose Adds ALL ride the device wire applies
+        as merged device rounds (ProcessAddRunPartsDevice on matrix +
+        array tables): one collective parts program per run instead of
+        one per position, with the summed result matching the oracle."""
+        run_two_process(_DEVICE_BURST_CHILD, tmp_path, expect="DEVBURST OK",
+                        timeout=280)
+
+    def test_mixed_run_merges_host_subset(self, tmp_path):
+        """A run mixing one device-wire (deferred) Add with a host-wire
+        burst on the same table still applies the host positions as
+        merged dispatches — a large deferred payload must not demote
+        its run-mates to per-position applies."""
+        run_two_process(_MIXED_RUN_CHILD, tmp_path, expect="MIXEDRUN OK",
+                        timeout=280)
+
+    @pytest.mark.parametrize("mode", ["auto", "host"])
+    def test_transport_selection(self, tmp_path, mode):
+        """-window_transport auto (with a low -window_device_min_bytes
+        floor, the pod configuration) routes eligible Add values over
+        the DEVICE wire — only dtype/shape metadata crosses the host
+        exchange — while host mode keeps everything on the staging
+        allgather; results are identical either way."""
+        run_two_process(_TRANSPORT_CHILD, tmp_path, mode,
+                        expect="TRANSPORT OK")
+
+
 _THREE_CHILD = r'''
 import os, sys
 rank, port = int(sys.argv[1]), sys.argv[2]
