@@ -1091,6 +1091,12 @@ def main() -> int:
 FULL_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "docs", "BENCH_FULL_latest.json")
 
+#: telemetry sidecar: the main process's instrument snapshot
+#: (counters/gauges/histograms, telemetry/metrics.py) written next to
+#: the bench JSON so a run's protocol counters are inspectable later
+TELEMETRY_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "docs", "TELEMETRY_latest.json")
+
 #: final-line fields, most important first; the line is cut to the byte
 #: budget from the tail, never exceeding what the driver's capture holds
 _COMPACT_PRIORITY = [
@@ -1133,6 +1139,13 @@ def emit_results(out: dict, budget: int = 1200) -> None:
         # never point readers at a possibly-STALE previous sidecar
         print(f"full-json sidecar write failed: {exc}", file=sys.stderr)
         sidecar = None
+    try:
+        # telemetry snapshot sidecar (this process's instruments; the
+        # subprocess sections carry theirs in their own NPROC payloads)
+        from multiverso_tpu.telemetry.export import write_snapshot_sidecar
+        write_snapshot_sidecar(TELEMETRY_JSON_PATH)
+    except Exception as exc:  # pragma: no cover - read-only checkout
+        print(f"telemetry sidecar write failed: {exc}", file=sys.stderr)
     print("==== FULL RESULTS (also in docs/BENCH_FULL_latest.json) ====")
     print(json.dumps(out, indent=1, sort_keys=True))
     print("==== COMPACT (final line; full field set in the sidecar) ====")
@@ -1292,12 +1305,21 @@ def window():
         table.Wait(h)
 
 window()                                                # warm
+from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.zoo import Zoo
 eng = Zoo.Get().server_engine
+
+def _wire_seconds():
+    # telemetry histograms replaced the r6 ad-hoc STATS keys: the
+    # engine observes each window's codec encode/decode time into
+    # server.wire.{encode,decode}_s (sync/server.py)
+    snap = tmetrics.snapshot()
+    return (snap.get("server.wire.encode_s", {}).get("sum", 0.0)
+            + snap.get("server.wire.decode_s", {}).get("sum", 0.0))
+
 multihost.host_barrier()
 c0 = multihost.STATS["host_collective_rounds"]
-we0 = multihost.STATS["wire_encode_seconds"]
-wd0 = multihost.STATS["wire_decode_seconds"]
+w0 = _wire_seconds()
 x0 = eng.mh_window_exchanges
 t0 = time.perf_counter()
 for _ in range(ROUNDS):
@@ -1310,9 +1332,7 @@ pipe_coll_per_op = (multihost.STATS["host_collective_rounds"] - c0
 # + zero-copy decode, parallel/wire.py), vs a pickled baseline of the
 # same representative window payload — the r5 wire pickled everything
 wire_windows = max(eng.mh_window_exchanges - x0, 1)
-engine_wire_ms = 1e3 * (multihost.STATS["wire_encode_seconds"] - we0
-                        + multihost.STATS["wire_decode_seconds"] - wd0
-                        ) / wire_windows
+engine_wire_ms = 1e3 * (_wire_seconds() - w0) / wire_windows
 import pickle
 from multiverso_tpu.parallel import wire
 # DISTINCT arrays per verb, like a real window (repeating one object
@@ -1513,10 +1533,12 @@ def burst():
     kv.Get(keys[:1])
 
 burst()                                               # warm
-eng = Zoo.Get().server_engine
+from multiverso_tpu.telemetry import metrics as tmetrics
 multihost.host_barrier()
 c0 = multihost.STATS["host_collective_rounds"]
-d0 = eng.mh_add_dispatches
+# dispatch economics from the telemetry counter (mirrors the engine's
+# mh_add_dispatches — bench consumes the snapshot, not engine fields)
+d0 = tmetrics.snapshot().get("server.add.dispatches", {}).get("value", 0)
 t0 = time.perf_counter()
 for _ in range(ROUNDS):
     burst()
@@ -1525,7 +1547,8 @@ secs = (time.perf_counter() - t0) / (ROUNDS * W)
 barrier_cost = 1 if nproc > 1 else 0
 coll_per_op = (multihost.STATS["host_collective_rounds"] - c0
                - barrier_cost) / ((W + 1) * ROUNDS)
-dispatches_per_add = (eng.mh_add_dispatches - d0) / (W * ROUNDS)
+d1 = tmetrics.snapshot().get("server.add.dispatches", {}).get("value", 0)
+dispatches_per_add = (d1 - d0) / (W * ROUNDS)
 mv.MV_Barrier()
 mv.MV_ShutDown()
 if rank == 0:

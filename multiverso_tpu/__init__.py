@@ -44,6 +44,8 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_LoadCheckpoint,
     MV_StartProfiler,
     MV_StopProfiler,
+    MV_MetricsSnapshot,
+    MV_DumpTrace,
     MV_WorkerContext,
 )
 

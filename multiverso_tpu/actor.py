@@ -18,9 +18,11 @@ exercised standalone in tests for parity.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.telemetry import metrics, trace
 from multiverso_tpu.utils.log import Log
 from multiverso_tpu.utils.mt_queue import MtQueue
 
@@ -41,6 +43,13 @@ class Actor:
         self._handlers: Dict[MsgType, Callable[[Message], None]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        # telemetry: mailbox backlog + how long messages sat in it
+        # (queue-wait is the actor-side half of a verb's latency; the
+        # other half is the handler span). NULL instruments when off.
+        self._m_depth = metrics.gauge(f"actor.{name}.mailbox_depth")
+        self._m_qwait = metrics.histogram(f"actor.{name}.queue_wait_s")
+        self._m_received = metrics.counter(f"actor.{name}.messages")
+        self._span_name = f"actor.{name}.dispatch"
 
     def RegisterHandler(self, msg_type: MsgType, handler: Callable[[Message], None]) -> None:
         self._handlers[msg_type] = handler
@@ -61,25 +70,50 @@ class Actor:
 
     def Receive(self, msg: Message) -> None:
         """Push into the mailbox (reference actor.h:45-47)."""
+        msg._enq_t = time.perf_counter()
         self.mailbox.Push(msg)
+        self._m_received.inc()
+        self._m_depth.set(self.mailbox.Size())
+
+    def note_dequeue(self, msg: Message) -> None:
+        """Telemetry at the moment a message leaves the mailbox: observe
+        its queue wait, refresh the depth gauge (Receive alone would
+        leave it a stale high-water mark once the backlog drains), and
+        close the flow arrow. Idempotent per message (engines drain
+        windows with TryPop and then pass the head back through
+        _dispatch — only the first sighting counts)."""
+        if msg._enq_t:
+            self._m_qwait.observe(time.perf_counter() - msg._enq_t)
+            msg._enq_t = 0.0
+            self._m_depth.set(self.mailbox.Size())
+            trace.flow_end(msg.trace_ctx)
 
     def _dispatch(self, msg: Message) -> None:
         """Route one message through its handler; failures reply to the
         caller's Wait() instead of killing the loop. Shared by the main
         loop and engines that drain extra messages (pipeline windows)."""
+        self.note_dequeue(msg)  # before the unhandled bail-out too, or
+        # the depth gauge sticks at its high-water mark
         handler = self._handlers.get(msg.msg_type)
         if handler is None:
             Log.Error("actor %s: unhandled message type %s", self.name,
                       msg.msg_type)
             return
-        try:
-            handler(msg)
-        except Exception as exc:  # surface, don't kill the loop silently
-            Log.Error("actor %s: handler for %s raised: %r", self.name,
-                      msg.msg_type, exc)
-            # route through the normal reply path so the error reaches
-            # the caller's Wait() and re-raises there
-            msg.reply(exc)
+        # args built only when tracing is on — this is the one span
+        # entry on the per-message hot path (the -trace-off default
+        # must stay allocation-free)
+        with trace.span(self._span_name, cat="actor",
+                        parent=msg.trace_ctx,
+                        args=({"msg_type": int(msg.msg_type)}
+                              if trace.enabled() else None)):
+            try:
+                handler(msg)
+            except Exception as exc:  # surface, don't kill the loop silently
+                Log.Error("actor %s: handler for %s raised: %r", self.name,
+                          msg.msg_type, exc)
+                # route through the normal reply path so the error reaches
+                # the caller's Wait() and re-raises there
+                msg.reply(exc)
 
     def _main(self) -> None:
         self._started.set()

@@ -11,12 +11,13 @@ feed the next MV_Init, rank 0's endpoint being the coordinator.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 import numpy as np
 
 from multiverso_tpu.utils.configure import SetCMDFlag
-from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.zoo import Zoo
 
 
@@ -152,16 +153,63 @@ def MV_WorkerContext(worker_id: int):
     return Zoo.Get().worker_context(worker_id)
 
 
+_profiler_lock = threading.Lock()
+_profiler_active = False
+
+
 def MV_StartProfiler(logdir: str) -> None:
     """Start a JAX profiler trace (xplane) into ``logdir`` — the
     device-side complement of the host-side Monitor dashboard (SURVEY.md
     §5: 'jax profiler/xplane traces + the same named-region dashboard');
-    view with TensorBoard or xprof. One trace at a time."""
+    view with TensorBoard or xprof. One trace at a time — a second start
+    CHECK-fails with a clear message instead of raising from deep inside
+    jax. While the trace runs, telemetry spans (telemetry/trace.py)
+    bridge into ``jax.profiler.TraceAnnotation`` so host spans appear on
+    the xplane timeline alongside the device ops they dispatched."""
+    global _profiler_active
     import jax
-    jax.profiler.start_trace(logdir)
+    with _profiler_lock:
+        CHECK(not _profiler_active,
+              "MV_StartProfiler: a profiler trace is already active — "
+              "one trace at a time (call MV_StopProfiler first)")
+        jax.profiler.start_trace(logdir)
+        _profiler_active = True
+    from multiverso_tpu.telemetry import trace as ttrace
+    ttrace.set_xplane(True)
 
 
 def MV_StopProfiler() -> None:
-    """Stop the trace started by ``MV_StartProfiler`` and flush it."""
-    import jax
-    jax.profiler.stop_trace()
+    """Stop the trace started by ``MV_StartProfiler`` and flush it.
+    Without an active trace this is a logged no-op."""
+    global _profiler_active
+    from multiverso_tpu.telemetry import trace as ttrace
+    with _profiler_lock:
+        if not _profiler_active:
+            Log.Error("MV_StopProfiler without an active MV_StartProfiler "
+                      "trace — no-op")
+            return
+        ttrace.set_xplane(False)
+        import jax
+        jax.profiler.stop_trace()
+        _profiler_active = False
+
+
+def MV_MetricsSnapshot() -> dict:
+    """Job-wide telemetry snapshot: every registered instrument
+    (telemetry/metrics.py) summed across hosts — ``{name: {"type":
+    ..., "value"/"count"/"p50"/...}}``. COLLECTIVE in a multi-process
+    world: every process must call it at the same point with the engine
+    quiesced (after tracked verbs have replied / after MV_Barrier),
+    exactly like Dashboard.AggregateAcrossHosts. Identity
+    single-process."""
+    from multiverso_tpu.telemetry import metrics
+    return metrics.merged_snapshot()
+
+
+def MV_DumpTrace(path: str) -> str:
+    """Write the buffered telemetry spans (``-trace=true``) as Chrome
+    trace-event JSON to ``path`` — load it in Perfetto
+    (https://ui.perfetto.dev) or chrome://tracing. Per-rank in multihost
+    jobs (each rank dumps its own spans). Returns ``path``."""
+    from multiverso_tpu.telemetry import trace
+    return trace.dump(path)

@@ -83,14 +83,11 @@ _owns_runtime = False   # True only when WE called jax.distributed.initialize
 STATS = {"host_collective_rounds": 0,
          #: wall seconds spent inside capped_exchange (the windowed
          #: engine's one host-collective path) — lets the bench decompose
-         #: the 2-proc cost into protocol rounds vs shared-core compute
-         "exchange_seconds": 0.0,
-         #: wall seconds the windowed engine spent encoding/decoding
-         #: window blobs (parallel/wire.py flat codec; sync/server.py
-         #: accumulates) — the bench compares these per-window against a
-         #: pickled baseline of the same payloads
-         "wire_encode_seconds": 0.0,
-         "wire_decode_seconds": 0.0}
+         #: the 2-proc cost into protocol rounds vs shared-core compute.
+         #: Wire encode/decode timing moved to the telemetry histograms
+         #: server.wire.{encode,decode}_s (telemetry/metrics.py) — the
+         #: bench reads those from MV_MetricsSnapshot now.
+         "exchange_seconds": 0.0}
 
 
 def note_collective(n: int = 1) -> None:

@@ -108,6 +108,22 @@ class DeferredArray:
         return f"DeferredArray({self.dtype.str}, {self.shape}, {tag})"
 
 
+def payload_nbytes(payload: dict) -> int:
+    """Array bytes a verb payload carries — the ONE byte-accounting
+    rule shared by the worker-side telemetry counters (tables/base.py)
+    and the engine's window byte budget (sync/server.py), so the two
+    sides can never drift. DeferredArray placeholders count zero here:
+    their bytes ride the device wire, not this payload."""
+    total = 0
+    for v in payload.values():
+        if isinstance(v, np.ndarray):
+            total += v.nbytes
+        elif isinstance(v, dict):       # compressed-wire payloads
+            total += sum(a.nbytes for a in v.values()
+                         if isinstance(a, np.ndarray))
+    return total
+
+
 def dtype_wire_safe(dt) -> bool:
     """True when ``dt`` survives the flat wire: its ``.str`` tag decodes
     back to the SAME dtype. Extension dtypes (e.g. ml_dtypes.bfloat16,
@@ -216,7 +232,12 @@ def encode_window(verbs: List[Tuple[str, int, dict]]) -> bytes:
             parts.append(_U8.pack(len(kb)))
             parts.append(kb)
             _encode_value(parts, payload[key])
-    return b"".join(parts)
+    blob = b"".join(parts)
+    # telemetry byte accounting (per window — not per element, so the
+    # registry lookup is off the hot loop); NULL instrument when off
+    from multiverso_tpu.telemetry import metrics as _tmetrics
+    _tmetrics.counter("wire.encode_bytes").inc(len(blob))
+    return blob
 
 
 class _Cursor:
@@ -291,6 +312,8 @@ def _decode_value(cur: _Cursor):
 def decode_window(blob: bytes) -> List[Tuple[str, int, dict]]:
     """Wire bytes -> ``[(kind, table_id, payload), ...]``. Array entries
     are zero-copy READ-ONLY views into ``blob``."""
+    from multiverso_tpu.telemetry import metrics as _tmetrics
+    _tmetrics.counter("wire.decode_bytes").inc(len(blob))
     cur = _Cursor(blob)
     (magic,) = cur.unpack(_U8)
     if magic != KIND_WINDOW:

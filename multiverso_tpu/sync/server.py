@@ -31,6 +31,8 @@ import numpy as np
 from multiverso_tpu.actor import Actor, actor_names
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.parallel import wire
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
                                             MV_DEFINE_int, MV_DEFINE_string)
@@ -117,6 +119,13 @@ class VectorClock:
     def global_clock(self) -> float:
         return self._global
 
+    def staleness(self) -> float:
+        """How far the fastest still-training worker runs ahead of the
+        global round — the BSP skew the telemetry gauge tracks (0 when
+        every worker is caught up or finished)."""
+        finite = [v for v in self._local if v != _INF]
+        return max(max(finite) - self._global, 0.0) if finite else 0.0
+
     def DebugString(self) -> str:
         local = " ".join("-1" if v == _INF else str(int(v)) for v in self._local)
         return f"global {self._global} local: {local}"
@@ -146,6 +155,24 @@ class Server(Actor):
         #: (multihost.capped_exchange) — evolves identically on every
         #: rank, keeping steady exchanges to ONE collective round
         self._mh_caps: Dict = {}
+        # telemetry (telemetry/metrics.py; NULL instruments when off).
+        # The mh_* int attributes above stay — tests assert them — and
+        # the typed instruments mirror them into snapshots/exports.
+        self._t_window_s = tmetrics.histogram("server.window.latency_s")
+        self._t_encode_s = tmetrics.histogram("server.wire.encode_s")
+        self._t_decode_s = tmetrics.histogram("server.wire.decode_s")
+        self._t_exchanges = tmetrics.counter("server.window.exchanges")
+        self._t_verbs = tmetrics.counter("server.window.verbs")
+        self._t_splits = tmetrics.counter("server.window.barrier_splits")
+        self._t_dispatch = tmetrics.counter("server.add.dispatches")
+        self._t_merged = tmetrics.counter("server.add.run_merged")
+        self._t_defer = tmetrics.counter("server.add.device_deferrals")
+        #: host-vs-device transport byte accounting: what this rank
+        #: actually shipped on the host staging wire vs what it kept
+        #: local for the device-parts collectives (DeferredArray)
+        self._t_host_bytes = tmetrics.counter("server.wire.host_bytes")
+        self._t_dev_bytes = tmetrics.counter("server.wire.device_bytes")
+        self._t_budget = tmetrics.gauge("server.window.host_budget_bytes")
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self._add_entry)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
@@ -200,6 +227,10 @@ class Server(Actor):
             if not ok:
                 break
             batch.append(nxt)
+        for m in batch:
+            # drained members bypass _dispatch — observe their queue
+            # wait here (idempotent; the head was noted there already)
+            self.note_dequeue(m)
         from multiverso_tpu.parallel import multihost
         if multihost.process_count() > 1:
             # multi-process WINDOWED protocol (round 5): one host
@@ -207,6 +238,18 @@ class Server(Actor):
             # from the exchanged parts with cross-rank coalescing/dedup.
             self._mh_windows(batch)
             return
+        _t0 = _time.perf_counter()
+        with ttrace.span("server.window", cat="server",
+                         args={"verbs": len(batch)}):
+            self._local_window(batch)
+        self._t_window_s.observe(_time.perf_counter() - _t0)
+        # count Add/Get verbs only, like the mh path's prefix count —
+        # the counter must mean the same thing in every topology
+        self._t_verbs.inc(sum(1 for m in batch if m.msg_type in
+                              (MsgType.Request_Add, MsgType.Request_Get)))
+
+    def _local_window(self, batch) -> None:
+        """Apply one drained single-process window (see _get_entry)."""
         # Any non-Get/Add message (e.g. Request_StoreLoad's Load) mutates
         # table state outside the Add/Get algebra: it BARRIERS the window.
         # Adds must not coalesce across it (a Load between two Adds would
@@ -227,6 +270,7 @@ class Server(Actor):
                 # barrier: runs its normal handler in order, with
                 # standard error routing; no dedup survives it
                 self.window_barrier_splits += 1
+                self._t_splits.inc()
                 self._dispatch(seg)
                 seen.clear()
                 continue
@@ -347,6 +391,7 @@ class Server(Actor):
                 self._mh_check_barrier_head(head)
                 pending.popleft()
                 self.window_barrier_splits += 1
+                self._t_splits.inc()
                 self._dispatch(head)
                 continue
             verbs = []
@@ -366,16 +411,10 @@ class Server(Actor):
     #: bytes for a W-verb burst of large payloads).
     MH_WINDOW_BYTES = 4 << 20
 
-    @staticmethod
-    def _payload_bytes(payload) -> int:
-        total = 0
-        for v in payload.values():
-            if isinstance(v, np.ndarray):
-                total += v.nbytes
-            elif isinstance(v, dict):     # compressed payloads
-                total += sum(a.nbytes for a in v.values()
-                             if isinstance(a, np.ndarray))
-        return total
+    #: one shared byte-accounting rule with the worker-side telemetry
+    #: counters (wire.payload_nbytes) — the budget and the counters
+    #: must never drift
+    _payload_bytes = staticmethod(wire.payload_nbytes)
 
     def _mh_check_barrier_head(self, head: Message) -> None:
         """Exchange a head-kind marker for a non-verb window head. Every
@@ -437,12 +476,23 @@ class Server(Actor):
             return payload
         out = dict(payload)
         out["values"] = wire.DeferredArray.of(v)
+        self._t_defer.inc()
+        self._t_dev_bytes.inc(v.nbytes)
         return out
 
     def _mh_collective_window(self, verbs) -> int:
         """One collective window: exchange, agree on the common prefix,
         execute it from the exchanged parts. Returns how many of this
         rank's ``verbs`` were processed (>= 1)."""
+        _t_start = _time.perf_counter()
+        with ttrace.span("server.window", cat="server",
+                         parent=verbs[0].trace_ctx,
+                         args={"verbs": len(verbs)}):
+            done = self._mh_collective_window_inner(verbs)
+        self._t_window_s.observe(_time.perf_counter() - _t_start)
+        return done
+
+    def _mh_collective_window_inner(self, verbs) -> int:
         from multiverso_tpu.parallel import multihost
         my_rank = multihost.process_index()
         mode = self._mh_transport()
@@ -460,26 +510,38 @@ class Server(Actor):
             if kind == "A":
                 payload = self._mh_maybe_defer(m.table_id, payload,
                                                mode, min_bytes)
-            packed += self._payload_bytes(payload)
-            if packed > self.MH_WINDOW_BYTES and i > 0:
+                if payload is not m.payload:
+                    # keep the deferred form on the message: a verb
+                    # re-led after a short peer prefix / budget cut must
+                    # not re-defer (and re-count) on the next pack pass
+                    m.payload = payload
+            nbytes = self._payload_bytes(payload)
+            if packed + nbytes > self.MH_WINDOW_BYTES and i > 0:
+                # over-budget verb waits for the next exchange — its
+                # bytes stay OUT of this window's budget accounting
                 verbs = verbs[:i]
                 break
+            packed += nbytes
             local.append((kind, m.table_id, payload))
+        self._t_budget.set(packed)
         # flat binary codec (parallel/wire.py): pickle's object-graph
         # walk + buffer copies were pure overhead for payloads that are
         # already contiguous arrays; decode below is zero-copy.
-        # wire_encode_seconds times the CODEC only (bench compares it
+        # server.wire.encode_s times the CODEC only (bench compares it
         # against the pickled baseline) — packing/transport selection
         # above is engine work either wire would pay
         _t0 = _time.perf_counter()
         blob = wire.encode_window(local)
-        multihost.STATS["wire_encode_seconds"] += _time.perf_counter() - _t0
+        self._t_encode_s.observe(_time.perf_counter() - _t0)
+        self._t_host_bytes.inc(len(blob))
         # standing-cap exchange keyed by the window HEAD verb: the head
         # is the same global verb on every rank (FIFO + common-prefix
         # processing), and per-head payload sizes are stable in steady
         # loops — so the exchange stays on the 1-round path
-        blobs = multihost.capped_exchange(blob, self._mh_caps,
-                                          (local[0][0], local[0][1]))
+        with ttrace.span("server.window.exchange", cat="server",
+                         args={"bytes": len(blob)}):
+            blobs = multihost.capped_exchange(blob, self._mh_caps,
+                                              (local[0][0], local[0][1]))
         _t0 = _time.perf_counter()
         windows: list = []
         for i, b in enumerate(blobs):
@@ -496,8 +558,9 @@ class Server(Actor):
                   f"reach the same stream position (the SPMD collective "
                   f"contract)")
             windows.append(wire.decode_window(b))
-        multihost.STATS["wire_decode_seconds"] += _time.perf_counter() - _t0
+        self._t_decode_s.observe(_time.perf_counter() - _t0)
         self.mh_window_exchanges += 1
+        self._t_exchanges.inc()
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
         CHECK(all(d == descs[0] for d in descs),
@@ -505,6 +568,7 @@ class Server(Actor):
               f"{descs} — every process must issue the same table-verb "
               f"sequence (the SPMD collective contract)")
         self.mh_window_verbs += prefix
+        self._t_verbs.inc(prefix)
         # group per table: Add positions, and Get positions split into
         # the before/after segment around the table's one add-run
         add_pos: Dict[int, list] = {}
@@ -524,15 +588,20 @@ class Server(Actor):
                 if tid in applied:
                     continue
                 applied.add(tid)
-                self._mh_add_run(tid, add_pos[tid], parts_at, verbs,
-                                 my_rank)
+                with ttrace.span("server.window.add_run", cat="server",
+                                 args={"table_id": tid,
+                                       "positions": len(add_pos[tid])}):
+                    self._mh_add_run(tid, add_pos[tid], parts_at, verbs,
+                                     my_rank)
             else:
                 seg = 0 if (tid not in add_pos or i < add_pos[tid][0]) else 1
                 if (tid, seg) in served:
                     continue
                 served.add((tid, seg))
-                self._mh_get_group(tid, get_groups[(tid, seg)], parts_at,
-                                   verbs, my_rank)
+                with ttrace.span("server.window.get_group", cat="server",
+                                 args={"table_id": tid}):
+                    self._mh_get_group(tid, get_groups[(tid, seg)],
+                                       parts_at, verbs, my_rank)
         return prefix
 
     def _mh_add_run(self, tid: int, positions, parts_at, verbs,
@@ -573,6 +642,8 @@ class Server(Actor):
             if merged:
                 self.mh_add_dispatches += 1
                 self.mh_add_run_merged += 1
+                self._t_dispatch.inc()
+                self._t_merged.inc()
                 for p in host_pos:
                     verbs[p].reply(None)
                 pending = [p for p in pending if p in deferred]
@@ -594,6 +665,8 @@ class Server(Actor):
                 self.mh_add_dispatches += 1
                 self.mh_add_run_merged += 1
                 self.mh_device_wire_adds += len(dev_pos)
+                self._t_dispatch.inc()
+                self._t_merged.inc()
                 for p in dev_pos:
                     verbs[p].reply(None)
                 pending = [p for p in pending if p not in deferred]
@@ -606,6 +679,7 @@ class Server(Actor):
                     else:
                         table.ProcessAddParts(parts_at[p], my_rank)
                     self.mh_add_dispatches += 1
+                    self._t_dispatch.inc()
                 except Exception as exc:
                     Log.Error("table %d parts Add failed: %r", tid, exc)
                     verbs[p].reply(exc)
@@ -665,6 +739,8 @@ class Server(Actor):
                     m.reply(exc)
                 return
             if merged:
+                self._t_dispatch.inc()
+                self._t_merged.inc()
                 for m in msgs:
                     m.reply(None)
                 return
@@ -719,6 +795,7 @@ class Server(Actor):
                 Log.Error("table %d ProcessAdd failed: %r", msg.table_id, exc)
                 msg.reply(exc)
                 return
+            self._t_dispatch.inc()
             msg.reply(None)
 
     def ProcessFinishTrain(self, msg: Message) -> None:
@@ -752,6 +829,15 @@ class SyncServer(Server):
         self._num_waited_add = [0] * num_workers
         self._add_cache: Deque[Message] = collections.deque()
         self._get_cache: Deque[Message] = collections.deque()
+        #: telemetry: worst clock skew across both vector clocks — how
+        #: stale the slowest worker's view is vs the fastest's. A
+        #: MAX-merge gauge: the job-wide number is the worst rank's
+        #: skew, not a sum over ranks
+        self._t_staleness = tmetrics.max_gauge("server.bsp.staleness")
+
+    def _note_staleness(self) -> None:
+        self._t_staleness.set(max(self._get_clocks.staleness(),
+                                  self._add_clocks.staleness()))
 
     def ProcessAdd(self, msg: Message) -> None:
         worker = msg.src
@@ -759,6 +845,7 @@ class SyncServer(Server):
         if self._get_clocks.local_clock(worker) > self._get_clocks.global_clock():
             self._add_cache.append(msg)
             self._num_waited_add[worker] += 1
+            self._note_staleness()
             return
         # 2. Process add
         super().ProcessAdd(msg)
@@ -770,6 +857,7 @@ class SyncServer(Server):
                 super().ProcessGet(get_msg)
                 CHECK(not self._get_clocks.Update(get_msg.src),
                       "drained Get must not complete a round")
+        self._note_staleness()
 
     def _get_entry(self, msg: Message) -> None:
         # no pipelining window under BSP: the vector-clock protocol's
@@ -786,6 +874,7 @@ class SyncServer(Server):
         if (self._add_clocks.local_clock(worker) > self._add_clocks.global_clock()
                 or self._num_waited_add[worker] > 0):
             self._get_cache.append(msg)
+            self._note_staleness()
             return
         # 2. Process get
         super().ProcessGet(msg)
@@ -797,6 +886,7 @@ class SyncServer(Server):
                 CHECK(not self._add_clocks.Update(add_msg.src),
                       "drained Add must not complete a round")
                 self._num_waited_add[add_msg.src] -= 1
+        self._note_staleness()
 
     def ProcessFinishTrain(self, msg: Message) -> None:
         """server.cpp:188-211: force worker clocks to infinity, drain caches."""

@@ -33,6 +33,9 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from multiverso_tpu.message import Message, MsgType, next_msg_id
+from multiverso_tpu.parallel.wire import payload_nbytes
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK
@@ -197,6 +200,11 @@ class ServerTable:
 class WorkerTable:
     """Worker half: request construction + waiter bookkeeping."""
 
+    #: short telemetry family tag — concrete tables override (array /
+    #: matrix / sparse_matrix / kv) so per-table instrument names read
+    #: like "table.matrix0.add.count"
+    telemetry_label = "table"
+
     def __init__(self):
         from multiverso_tpu.zoo import Zoo
         self._zoo = Zoo.Get()
@@ -204,6 +212,20 @@ class WorkerTable:
         self._lock = threading.Lock()
         self._waiters: Dict[int, Waiter] = {}
         self._results: Dict[int, Any] = {}
+        self._tele: Optional[Dict[str, Any]] = None
+
+    def _tele_verbs(self) -> Dict[str, Any]:
+        """Per-table per-verb count/byte instruments, fetched lazily —
+        table_id is only assigned after construction (CreateTable)."""
+        if self._tele is None:
+            base = f"table.{self.telemetry_label}{self.table_id}"
+            self._tele = {
+                "get_n": tmetrics.counter(f"{base}.get.count"),
+                "get_b": tmetrics.counter(f"{base}.get.bytes"),
+                "add_n": tmetrics.counter(f"{base}.add.count"),
+                "add_b": tmetrics.counter(f"{base}.add.bytes"),
+            }
+        return self._tele
 
     # -- request plumbing ---------------------------------------------------
 
@@ -229,6 +251,11 @@ class WorkerTable:
         else:
             msg = Message(msg_type=msg_type, table_id=self.table_id,
                           msg_id=msg_id, src=src, payload=payload)
+        # telemetry: carry the worker span's context across the mailbox
+        # hop (the engine parents its dispatch span here) and open the
+        # flow arrow Perfetto draws between the two threads
+        msg.trace_ctx = ttrace.current_ctx()
+        ttrace.flow_start(msg.trace_ctx)
         self._zoo.SendToServer(msg)
         return msg_id
 
@@ -258,8 +285,13 @@ class WorkerTable:
             opt = option or GetOption(worker_id=self._zoo.current_worker_id())
             payload = dict(payload)
             payload["option"] = opt
-            return self._submit(MsgType.Request_Get, payload,
-                                worker_id=opt.worker_id)
+            tele = self._tele_verbs()
+            tele["get_n"].inc()
+            tele["get_b"].inc(payload_nbytes(payload))
+            with ttrace.span("worker.get", cat="worker",
+                             args={"table_id": self.table_id}):
+                return self._submit(MsgType.Request_Get, payload,
+                                    worker_id=opt.worker_id)
 
     def AddAsync(self, payload: Dict[str, Any],
                  option: Optional[AddOption] = None,
@@ -268,8 +300,13 @@ class WorkerTable:
             opt = option or AddOption(worker_id=self._zoo.current_worker_id())
             payload = dict(payload)
             payload["option"] = opt
-            return self._submit(MsgType.Request_Add, payload,
-                                worker_id=opt.worker_id, track=track)
+            tele = self._tele_verbs()
+            tele["add_n"].inc()
+            tele["add_b"].inc(payload_nbytes(payload))
+            with ttrace.span("worker.add", cat="worker",
+                             args={"table_id": self.table_id}):
+                return self._submit(MsgType.Request_Add, payload,
+                                    worker_id=opt.worker_id, track=track)
 
 
 def CreateTable(option: TableOption):

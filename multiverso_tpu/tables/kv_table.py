@@ -627,6 +627,8 @@ class KVServerTable(ServerTable):
 class KVWorkerTable(WorkerTable):
     """Worker half with a local cache (reference kv_table.h:19-46)."""
 
+    telemetry_label = "kv"
+
     def __init__(self, dtype=np.float32):
         super().__init__()
         self.dtype = np.dtype(dtype)

@@ -160,6 +160,8 @@ class MatrixServerTable(ServerTable):
         self.compress = compress
         #: wire accounting for compressed Adds: what the payload would
         #: have cost dense vs what actually crossed host->device
+        #: (mirrored into the telemetry counters
+        #: wire.compress.{dense,payload}_bytes via _note_wire)
         self.wire_stats = {"dense_bytes": 0, "payload_bytes": 0}
         self.num_rows = num_rows
         self.num_cols = num_cols
@@ -719,7 +721,7 @@ class MatrixServerTable(ServerTable):
             self.state = self._consume_sparse(
                 self.state, jnp.asarray(padded), jnp.asarray(idx_p),
                 jnp.asarray(val_p), option.as_jnp())
-            self.wire_stats["payload_bytes"] += idx_p.nbytes + val_p.nbytes
+            self._note_wire(dense_bytes, idx_p.nbytes + val_p.nbytes)
         else:
             packed = np.asarray(comp["packed"], np.uint8)
             CHECK(packed.size * 8 >= len(padded) * self.num_cols,
@@ -731,9 +733,17 @@ class MatrixServerTable(ServerTable):
             self.state = self._consume_1bit(
                 self.state, jnp.asarray(padded), jnp.asarray(packed),
                 jnp.asarray(pos), jnp.asarray(neg), option.as_jnp())
-            self.wire_stats["payload_bytes"] += (packed.nbytes
-                                                 + pos.nbytes + neg.nbytes)
+            self._note_wire(dense_bytes,
+                            packed.nbytes + pos.nbytes + neg.nbytes)
+
+    def _note_wire(self, dense_bytes: int, payload_bytes: int) -> None:
+        """Record one compressed payload's wire economics, locally (the
+        bench's wire_reduction metric) and in the telemetry registry."""
+        from multiverso_tpu.telemetry import metrics as tmetrics
         self.wire_stats["dense_bytes"] += dense_bytes
+        self.wire_stats["payload_bytes"] += payload_bytes
+        tmetrics.counter("wire.compress.dense_bytes").inc(dense_bytes)
+        tmetrics.counter("wire.compress.payload_bytes").inc(payload_bytes)
 
     def _note_add_parts(self, option: AddOption, parts) -> None:
         """Hook: every rank's id set (None = whole table) of the applied
@@ -925,8 +935,7 @@ class MatrixServerTable(ServerTable):
                 combined = _acc_sparse_part(
                     combined, inv_j, jnp.asarray(idx_p),
                     jnp.asarray(val_p), rows=nb_r, cols=cols)
-                self.wire_stats["payload_bytes"] += (idx_p.nbytes
-                                                     + val_p.nbytes)
+                self._note_wire(dense_bytes, idx_p.nbytes + val_p.nbytes)
             else:
                 packed = np.asarray(comp["packed"], np.uint8)
                 CHECK(packed.size * 8 >= nb_r * cols,
@@ -939,10 +948,8 @@ class MatrixServerTable(ServerTable):
                     combined, inv_j, jnp.asarray(packed),
                     jnp.asarray(pos), jnp.asarray(neg), rows=nb_r,
                     cols=cols)
-                self.wire_stats["payload_bytes"] += (packed.nbytes
-                                                     + pos.nbytes
-                                                     + neg.nbytes)
-            self.wire_stats["dense_bytes"] += dense_bytes
+                self._note_wire(dense_bytes,
+                                packed.nbytes + pos.nbytes + neg.nbytes)
         union_p = np.full(bucket, -1, np.int32)
         union_p[: len(union)] = union
         self.state = self._update_rows(self.state, jnp.asarray(union_p),
@@ -1466,6 +1473,8 @@ class MatrixServerTable(ServerTable):
 
 class MatrixWorkerTable(WorkerTable):
     """Worker half (reference matrix_table.h:26-77)."""
+
+    telemetry_label = "matrix"
 
     def __init__(self, num_rows: int, num_cols: int, dtype=np.float32,
                  compress: Optional[str] = None):

@@ -220,6 +220,8 @@ class SparseMatrixWorkerTable(MatrixWorkerTable):
     """Worker half: Get returns (row_ids, rows) since the server picks the
     rows (reference sparse ProcessReplyGet fills only returned rows)."""
 
+    telemetry_label = "sparse_matrix"
+
     def Get(self, option: Optional[GetOption] = None):
         if option is None:
             option = GetOption(worker_id=self._zoo.current_worker_id())

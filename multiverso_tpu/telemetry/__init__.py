@@ -1,0 +1,28 @@
+"""Telemetry — typed metrics, span tracing, and export for the runtime.
+
+The reference's only host-side instrument was the Dashboard monitor
+(count + mean per named region, utils/dashboard.py); production traffic
+needs latency *distributions*, byte accounting, and a way to follow one
+verb across the actor mailboxes. This package provides the three layers
+(docs/DESIGN.md §6):
+
+* ``metrics`` — a thread-safe registry of typed instruments (Counter,
+  Gauge, log-bucketed Histogram with p50/p90/p99) that merges across
+  hosts over the same union-of-names allreduce the Dashboard uses,
+  extended to fixed bucket vectors so every rank agrees on collective
+  shape.
+* ``trace`` — Dapper-style span trees carried on ``Message`` across the
+  worker -> mailbox -> server-window hops, exported as Chrome
+  trace-event JSON (Perfetto-loadable), with
+  ``jax.profiler.TraceAnnotation`` bridging so host spans line up with
+  the xplane device traces ``MV_StartProfiler`` produces.
+* ``export`` — the ``-stats_interval_s`` periodic reporter plus the
+  snapshot/dump helpers behind ``MV_MetricsSnapshot`` /
+  ``MV_DumpTrace``.
+
+Importing this package registers every telemetry flag (``-telemetry``,
+``-trace``, ``-stats_interval_s``) so ``MV_Init`` argv parsing claims
+them.
+"""
+
+from multiverso_tpu.telemetry import export, metrics, trace  # noqa: F401

@@ -1,0 +1,222 @@
+"""Span-based structured tracing across the actor runtime.
+
+Dapper-style: a *span* is a named, timed region on one thread; spans
+nest through a thread-local stack, and a span's context ``(trace_id,
+span_id)`` rides on ``Message.trace_ctx`` so the tree continues on the
+thread that dequeues the message — one tree follows a verb from the
+worker's ``GetAsync/AddAsync`` through the engine mailbox into the
+server's window lifecycle (sync/server.py).
+
+Export is Chrome trace-event JSON (`MV_DumpTrace`), loadable in
+Perfetto / chrome://tracing:
+
+* complete events (``ph: "X"``) — one per finished span, with
+  ``trace_id/span_id/parent_id`` in ``args`` (the tree is explicit even
+  across threads);
+* flow events (``ph: "s"`` at message enqueue, ``ph: "f"`` at dequeue)
+  — Perfetto draws the worker->server mailbox hop as an arrow.
+
+Device correlation: when ``MV_StartProfiler`` has an xplane trace
+active (api.py flips :func:`set_xplane`), every span also enters a
+``jax.profiler.TraceAnnotation`` of the same name, so host spans appear
+on the device timeline next to the XLA ops they dispatched.
+
+Gated by ``-trace`` (default off). The ring buffer is bounded
+(:data:`MAX_EVENTS`): a forgotten long-running trace degrades to
+keeping the most recent events instead of eating the heap.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Optional
+
+from multiverso_tpu.utils.configure import MV_DEFINE_bool, cached_bool_flag
+from multiverso_tpu.utils.log import Log
+
+MV_DEFINE_bool("trace", False,
+               "span tracing on/off (export with MV_DumpTrace)")
+
+#: the -trace gate, CACHED behind a flag listener (hot-path span entry
+#: must not pay a registry-lock GetFlag per message)
+enabled = cached_bool_flag("trace", False)
+
+#: completed-event ring bound — oldest events drop first
+MAX_EVENTS = 200_000
+
+_events = collections.deque(maxlen=MAX_EVENTS)
+_events_lock = threading.Lock()
+_tls = threading.local()
+_id_counter = itertools.count(1)
+_id_lock = threading.Lock()
+#: set by api.MV_StartProfiler/MV_StopProfiler: bridge spans into
+#: jax.profiler.TraceAnnotation while an xplane trace runs
+_xplane_active = False
+
+
+class SpanContext(NamedTuple):
+    trace_id: int
+    span_id: int
+
+
+
+
+def set_xplane(active: bool) -> None:
+    global _xplane_active
+    _xplane_active = bool(active)
+
+
+def _next_id() -> int:
+    # pid-prefixed so ids from different ranks' dumps never collide
+    with _id_lock:
+        return (os.getpid() << 24) | (next(_id_counter) & 0xFFFFFF)
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def current_ctx() -> Optional[SpanContext]:
+    """The calling thread's innermost open span, or None (used to stamp
+    ``Message.trace_ctx`` at enqueue)."""
+    return getattr(_tls, "ctx", None)
+
+
+def _record(event: dict) -> None:
+    with _events_lock:
+        _events.append(event)
+
+
+class _NullSpan:
+    """Shared no-op context manager: the tracing-off fast path must not
+    allocate per call (span() sits on per-message hot paths)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "_parent", "_prev", "_ctx",
+                 "_ann", "_t0")
+
+    def __init__(self, name, parent, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._parent = parent
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        parent_ctx = self._parent if self._parent is not None else self._prev
+        self._parent = parent_ctx
+        sid = _next_id()
+        self._ctx = SpanContext(
+            parent_ctx.trace_id if parent_ctx else sid, sid)
+        _tls.ctx = self._ctx
+        self._ann = None
+        if _xplane_active:
+            try:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = _now_us()
+        return self._ctx
+
+    def __exit__(self, *exc):
+        dur = _now_us() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        _tls.ctx = self._prev
+        ev_args = {"trace_id": self._ctx.trace_id,
+                   "span_id": self._ctx.span_id,
+                   "parent_id": self._parent.span_id if self._parent else 0}
+        if self.args:
+            ev_args.update(self.args)
+        _record({"name": self.name, "cat": self.cat, "ph": "X",
+                 "ts": self._t0, "dur": dur, "pid": os.getpid(),
+                 "tid": threading.get_ident(), "args": ev_args})
+        return False
+
+
+def span(name: str, parent: Optional[SpanContext] = None, cat: str = "mv",
+         args: Optional[dict] = None):
+    """Context manager opening a span for the ``with`` block. ``parent``
+    overrides the thread-local nesting (pass a message's ``trace_ctx``
+    when picking work up from a mailbox). ``with`` yields the span's
+    context (None when tracing is off)."""
+    if not enabled():
+        return _NULL_SPAN
+    return _Span(name, parent, cat, args)
+
+
+def flow_start(ctx: Optional[SpanContext], name: str = "mv.msg") -> None:
+    """Flow-arrow origin (message enqueue). No-op when ``ctx`` is None
+    or tracing is off."""
+    if ctx is None or not enabled():
+        return
+    _record({"name": name, "cat": "msg", "ph": "s", "id": ctx.span_id,
+             "ts": _now_us(), "pid": os.getpid(),
+             "tid": threading.get_ident()})
+
+
+def flow_end(ctx: Optional[SpanContext], name: str = "mv.msg") -> None:
+    """Flow-arrow target (message dequeue on the actor thread)."""
+    if ctx is None or not enabled():
+        return
+    _record({"name": name, "cat": "msg", "ph": "f", "bp": "e",
+             "id": ctx.span_id, "ts": _now_us(), "pid": os.getpid(),
+             "tid": threading.get_ident()})
+
+
+def to_chrome_trace() -> dict:
+    """The buffered events as a Chrome trace-event object (JSON-ready)."""
+    with _events_lock:
+        events = list(_events)
+    meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+             "tid": 0, "args": {"name": _process_label()}}]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def _process_label() -> str:
+    try:
+        from multiverso_tpu.parallel import multihost
+        return f"multiverso rank {multihost.process_index()}"
+    except Exception:
+        return "multiverso"
+
+
+def dump(path: str) -> str:
+    """Write the buffered span tree as Chrome trace JSON to ``path``
+    (per-rank file in multihost jobs — each rank holds its own spans)
+    and return the path."""
+    data = to_chrome_trace()
+    with open(path, "w") as f:
+        json.dump(data, f)
+    Log.Info("telemetry: wrote %d trace events to %s",
+             len(data["traceEvents"]), path)
+    return path
+
+
+def clear() -> None:
+    with _events_lock:
+        _events.clear()
+
+
+def _reset_for_tests() -> None:
+    clear()
+    set_xplane(False)

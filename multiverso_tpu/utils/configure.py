@@ -23,6 +23,44 @@ T = TypeVar("T")
 
 _lock = threading.RLock()
 
+#: change listeners: fn(name_or_None) called after any flag value
+#: changes (None = bulk change, e.g. reset-to-defaults). Lets hot paths
+#: cache flag reads (telemetry gates) instead of taking the registry
+#: lock per call; listeners must be cheap and never raise.
+_listeners: List = []
+
+
+def register_flag_listener(fn) -> None:
+    _listeners.append(fn)
+
+
+def _notify(name) -> None:
+    for fn in _listeners:
+        fn(name)
+
+
+def cached_bool_flag(name: str, default: bool):
+    """Zero-arg callable reading ``name`` as a bool from a listener-
+    refreshed cache — for per-message gates (telemetry/trace) where a
+    GetFlag registry walk per call is too costly. ``default`` applies
+    while the flag is unregistered or the registry is torn down."""
+    state = {"v": default}
+
+    def _refresh(changed=None):
+        if changed is None or changed == name:
+            try:
+                state["v"] = bool(GetFlag(name))
+            except Exception:
+                state["v"] = default
+
+    register_flag_listener(_refresh)
+    _refresh()
+
+    def _get() -> bool:
+        return state["v"]
+
+    return _get
+
 
 class _FlagRegister(Generic[T]):
     """One typed registry (reference configure.h:40-57 FlagRegister<T>)."""
@@ -40,6 +78,7 @@ class _FlagRegister(Generic[T]):
             self.flags.setdefault(name, default)
             self.defaults[name] = default
             self.help[name] = help_text
+        _notify(name)
 
     def reset_to_defaults(self) -> None:
         with _lock:
@@ -50,7 +89,8 @@ class _FlagRegister(Generic[T]):
             if name not in self.flags:
                 return False
             self.flags[name] = self._caster(raw)
-            return True
+        _notify(name)
+        return True
 
     def get(self, name: str) -> T:
         with _lock:
@@ -164,6 +204,7 @@ def ResetFlagsToDefaults() -> None:
     once and exits)."""
     for reg in _REGISTRIES:
         reg.reset_to_defaults()
+    _notify(None)
 
 
 def _reset_for_tests() -> None:
@@ -172,3 +213,4 @@ def _reset_for_tests() -> None:
         for reg in _REGISTRIES:
             reg.flags.clear()
             reg.help.clear()
+    _notify(None)

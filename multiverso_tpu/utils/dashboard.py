@@ -18,7 +18,9 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
+
+from multiverso_tpu.utils.log import Log
 
 
 def format_monitor_line(name: str, count: int, elapse_ms: float,
@@ -35,19 +37,26 @@ class Monitor:
         self.name = name
         self._count = 0
         self._elapsed = 0.0  # seconds
-        self._begin: Optional[float] = None
+        # per-thread Begin stack: a single shared begin slot is
+        # corrupted by concurrent regions from two threads (B1 B2 E1 E2
+        # loses one region and mis-times the other); thread-locality
+        # also makes nested Begin/End on one thread pair up correctly
+        self._begin_tls = threading.local()
         self._lock = threading.Lock()
         if register:
             Dashboard.AddMonitor(self)
 
     def Begin(self) -> None:
-        self._begin = time.perf_counter()
+        stack = getattr(self._begin_tls, "stack", None)
+        if stack is None:
+            stack = self._begin_tls.stack = []
+        stack.append(time.perf_counter())
 
     def End(self) -> None:
-        if self._begin is None:
+        stack = getattr(self._begin_tls, "stack", None)
+        if not stack:
             return
-        dt = time.perf_counter() - self._begin
-        self._begin = None
+        dt = time.perf_counter() - stack.pop()
         with self._lock:
             self._count += 1
             self._elapsed += dt
@@ -105,8 +114,10 @@ class Dashboard:
         with cls._lock:
             lines = [m.info_string() for m in cls._records.values()]
         out = "\n".join(lines)
-        if out:
-            print(out, flush=True)
+        # stats ride the leveled logger (level/sink respected), not a
+        # bare print; the return-string contract stays for tests
+        for line in lines:
+            Log.Info("%s", line)
         return out
 
     @classmethod
@@ -154,8 +165,8 @@ class Dashboard:
                                      " (all hosts)")
                  for name, rec in cls.AggregateAcrossHosts().items()]
         out = "\n".join(lines)
-        if out:
-            print(out, flush=True)
+        for line in lines:
+            Log.Info("%s", line)
         return out
 
     @classmethod

@@ -25,6 +25,7 @@ TPU mapping (see docs/DESIGN.md):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -32,10 +33,13 @@ import numpy as np
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # Imported for their flag registrations (sync, backup_worker_ratio,
-# updater_type, omp_threads) — they MUST be registered before Start()'s
-# ParseCMDFlags runs, or a first-call "-sync=true" would be silently dropped.
+# updater_type, omp_threads, telemetry/trace/stats_interval_s) — they
+# MUST be registered before Start()'s ParseCMDFlags runs, or a
+# first-call "-sync=true" would be silently dropped.
 import multiverso_tpu.sync.server  # noqa: F401
+import multiverso_tpu.telemetry  # noqa: F401
 import multiverso_tpu.updaters.base  # noqa: F401
+from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
 from multiverso_tpu.parallel.mesh import MeshContext
@@ -106,6 +110,8 @@ class Zoo:
             from multiverso_tpu.sync.server import Server
             self.server_engine = Server.GetServer(self.num_workers)
             self.server_engine.Start()
+        from multiverso_tpu.telemetry.export import start_reporter
+        start_reporter()        # -stats_interval_s periodic reports
         self.started = True
         Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
                   "mode=%s", self.num_servers, self.num_workers,
@@ -116,6 +122,8 @@ class Zoo:
     def Stop(self, finalize_net: bool = True) -> None:
         if not self.started:
             return
+        from multiverso_tpu.telemetry.export import stop_reporter
+        stop_reporter()
         if self.server_engine is not None:
             self.FinishTrain()
             self.server_engine.Stop()
@@ -225,6 +233,7 @@ class Zoo:
         (one host_barrier per rendezvous, issued by every process
         collectively)."""
         CHECK(self._barrier is not None, "Zoo not started")
+        _t0 = time.perf_counter()
         idx = self._barrier.wait()
         if self._multihost:
             if idx == 0:
@@ -237,6 +246,10 @@ class Zoo:
                     self._barrier.abort()
                     raise
             self._barrier.wait()  # hold threads until the cross-host leg ends
+        # telemetry: how long this thread sat in the barrier (straggler
+        # skew shows up as a wide distribution here)
+        tmetrics.histogram("zoo.barrier_wait_s").observe(
+            time.perf_counter() - _t0)
 
     def Aggregate(self, data: np.ndarray) -> np.ndarray:
         """In-place elementwise-sum allreduce across workers

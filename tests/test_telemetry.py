@@ -1,0 +1,400 @@
+"""Telemetry subsystem (multiverso_tpu/telemetry/) — PR 2.
+
+Coverage per the issue checklist:
+
+* histogram bucket math (fixed ladder, percentile interpolation, vector
+  merge algebra) — pure, no world needed;
+* cross-host registry merge in a REAL 2-process gloo world with
+  rank-disjoint instruments (union-of-names over fixed-width vectors),
+  riding a windowed engine run with ``-stats_interval_s=1`` so the
+  periodic reporter and the window-latency / host-vs-device byte
+  instruments are exercised end to end;
+* trace export round-trip: ``-trace=true`` world -> ``MV_DumpTrace`` ->
+  schema-valid Chrome trace JSON holding ONE span tree spanning worker
+  verb -> mailbox -> server window;
+* the telemetry-off fast path registers NO instruments;
+* satellites: Monitor Begin/End thread-safety, the MV_StartProfiler
+  double-start guard, Dashboard.Display through the logger, and the
+  no-bare-print lint over the package.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.telemetry import metrics, trace
+from tests.test_multihost import run_two_process
+
+
+class TestHistogramMath:
+    def test_bucket_index_ladder(self):
+        # exact powers of two sit at their bucket's upper bound
+        assert metrics.bucket_index(0.0) == 0
+        assert metrics.bucket_index(-1.0) == 0
+        assert metrics.bucket_index(2.0 ** -20) == 0
+        assert metrics.bucket_index(2.0 ** -19) == 1
+        assert metrics.bucket_index(1.5 * 2.0 ** -20) == 1
+        assert metrics.bucket_index(1.0) == 20
+        assert metrics.bucket_index(1e30) == metrics.N_BUCKETS - 1
+        lo, hi = metrics.bucket_bounds(metrics.bucket_index(0.003))
+        assert lo < 0.003 <= hi
+
+    def test_percentiles_and_totals(self):
+        h = metrics.Histogram("t")
+        for _ in range(50):
+            h.observe(0.001)
+        for _ in range(45):
+            h.observe(0.1)
+        for _ in range(5):
+            h.observe(10.0)
+        snap = metrics.Histogram._snapshot(h._vector())
+        assert snap["count"] == 100
+        assert snap["sum"] == pytest.approx(0.05 + 4.5 + 50.0)
+        # p50 falls in 0.001's bucket, p90 in 0.1's, p99 in 10.0's —
+        # each estimate bounded by its bucket (one-octave error bars)
+        for q, v in (("p50", 0.001), ("p90", 0.1), ("p99", 10.0)):
+            lo, hi = metrics.bucket_bounds(metrics.bucket_index(v))
+            assert lo <= snap[q] <= hi, (q, snap[q], lo, hi)
+
+    def test_vector_merge_is_elementwise_sum(self):
+        """The cross-host merge contract: adding two ranks' fixed-width
+        vectors must equal observing both streams on one histogram."""
+        a, b, both = (metrics.Histogram("a"), metrics.Histogram("b"),
+                      metrics.Histogram("ab"))
+        for v in (0.002, 0.004, 1.5):
+            a.observe(v)
+            both.observe(v)
+        for v in (0.004, 30.0):
+            b.observe(v)
+            both.observe(v)
+        merged = np.asarray(a._vector()) + np.asarray(b._vector())
+        snap = metrics.Histogram._snapshot(merged)
+        expect = metrics.Histogram._snapshot(both._vector())
+        assert snap == expect
+
+    def test_empty_histogram(self):
+        snap = metrics.Histogram._snapshot(metrics.Histogram("e")._vector())
+        assert snap["count"] == 0 and snap["p50"] == 0.0
+
+
+class TestRegistry:
+    def test_lazy_create_and_type_conflict(self):
+        from multiverso_tpu.utils.log import FatalError
+        metrics._reset_for_tests()
+        c = metrics.counter("t.reg.c")
+        c.inc(3)
+        assert metrics.counter("t.reg.c") is c
+        assert metrics.snapshot()["t.reg.c"]["value"] == 3
+        with pytest.raises(FatalError):
+            metrics.histogram("t.reg.c")
+        metrics._reset_for_tests()
+
+    def test_gauge_set_inc_dec(self):
+        metrics._reset_for_tests()
+        g = metrics.gauge("t.reg.g")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert metrics.snapshot()["t.reg.g"]["value"] == 6
+        metrics._reset_for_tests()
+
+    def test_merged_snapshot_single_process_identity(self):
+        metrics._reset_for_tests()
+        metrics.counter("t.m.c").inc(2)
+        metrics.histogram("t.m.h").observe(0.5)
+        metrics.max_gauge("t.m.mg").set(7)
+        merged = metrics.merged_snapshot()
+        assert merged["t.m.c"]["value"] == 2
+        assert merged["t.m.h"]["count"] == 1
+        assert merged["t.m.mg"]["value"] == 7
+        metrics._reset_for_tests()
+
+
+class TestTelemetryOffFastPath:
+    def test_no_instruments_registered(self):
+        """-telemetry=false: driving real verbs through a world must
+        leave the registry EMPTY (instrument lookups return the shared
+        no-op), so the off fast path costs nothing to snapshot."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+        metrics._reset_for_tests()
+        mv.MV_Init(["-telemetry=false"])
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                    num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            t.AddRows(ids, np.ones((4, 4), np.float32))
+            t.GetRows(ids)
+            assert metrics.snapshot() == {}
+            assert mv.MV_MetricsSnapshot() == {}
+        finally:
+            mv.MV_ShutDown()
+
+    def test_null_instrument_is_inert(self):
+        n = metrics.NULL
+        n.inc()
+        n.dec()
+        n.set(3)
+        n.observe(1.0)
+        assert n.value == 0.0
+
+
+class TestTraceExport:
+    def test_chrome_trace_roundtrip_span_tree(self, tmp_path):
+        """-trace=true world -> MV_DumpTrace -> schema-valid Chrome
+        trace JSON with ONE span tree spanning worker verb -> mailbox
+        (flow events) -> server window."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+        trace._reset_for_tests()
+        mv.MV_Init(["-trace=true"])
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                    num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            t.AddRows(ids, np.ones((4, 4), np.float32))
+            t.GetRows(ids)
+            path = str(tmp_path / "trace.json")
+            assert mv.MV_DumpTrace(path) == path
+        finally:
+            mv.MV_ShutDown()
+        data = json.load(open(path))
+        events = data["traceEvents"]
+        assert isinstance(events, list) and events
+        for ev in events:   # Chrome trace-event schema
+            assert {"name", "ph", "pid", "tid"} <= set(ev), ev
+            assert ev["ph"] in ("X", "s", "f", "M"), ev
+            if ev["ph"] != "M":     # metadata records carry no timestamp
+                assert isinstance(ev["ts"], (int, float)), ev
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+                assert {"trace_id", "span_id",
+                        "parent_id"} <= set(ev["args"])
+        by_name = {}
+        for ev in events:
+            by_name.setdefault(ev["name"], []).append(ev)
+        worker = by_name["worker.add"][0]
+        tid = worker["args"]["trace_id"]
+        # the dispatch span picked the worker's context up off the
+        # message (cross-thread parenting)...
+        dispatch = [e for e in by_name["actor.server.dispatch"]
+                    if e["args"]["trace_id"] == tid
+                    and e["args"]["parent_id"] == worker["args"]["span_id"]]
+        assert dispatch, "dispatch span not parented to the worker verb"
+        assert dispatch[0]["tid"] != worker["tid"], \
+            "worker and engine spans should sit on different threads"
+        # ...and the server window nests under the dispatch
+        window = [e for e in by_name["server.window"]
+                  if e["args"]["trace_id"] == tid]
+        assert window, "server window span missing from the verb's tree"
+        # the mailbox hop has a flow arrow: s on the worker thread,
+        # f on the engine thread, same id
+        starts = {e["id"] for e in events if e["ph"] == "s"}
+        ends = {e["id"] for e in events if e["ph"] == "f"}
+        assert worker["args"]["span_id"] in starts & ends
+
+    def test_trace_off_records_nothing(self):
+        trace._reset_for_tests()
+        with trace.span("t.off"):
+            pass
+        assert len(trace.to_chrome_trace()["traceEvents"]) == 1  # meta only
+
+
+class TestProfilerGuard:
+    def test_double_start_checks_and_stop_without_start_noop(self, tmp_path):
+        import multiverso_tpu as mv
+        from multiverso_tpu.utils.log import FatalError
+        mv.MV_StopProfiler()        # no active trace: logged no-op
+        mv.MV_StartProfiler(str(tmp_path))
+        try:
+            with pytest.raises(FatalError, match="one trace at a time"):
+                mv.MV_StartProfiler(str(tmp_path))
+        finally:
+            mv.MV_StopProfiler()
+        mv.MV_StopProfiler()        # unmatched again: still a no-op
+        # the guard must not wedge the next legitimate trace
+        mv.MV_StartProfiler(str(tmp_path))
+        mv.MV_StopProfiler()
+
+
+class TestMonitorThreadSafety:
+    def test_concurrent_begin_end_regions(self):
+        """Two threads running Begin/End regions concurrently must not
+        corrupt each other (the old single shared _begin slot lost
+        regions and mis-timed the rest)."""
+        from multiverso_tpu.utils.dashboard import Monitor
+        mon = Monitor("t.mt", register=False)
+        N = 200
+
+        def run():
+            for _ in range(N):
+                mon.Begin()
+                mon.End()
+
+        ts = [threading.Thread(target=run) for _ in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert mon.count == 2 * N
+        assert mon.elapse_ms >= 0
+
+    def test_unmatched_end_is_noop_and_nesting_pairs(self):
+        from multiverso_tpu.utils.dashboard import Monitor
+        mon = Monitor("t.nest", register=False)
+        mon.End()                   # no Begin: ignored
+        assert mon.count == 0
+        mon.Begin()
+        time.sleep(0.002)
+        mon.Begin()
+        mon.End()                   # inner
+        mon.End()                   # outer
+        assert mon.count == 2
+        assert mon.elapse_ms >= 2   # outer region kept its early start
+
+
+class TestDashboardThroughLogger:
+    def test_display_respects_log_level(self, capsys):
+        """Display rides Log.Info now: silenced below the Error level,
+        return-string contract intact (the old bare print ignored the
+        configured level)."""
+        from multiverso_tpu.utils.dashboard import Dashboard, Monitor
+        from multiverso_tpu.utils.log import Log, LogLevel
+        Dashboard._reset_for_tests()
+        Monitor("t.disp").Add(0.001)
+        Log.ResetLogLevel(LogLevel.Error)
+        try:
+            out = Dashboard.Display()
+        finally:
+            Log.ResetLogLevel(LogLevel.Info)
+        assert "t.disp" in out
+        captured = capsys.readouterr()
+        assert "t.disp" not in captured.err and "t.disp" not in captured.out
+        out = Dashboard.Display()
+        assert "t.disp" in capsys.readouterr().err
+        Dashboard._reset_for_tests()
+
+
+class TestNoBarePrintLint:
+    #: the logger's own sinks are the one legitimate print site
+    ALLOW = {os.path.join("utils", "log.py")}
+
+    def test_package_routes_output_through_logger(self):
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "multiverso_tpu")
+        pat = re.compile(r"(?<![\w.])print\s*\(")
+        offenders = []
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, pkg)
+                if rel in self.ALLOW:
+                    continue
+                with open(path) as f:
+                    for lineno, line in enumerate(f, 1):
+                        if line.lstrip().startswith("#"):
+                            continue
+                        if pat.search(line):
+                            offenders.append(f"{rel}:{lineno}: "
+                                             f"{line.strip()}")
+        assert not offenders, (
+            "bare print() in the package — route output through "
+            "utils/log.py or the telemetry exporters:\n"
+            + "\n".join(offenders))
+
+
+_TELEMETRY_2PROC_CHILD = r'''
+import json, os, sys, time
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.telemetry import metrics
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-stats_interval_s=1", "-trace=true"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=256, num_cols=8))
+rng = np.random.default_rng(3 + rank)
+# windowed burst: fire-and-forget Adds + a draining Get per round
+for _ in range(6):
+    for _ in range(4):
+        mat.AddFireForget(rng.standard_normal((16, 8)).astype(np.float32),
+                          row_ids=rng.choice(256, 16,
+                                             replace=False).astype(np.int32))
+    mat.GetRows(np.arange(8, dtype=np.int32))
+
+# rank-disjoint instruments: the union-of-names merge must carry BOTH
+# ranks' names to everyone, with absent ranks contributing zeros
+metrics.counter(f"test.only_rank{rank}").inc(rank + 1)
+metrics.counter("test.shared").inc(10)
+metrics.histogram(f"test.hist_rank{rank}").observe(0.5 * (rank + 1))
+metrics.max_gauge("test.maxg").set(5 + rank)   # merge = max, not sum
+
+time.sleep(1.3)            # let the periodic reporter fire at least once
+mv.MV_Barrier()            # engines quiesced -> the snapshot collective
+snap = mv.MV_MetricsSnapshot()
+
+# both ranks see BOTH rank-disjoint counters with the pushing rank's value
+assert snap["test.only_rank0"]["value"] == 1, snap["test.only_rank0"]
+assert snap["test.only_rank1"]["value"] == 2, snap["test.only_rank1"]
+assert snap["test.shared"]["value"] == 20, snap["test.shared"]
+assert snap["test.hist_rank0"]["count"] == 1
+assert snap["test.hist_rank1"]["count"] == 1
+assert snap["test.maxg"]["value"] == 6, snap["test.maxg"]   # max(5, 6)
+
+# the windowed engine's instruments merged across hosts: window-latency
+# histogram with percentiles, and the host-vs-device byte counters
+lat = snap["server.window.latency_s"]
+assert lat["type"] == "histogram" and lat["count"] >= 2, lat
+assert 0 < lat["p50"] <= lat["p99"], lat
+assert snap["server.wire.host_bytes"]["value"] > 0
+assert snap["server.wire.device_bytes"]["value"] >= 0
+assert snap["server.window.exchanges"]["value"] >= 2
+assert snap["table.matrix0.add.bytes"]["value"] > 0
+assert snap["actor.server.queue_wait_s"]["count"] > 0
+
+# per-rank trace dump: one span tree follows a verb worker -> mailbox
+# -> WINDOWED server path (window span + its exchange child)
+path = mv.MV_DumpTrace(os.path.join(os.path.dirname(os.path.abspath(
+    sys.argv[0])), f"trace_{rank}.json"))
+events = json.load(open(path))["traceEvents"]
+xs = [e for e in events if e["ph"] == "X"]
+worker = [e for e in xs if e["name"] == "worker.add"]
+assert worker, "no worker verb spans"
+tids = {e["args"]["trace_id"] for e in worker}
+windows = [e for e in xs if e["name"] == "server.window"
+           and e["args"]["trace_id"] in tids]
+assert windows, "no window span in any worker verb's tree"
+win_ids = {e["args"]["span_id"] for e in windows}
+exchanges = [e for e in xs if e["name"] == "server.window.exchange"
+             and e["args"]["parent_id"] in win_ids]
+assert exchanges, "window span has no exchange child"
+
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} TELEMETRY OK", flush=True)
+'''
+
+
+class TestTwoProcessTelemetry:
+    def test_cross_host_merge_and_reporter(self, tmp_path):
+        """A 2-proc windowed run with -stats_interval_s=1: the periodic
+        reporter emits local snapshot lines through the logger, and
+        MV_MetricsSnapshot returns a cross-host-merged snapshot holding
+        rank-disjoint instruments (union-of-names), window-latency
+        percentiles, and host-vs-device byte counters."""
+        outs = run_two_process(_TELEMETRY_2PROC_CHILD, tmp_path,
+                               expect="TELEMETRY OK")
+        for out in outs:
+            assert "[telemetry]" in out, \
+                "periodic reporter emitted nothing:\n" + out[-800:]
