@@ -19,11 +19,16 @@ from __future__ import annotations
 
 import threading
 import time
+import traceback
 from typing import Callable, Dict, Optional
 
+from multiverso_tpu.failsafe import chaos
+from multiverso_tpu.failsafe.deadline import (DEFAULT_SHUTDOWN_JOIN_S,
+                                              deadline_s)
+from multiverso_tpu.failsafe.errors import ActorDied
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.telemetry import metrics, trace
-from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.utils.mt_queue import MtQueue
 
 
@@ -43,6 +48,11 @@ class Actor:
         self._handlers: Dict[MsgType, Callable[[Message], None]] = {}
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        #: fail-fast poison: set to the original exception when the
+        #: loop thread dies; Receive then raises ActorDied immediately
+        #: instead of enqueueing into a dead thread
+        self._poison: Optional[BaseException] = None
+        self._current_msg: Optional[Message] = None
         # telemetry: mailbox backlog + how long messages sat in it
         # (queue-wait is the actor-side half of a verb's latency; the
         # other half is the handler span). NULL instruments when off.
@@ -58,22 +68,68 @@ class Actor:
         self._thread = threading.Thread(target=self._main, name=f"mv-{self.name}",
                                         daemon=True)
         self._thread.start()
-        self._started.wait()  # reference busy-wait handshake (actor.cpp:24-26),
-        # done with an event instead of spinning (SURVEY.md flags the spin as
-        # a smell not to copy).
+        ok = self._started.wait(60.0)  # reference busy-wait handshake
+        # (actor.cpp:24-26), done with an event instead of spinning
+        # (SURVEY.md flags the spin as a smell not to copy). Bounded:
+        # a thread that never reaches its loop is a broken interpreter,
+        # not something to block startup on forever.
+        CHECK(ok, f"actor {self.name} thread failed to start in 60s")
 
     def Stop(self) -> None:
+        """Drain + join, BOUNDED: a stuck actor (handler wedged in a
+        device op or an abandoned collective) is logged with its name
+        and queue depth instead of hanging MV_ShutDown. The bound is
+        -mv_deadline_s when set, else a generous shutdown default —
+        opting into deadlines deliberately bounds shutdown too, which
+        can abandon a legitimately slow final handler: the daemon
+        thread still runs to completion unless the process exits first,
+        and the Log.Error below is the audit trail either way."""
         self.mailbox.Exit()
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(deadline_s() or DEFAULT_SHUTDOWN_JOIN_S)
+            if self._thread.is_alive():
+                Log.Error(
+                    "actor %s stuck at shutdown (mailbox depth %d) — "
+                    "abandoning its daemon thread", self.name,
+                    self.mailbox.Size())
             self._thread = None
 
     def Receive(self, msg: Message) -> None:
-        """Push into the mailbox (reference actor.h:45-47)."""
+        """Push into the mailbox (reference actor.h:45-47). Raises
+        ``ActorDied`` (original traceback chained) when the loop thread
+        is dead — fail fast, never enqueue into a dead thread. Chaos
+        (when armed) may drop/duplicate/delay table verbs here."""
+        if self._poison is not None:
+            raise ActorDied(self.name, self._poison) from self._poison
+        cz = chaos.get()
+        if (cz is not None
+                and msg.msg_type in (MsgType.Request_Get,
+                                     MsgType.Request_Add)
+                and not getattr(msg, "_fs_chaos_done", False)):
+            # one decision per first delivery: redeliveries and dups
+            # must not roll the dice again (schedules stay lockstep
+            # across SPMD ranks running the same verb program)
+            msg._fs_chaos_done = True
+            action = cz.mailbox_action()
+            if action == "dup":
+                self._push(msg)       # same object twice: the engine's
+                self._push(msg)       # dedup window skips the copy
+                return
+            if action in ("drop", "delay"):
+                chaos.schedule_redelivery(self._push, msg, action,
+                                          cz.param(f"mailbox.{action}"))
+                return
+        self._push(msg)
+
+    def _push(self, msg: Message) -> None:
         msg._enq_t = time.perf_counter()
         self.mailbox.Push(msg)
         self._m_received.inc()
         self._m_depth.set(self.mailbox.Size())
+        if self._poison is not None:
+            # lost race with a dying loop thread: its drain may have
+            # missed this message — fail whatever is still queued
+            self._fail_pending(self._poison)
 
     def note_dequeue(self, msg: Message) -> None:
         """Telemetry at the moment a message leaves the mailbox: observe
@@ -114,11 +170,44 @@ class Actor:
                 # route through the normal reply path so the error reaches
                 # the caller's Wait() and re-raises there
                 msg.reply(exc)
+                if getattr(exc, "mv_fatal", False):
+                    # e.g. a DeadlineExceeded that abandoned a
+                    # collective: this actor's stream is unsound —
+                    # poison instead of processing more messages
+                    raise
+
+    def _fail_pending(self, original: BaseException) -> None:
+        """Fail every queued (and the in-dispatch) message with the
+        poison error so their waiters raise instead of hanging."""
+        died = ActorDied(self.name, original)
+        died.__cause__ = original
+        cur = self._current_msg
+        if cur is not None:
+            cur.reply(died)     # no-op if it already replied
+        while True:
+            ok, m = self.mailbox.TryPop()
+            if not ok:
+                return
+            m.reply(died)
 
     def _main(self) -> None:
         self._started.set()
-        while True:
-            ok, msg = self.mailbox.Pop()
-            if not ok:
-                break
-            self._dispatch(msg)
+        try:
+            while True:
+                ok, msg = self.mailbox.Pop()
+                if not ok:
+                    break
+                self._current_msg = msg
+                self._dispatch(msg)
+                self._current_msg = None
+        except BaseException as exc:
+            # fail-fast actor death: record the poison FIRST (Receive
+            # checks it before pushing), then fail everything queued —
+            # subsequent Receive/Wait re-raise the original traceback
+            # immediately instead of feeding a dead thread
+            self._poison = exc
+            metrics.counter(f"actor.{self.name}.deaths").inc()
+            Log.Error("actor %s: loop thread died, poisoning mailbox:\n%s",
+                      self.name, traceback.format_exc())
+            self.mailbox.Exit()
+            self._fail_pending(exc)

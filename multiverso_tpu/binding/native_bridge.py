@@ -233,11 +233,14 @@ class NativeBridge:
         # remote storage) stays on THIS thread: only the in-memory
         # serialize/deserialize occupies the engine.
         def submit(fn):
+            from multiverso_tpu.failsafe import deadline as fdeadline
             waiter = Waiter(1)
             msg = Message(msg_type=MsgType.Request_StoreLoad,
                           payload={"fn": fn}, waiter=waiter)
             Zoo.Get().SendToServer(msg)
-            waiter.Wait()
+            if not waiter.Wait(fdeadline.timeout_or_none()):
+                fdeadline.raise_deadline(
+                    f"native store/load of table {table}")
             if isinstance(msg.result, Exception):
                 raise msg.result
 

@@ -1,0 +1,38 @@
+"""Failsafe subsystem: bounded waits, seeded chaos, integrity, fail-fast.
+
+The reference Multiverso's failure model is "hang or die" (SURVEY.md
+§1): a lost message or a rank diverging from a collective strands every
+peer in ``Waiter::Wait`` / the controller barrier forever. This package
+generalizes PR 1's ad-hoc guard (head-kind marker blobs) into a
+subsystem threaded through the whole stack:
+
+* :mod:`deadline` — ``-mv_deadline_s`` bounds every blocking wait
+  (table ``Wait``, worker/cross-host barrier, window exchange,
+  shutdown drain); expiry raises :class:`DeadlineExceeded` carrying a
+  :mod:`diagnostics` bundle (all-thread stacks, mailbox depths,
+  in-flight msg ids, clock state, telemetry snapshot).
+* :mod:`chaos` — ``-chaos_spec``/``-chaos_seed`` seeded fault injector
+  (mailbox drop/dup/delay, wire bitflip/truncate, verb transient/
+  failack), deterministic given the seed.
+* :mod:`dedup` — server-side ``(src, msg_id)`` at-most-once window so
+  worker retries (exponential backoff + jitter on
+  :class:`TransientError`) never double-apply an Add; the wire layer's
+  CRC32 trailer (parallel/wire.py) turns corruption into
+  :class:`WireCorruption` instead of decoded garbage.
+* fail-fast actor death — an actor whose loop thread dies poisons its
+  mailbox (:class:`ActorDied`), failing queued and future requests with
+  the original traceback instead of enqueueing into a dead thread.
+
+Importing this package registers all failsafe flags (zoo imports it
+before ``ParseCMDFlags`` runs).
+"""
+
+from multiverso_tpu.failsafe import chaos, deadline, diagnostics  # noqa: F401
+from multiverso_tpu.failsafe.dedup import DedupWindow  # noqa: F401
+from multiverso_tpu.failsafe.errors import (  # noqa: F401
+    ActorDied,
+    DeadlineExceeded,
+    FailsafeError,
+    TransientError,
+    WireCorruption,
+)

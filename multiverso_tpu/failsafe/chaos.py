@@ -1,0 +1,232 @@
+"""Seeded fault injection (``-chaos_spec`` / ``-chaos_seed``).
+
+Every distributed failure mode this repo guards against — lost/dup/
+late deliveries, corrupted frames, transient verb faults — can be
+rehearsed on demand, DETERMINISTICALLY: each fault site owns an
+independent ``random.Random`` stream seeded from ``(chaos_seed,
+site-name CRC)`` (never Python's salted ``hash``), and a decision is a
+pure function of (site, call index). Same spec + seed ⇒ same fault
+schedule, so every chaos test is reproducible, and two SPMD ranks
+running the same verb program with the same seed inject faults at the
+SAME lockstep positions — which is what lets a 2-proc chaos soak
+converge instead of tripping the windowed engine's divergence CHECKs.
+
+Spec grammar (comma-separated)::
+
+    site:probability[@param]
+
+    mailbox.drop:P[@delay_s]   first delivery lost; the transport's
+                               retransmit redelivers after 2*delay_s
+                               (an in-process mailbox cannot lose bytes
+                               without breaking the waiter contract —
+                               what we model is the recovery layer)
+    mailbox.dup:P              message enqueued twice (same object; the
+                               server dedup window must skip the copy)
+    mailbox.delay:P[@delay_s]  delivery deferred by delay_s
+    wire.bitflip:P             one payload byte of an outgoing window
+                               blob flipped (CRC trailer must catch it)
+    wire.truncate:P            outgoing blob truncated by a few bytes
+    verb.transient:P           engine rejects the verb with
+                               TransientError BEFORE applying
+    verb.failack:P             engine APPLIES the Add, then fails the
+                               ack with TransientError — the retry must
+                               hit the dedup window, not re-apply
+
+Faults target table verbs only (Get/Add): control messages (barrier
+pings, StoreLoad, FinishTrain) stay reliable, matching real transports
+where control planes ride retried RPCs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Dict, Optional, Tuple
+
+from multiverso_tpu.telemetry import metrics
+from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_int,
+                                            MV_DEFINE_string,
+                                            register_flag_listener)
+from multiverso_tpu.utils.log import CHECK, Log
+
+MV_DEFINE_string("chaos_spec", "",
+                 "seeded fault-injection spec, e.g. 'mailbox.drop:0.05,"
+                 "wire.bitflip:0.01,verb.transient:0.1' (empty = off)")
+MV_DEFINE_int("chaos_seed", 0, "fault-schedule seed (chaos_spec)")
+
+_SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
+          "wire.bitflip", "wire.truncate",
+          "verb.transient", "verb.failack")
+_DEFAULT_DELAY_S = 0.002
+
+
+def parse_spec(spec: str) -> Dict[str, Tuple[float, float]]:
+    """``site:prob[@param]`` list -> {site: (prob, param)}."""
+    out: Dict[str, Tuple[float, float]] = {}
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, rest = entry.partition(":")
+        prob_s, _, param_s = rest.partition("@")
+        CHECK(site in _SITES,
+              f"-chaos_spec: unknown site {site!r} (know {_SITES})")
+        try:
+            prob = float(prob_s)
+            param = float(param_s) if param_s else _DEFAULT_DELAY_S
+        except ValueError:
+            CHECK(False, f"-chaos_spec: bad entry {entry!r}")
+        CHECK(0.0 <= prob <= 1.0,
+              f"-chaos_spec: probability out of [0,1] in {entry!r}")
+        out[site] = (prob, param)
+    return out
+
+
+class ChaosInjector:
+    """One seeded injector instance (rebuilt when the flags change)."""
+
+    def __init__(self, spec: Dict[str, Tuple[float, float]], seed: int):
+        self.spec = dict(spec)
+        self.seed = int(seed)
+        # per-site independent streams, seeded WITHOUT str hash (which
+        # PYTHONHASHSEED salts per process — determinism would die)
+        self._rngs = {site: random.Random(
+            (self.seed << 32) ^ zlib.crc32(site.encode()))
+            for site in _SITES}
+        # eager registration: an armed injector's sites show at zero in
+        # MV_MetricsSnapshot() even before their first fault
+        for site in self.spec:
+            metrics.counter(f"chaos.{site}")
+
+    def _fire(self, site: str) -> bool:
+        prob = self.spec.get(site, (0.0, 0.0))[0]
+        # ALWAYS draw, even at prob 0: a site's schedule must depend
+        # only on (seed, call index), not on which other sites are in
+        # the spec — so enabling a new site never reshuffles the others
+        hit = self._rngs[site].random() < prob
+        if hit:
+            metrics.counter(f"chaos.{site}").inc()
+        return hit
+
+    def param(self, site: str) -> float:
+        return self.spec.get(site, (0.0, _DEFAULT_DELAY_S))[1]
+
+    # -- decision points (one call per site per event: deterministic) --
+
+    def mailbox_action(self) -> Optional[str]:
+        """Consulted once per verb Receive: drop / dup / delay / None."""
+        action = None
+        for site in ("mailbox.drop", "mailbox.dup", "mailbox.delay"):
+            if self._fire(site) and action is None:
+                action = site.split(".", 1)[1]
+        return action
+
+    def verb_action(self, tracked: bool) -> Optional[str]:
+        """Consulted once per verb admission at the engine: transient /
+        failack / None. Only TRACKED verbs are faulted (a fire-and-
+        forget Add has no waiter to drive a retry — rejecting it would
+        silently lose the update, which chaos must never do)."""
+        action = None
+        for site in ("verb.transient", "verb.failack"):
+            if self._fire(site) and action is None and tracked:
+                action = site.split(".", 1)[1]
+        return action
+
+    def corrupt_blob(self, blob: bytes) -> Optional[bytes]:
+        """Consulted once per outgoing window exchange blob: a
+        corrupted copy (bitflip / truncate), or None. The flip never
+        lands on byte 0 (the blob-kind tag has its own loud error) —
+        everything else is the CRC trailer's job to catch."""
+        flip = self._fire("wire.bitflip")
+        trunc = self._fire("wire.truncate")
+        if flip and len(blob) > 1:
+            rng = self._rngs["wire.bitflip"]
+            pos = 1 + rng.randrange(len(blob) - 1)
+            bit = 1 << rng.randrange(8)
+            out = bytearray(blob)
+            out[pos] ^= bit
+            return bytes(out)
+        if trunc and len(blob) > 2:
+            rng = self._rngs["wire.truncate"]
+            return blob[:-(1 + rng.randrange(min(8, len(blob) - 1)))]
+        return None
+
+
+# -- module state: injector cache + redelivery timers ------------------
+
+_lock = threading.Lock()
+_cache: dict = {"spec": None, "seed": None, "inj": None}
+_timers: list = []
+
+
+def _invalidate(name) -> None:
+    if name in (None, "chaos_spec", "chaos_seed"):
+        with _lock:
+            _cache["spec"] = None
+            _cache["inj"] = None
+
+
+register_flag_listener(_invalidate)
+
+
+def get() -> Optional[ChaosInjector]:
+    """The active injector, or None when ``-chaos_spec`` is empty.
+
+    Called on every verb Receive/admission, so the steady-state path is
+    ONE lockless dict read (atomic under the GIL; a reader racing an
+    invalidation may use the outgoing injector for one message — flag
+    changes are eventually consistent by design). The lock only guards
+    the rebuild."""
+    if _cache["spec"] is not None:
+        return _cache["inj"]
+    with _lock:
+        if _cache["spec"] is not None:
+            return _cache["inj"]
+        try:
+            spec_s = str(GetFlag("chaos_spec"))
+            seed = int(GetFlag("chaos_seed"))
+        except Exception:       # registry torn down
+            return None
+        spec = parse_spec(spec_s)
+        _cache["spec"] = spec_s
+        _cache["seed"] = seed
+        _cache["inj"] = ChaosInjector(spec, seed) if spec else None
+        if spec:
+            Log.Info("chaos: injector armed (seed=%d, spec=%s)", seed,
+                     spec_s)
+        return _cache["inj"]
+
+
+def schedule_redelivery(deliver, msg, action: str, delay_s: float) -> None:
+    """Redeliver ``msg`` via ``deliver(msg)`` after ``delay_s`` (drop
+    waits 2x — the retransmit took a full extra round trip). Timers are
+    tracked so :func:`quiesce` can rendezvous with them."""
+    wait = delay_s * (2.0 if action == "drop" else 1.0)
+
+    def _redeliver():
+        try:
+            deliver(msg)
+        except Exception as exc:  # e.g. actor died meanwhile
+            Log.Error("chaos: redelivery failed: %r", exc)
+
+    t = threading.Timer(wait, _redeliver)
+    t.daemon = True
+    with _lock:
+        _timers.append(t)
+    t.start()
+
+
+def quiesce() -> None:
+    """Block until every scheduled redelivery has fired — call before
+    asserting convergence (or disabling chaos) so no delayed message is
+    still in flight."""
+    while True:
+        with _lock:
+            pending = [t for t in _timers if t.is_alive()]
+            _timers[:] = pending
+        if not pending:
+            return
+        for t in pending:
+            # unbounded-ok: a Timer is bounded by its own (tiny) delay
+            t.join()

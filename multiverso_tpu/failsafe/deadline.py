@@ -1,0 +1,137 @@
+"""Deadlines on blocking paths: ``-mv_deadline_s`` + helpers.
+
+The flag is 0 (off) by default so existing blocking semantics stay
+byte-identical; set it and every blocking wait in the runtime —
+``WorkerTable.Wait``, the worker/cross-host barrier, the windowed
+engine's exchange entry, the engine drain in ``MV_ShutDown`` — raises a
+typed :class:`DeadlineExceeded` carrying a diagnostic bundle instead of
+hanging forever on a lost peer.
+
+Two shapes of bounded wait:
+
+* condition-variable waits (``Waiter``, ``threading.Barrier``) take the
+  timeout natively — :func:`timeout_or_none` feeds it through and
+  :func:`raise_deadline` converts expiry into the typed error;
+* **collectives cannot be interrupted** (a gloo/XLA allgather blocked
+  on a dead peer holds its thread forever). :func:`bounded` runs the
+  call on a daemon thread and joins with the deadline: on expiry the
+  caller gets ``DeadlineExceeded`` (marked ``mv_fatal`` — the abandoned
+  thread may complete the collective later, so the surrounding
+  component's collective stream is unsound and the actor runtime
+  poisons it rather than issuing more collectives).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from multiverso_tpu.failsafe.errors import DeadlineExceeded
+from multiverso_tpu.utils.configure import MV_DEFINE_double, \
+    MV_DEFINE_int, cached_float_flag
+
+MV_DEFINE_double("mv_deadline_s", 0.0,
+                 "bound every blocking wait (table Wait, barriers, "
+                 "window exchange, shutdown drain) and raise "
+                 "DeadlineExceeded with a diagnostic bundle on expiry "
+                 "(0 = off, preserving blocking semantics)")
+MV_DEFINE_int("mv_max_retries", 3,
+              "worker verb retries on TransientError (exponential "
+              "backoff with jitter; the server dedup window makes "
+              "retried Adds at-most-once)")
+
+#: bounded shutdown join when no deadline is configured: MV_ShutDown
+#: must log a stuck actor (name + queue depth), never hang on it
+DEFAULT_SHUTDOWN_JOIN_S = 30.0
+
+#: listener-refreshed cache: deadline_s runs once per tracked Wait /
+#: window exchange — a GetFlag registry walk per call is too costly
+#: on that path (same rationale as the telemetry gates)
+_deadline_flag = cached_float_flag("mv_deadline_s", 0.0)
+
+
+def deadline_s() -> float:
+    """The configured deadline in seconds; 0.0 = deadlines off."""
+    return max(0.0, _deadline_flag())
+
+
+def timeout_or_none() -> Optional[float]:
+    """Deadline as a ``Condition.wait_for``-style timeout argument:
+    ``None`` (block forever — the byte-identical legacy path) when the
+    flag is unset."""
+    dl = deadline_s()
+    return dl if dl > 0 else None
+
+
+def raise_deadline(what: str, seconds: Optional[float] = None,
+                   fatal: bool = False) -> None:
+    """Build the diagnostic bundle and raise ``DeadlineExceeded``."""
+    from multiverso_tpu.failsafe import diagnostics
+    from multiverso_tpu.telemetry import metrics
+    metrics.counter("failsafe.deadline_exceeded").inc()
+    secs = deadline_s() if seconds is None else seconds
+    raise DeadlineExceeded(what, secs, diagnostics.bundle(what),
+                           fatal=fatal)
+
+
+class _Runner:
+    """One reusable single-slot worker thread for :func:`bounded` —
+    steady-state bounded calls (e.g. two window exchanges per engine
+    window) reuse it instead of paying a thread create/start/join per
+    call. A worker abandoned by an expiry (stuck inside an
+    uninterruptible collective) stays ``busy`` and the next call simply
+    spawns a replacement."""
+
+    def __init__(self):
+        from multiverso_tpu.utils.mt_queue import MtQueue
+        self.busy = False
+        self._calls: "MtQueue" = MtQueue()
+        threading.Thread(target=self._loop, name="mv-bounded-runner",
+                         daemon=True).start()
+
+    def submit(self, fn, box: dict, done: threading.Event) -> None:
+        self.busy = True
+        self._calls.Push((fn, box, done))
+
+    def _loop(self) -> None:
+        while True:
+            ok, item = self._calls.Pop()
+            if not ok:      # pragma: no cover - queue never exits
+                return
+            fn, box, done = item
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # delivered to the caller
+                box["error"] = exc
+            self.busy = False
+            done.set()
+
+
+_runner_tl = threading.local()
+
+
+def bounded(fn, what: str, fatal: bool = True):
+    """Run ``fn()`` under the configured deadline.
+
+    Deadline off: calls ``fn`` directly (no thread, no overhead —
+    semantics byte-identical to pre-failsafe code). Deadline on: hands
+    ``fn`` to this thread's reusable worker and waits with the
+    deadline; expiry raises ``DeadlineExceeded`` and abandons the
+    worker (the only honest option for an uninterruptible collective —
+    the process is expected to report and exit, which the daemon flag
+    permits)."""
+    dl = deadline_s()
+    if dl <= 0:
+        return fn()
+    runner = getattr(_runner_tl, "runner", None)
+    if runner is None or runner.busy:
+        runner = _Runner()
+        _runner_tl.runner = runner
+    box: dict = {}
+    done = threading.Event()
+    runner.submit(fn, box, done)
+    if not done.wait(dl):
+        raise_deadline(what, dl, fatal=fatal)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")

@@ -1,0 +1,108 @@
+"""Diagnostic bundle: what every failure report carries.
+
+A ``DeadlineExceeded`` that says only "timed out" forces the operator
+to reproduce the hang under a debugger. The bundle captures, at the
+moment of expiry, everything a post-mortem needs: every thread's stack
+(``sys._current_frames`` — the ``faulthandler`` view, but as a string
+we can embed in an exception), actor mailbox depth + poison state, the
+worker tables' in-flight msg ids, the engine's window/vector-clock
+position, and the local telemetry snapshot. Every section is
+best-effort (``try``/``except``): diagnostics must never turn one
+failure into two.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+
+#: cap per-section text so a bundle embedded in an exception message
+#: stays readable (and loggable) even in a 100-thread process
+_MAX_SECTION = 16000
+
+
+def _clip(text: str) -> str:
+    if len(text) <= _MAX_SECTION:
+        return text
+    return text[:_MAX_SECTION] + "\n... [clipped]"
+
+
+def _thread_stacks() -> str:
+    names = {t.ident: f"{t.name}{' (daemon)' if t.daemon else ''}"
+             for t in threading.enumerate()}
+    lines = []
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"thread {names.get(ident, ident)}:")
+        lines.extend("  " + ln.rstrip()
+                     for ln in traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+def _engine_state() -> str:
+    from multiverso_tpu.zoo import Zoo
+    zoo = Zoo.Get()
+    if not zoo.started:
+        return "zoo not started"
+    lines = []
+    srv = zoo.server_engine
+    if srv is None:
+        lines.append("no server engine (-ma mode)")
+    else:
+        poison = getattr(srv, "_poison", None)
+        lines.append(
+            f"actor {srv.name!r}: mailbox depth {srv.mailbox.Size()}, "
+            f"poisoned={poison!r}, window_exchanges="
+            f"{getattr(srv, 'mh_window_exchanges', 0)}, "
+            f"window_verbs={getattr(srv, 'mh_window_verbs', 0)}, "
+            f"barrier_splits={getattr(srv, 'window_barrier_splits', 0)}")
+        for attr, label in (("_get_clocks", "get clocks"),
+                            ("_add_clocks", "add clocks")):
+            clock = getattr(srv, attr, None)
+            if clock is not None:
+                lines.append(f"bsp {label}: {clock.DebugString()}")
+    return "\n".join(lines)
+
+
+def _inflight() -> str:
+    from multiverso_tpu.zoo import Zoo
+    zoo = Zoo.Get()
+    lines = []
+    for i, table in enumerate(zoo.worker_tables):
+        waiters = getattr(table, "_waiters", None)
+        if not waiters:
+            continue
+        with table._lock:
+            ids = sorted(waiters)
+        lines.append(f"table {i} ({type(table).__name__}): waiting on "
+                     f"msg_ids {ids[:32]}"
+                     + (" ..." if len(ids) > 32 else ""))
+    return "\n".join(lines) or "no tracked requests in flight"
+
+
+def _telemetry() -> str:
+    import json
+
+    from multiverso_tpu.telemetry import metrics
+    from multiverso_tpu.telemetry.export import _compact
+    snap = metrics.snapshot()
+    if not snap:
+        return "telemetry off / empty"
+    return json.dumps(_compact(snap), sort_keys=True)
+
+
+def bundle(what: str) -> str:
+    """Render the full diagnostic bundle for a failure named ``what``.
+    LOCAL only — never issues collectives (a diagnostic path that needs
+    a healthy world to describe an unhealthy one is useless)."""
+    sections = [("threads", _thread_stacks), ("engine", _engine_state),
+                ("in-flight requests", _inflight),
+                ("telemetry", _telemetry)]
+    lines = [f"== failsafe diagnostic bundle: {what} =="]
+    for title, fn in sections:
+        lines.append(f"-- {title} --")
+        try:
+            lines.append(_clip(fn()))
+        except Exception as exc:   # never turn one failure into two
+            lines.append(f"<{title} unavailable: {exc!r}>")
+    return "\n".join(lines)

@@ -1,0 +1,66 @@
+"""Typed failure taxonomy for the failsafe subsystem.
+
+The reference Multiverso's failure model is "hang or die": a lost
+message or a diverged rank leaves every peer blocked forever in
+``Waiter::Wait`` or the controller barrier (SURVEY.md §1). These types
+give every bounded wait, corrupted frame, retryable fault, and dead
+actor a NAME the caller can catch, so recovery code can distinguish
+"slow" from "gone" from "corrupt" instead of pattern-matching log text.
+"""
+
+from __future__ import annotations
+
+
+class FailsafeError(RuntimeError):
+    """Base of the failsafe taxonomy."""
+
+
+class DeadlineExceeded(FailsafeError):
+    """A blocking wait outlived ``-mv_deadline_s``.
+
+    ``what`` names the wait (e.g. "cross-host barrier"), ``seconds`` is
+    the bound that expired, ``bundle`` is the diagnostic bundle text
+    (all-thread stacks, mailbox depths, in-flight ids, clock state,
+    telemetry snapshot) captured at expiry. ``mv_fatal`` marks
+    deadlines after which the raising component's state is unsound
+    (e.g. an abandoned collective exchange): the actor runtime poisons
+    itself on those instead of processing further messages."""
+
+    def __init__(self, what: str, seconds: float, bundle: str = "",
+                 fatal: bool = False):
+        self.what = what
+        self.seconds = float(seconds)
+        self.bundle = bundle
+        self.mv_fatal = bool(fatal)
+        msg = f"deadline of {seconds:g}s exceeded waiting for {what}"
+        if bundle:
+            msg = f"{msg}\n{bundle}"
+        super().__init__(msg)
+
+
+class WireCorruption(ValueError):
+    """A wire frame failed its CRC32 trailer check (or arrived
+    truncated): the bytes are NOT decoded — corruption raises instead
+    of silently materializing garbage arrays. Subclasses ValueError so
+    callers treating malformed blobs generically keep working."""
+
+
+class TransientError(FailsafeError):
+    """A retryable fault: the request was not (or may not have been)
+    served, and resubmitting the SAME request is safe — the server's
+    ``(src, msg_id)`` dedup window guarantees an Add that did apply is
+    never applied twice. The worker verb layer retries these with
+    exponential backoff + jitter up to ``-mv_max_retries``."""
+
+
+class ActorDied(FailsafeError):
+    """An actor's loop thread died; its mailbox is poisoned. Raised
+    immediately by ``Receive``/pending ``Wait``s instead of enqueueing
+    into (or blocking on) a dead thread. ``__cause__`` carries the
+    original exception with its traceback."""
+
+    def __init__(self, actor_name: str, original: BaseException):
+        self.actor_name = actor_name
+        self.original = original
+        super().__init__(
+            f"actor {actor_name!r} loop thread died: {original!r}")

@@ -303,6 +303,8 @@ class WindowReader:
         return window
 
     def join(self) -> None:
+        # unbounded-ok: producer loop is bounded by the dataset (it always
+        # terminates after the last block or a recorded error)
         self._thread.join()
 
 
@@ -368,6 +370,7 @@ class _TeeReader:
         return w
 
     def join(self) -> None:
+        # unbounded-ok: delegates to the inner reader's bounded producer
         self._inner.join()
 
 

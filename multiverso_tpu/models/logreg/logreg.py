@@ -160,7 +160,7 @@ class LogReg:
                          "%.5f, %.2fs", epoch, samples, avg_loss,
                          timer.elapse())
         for t in log_threads:
-            t.join()
+            t.join()  # unbounded-ok: epoch workers finished their sample loop
         if cfg.use_ps:
             import multiverso_tpu as mv
             mv.MV_Barrier()

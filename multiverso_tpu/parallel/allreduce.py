@@ -69,10 +69,21 @@ class RendezvousAllreduce:
         self._generation = 0
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        #: set when a participant's deadline expired mid-round: the
+        #: round can never complete correctly (its contribution is in
+        #: _accum but its caller has moved on), so the rendezvous
+        #: BREAKS for everyone — threading.Barrier.abort semantics,
+        #: fail-fast over silently skewed sums
+        self._broken = False
 
     def allreduce(self, arr: np.ndarray) -> np.ndarray:
         arr = np.asarray(arr)
+        from multiverso_tpu.failsafe import deadline as fdeadline
         with self._lock:
+            if self._broken:
+                fdeadline.raise_deadline(
+                    "allreduce rendezvous (broken by an earlier "
+                    "participant deadline)")
             gen = self._generation
             if self._accum is None:
                 self._accum = arr.astype(np.float64, copy=True)
@@ -97,7 +108,22 @@ class RendezvousAllreduce:
                 self._generation += 1
                 self._lock.notify_all()
             else:
-                self._lock.wait_for(lambda: self._generation > gen)
+                if not self._lock.wait_for(
+                        lambda: self._generation > gen or self._broken,
+                        fdeadline.timeout_or_none()):
+                    # a participant never arrived: bounded by
+                    # -mv_deadline_s (None = block as before). Our
+                    # contribution is already in _accum and cannot be
+                    # handed back, so the whole rendezvous breaks —
+                    # a retry re-adding it would double-count
+                    self._broken = True
+                    self._lock.notify_all()
+                    fdeadline.raise_deadline(
+                        "allreduce rendezvous (missing participants)")
+                if self._broken and self._generation <= gen:
+                    fdeadline.raise_deadline(
+                        "allreduce rendezvous (broken by a peer "
+                        "participant deadline)")
             if self._error is not None:
                 raise RuntimeError(
                     "cross-host allreduce failed") from self._error

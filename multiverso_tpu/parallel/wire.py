@@ -27,9 +27,17 @@ and decodes correctly anywhere):
   ``KIND_HEAD_BARRIER`` marks a non-verb head marker blob
   (sync/server.py exchanges those so a cross-rank verb-vs-barrier head
   mismatch fails the loud SPMD CHECK instead of deadlocking).
+* u32 exchange sequence number (failsafe): each rank stamps its
+  position in the window-exchange stream; the engine CHECKs that every
+  received frame carries ITS sequence, so a rank that re-entered the
+  exchange alone (asymmetric corruption retry) pairs with its peers'
+  NEXT round as a loud desync error, never a silent mismatched merge.
 * u32 verb count, then per verb: u8 kind char, u32 table id, u8 entry
   count, then per entry: u8 key length + key utf8, u8 value tag + the
   tag's body.
+* trailing u32 — CRC32 over everything before it (failsafe subsystem):
+  decode verifies it BEFORE parsing, so a flipped bit or truncated
+  frame raises ``WireCorruption`` instead of decoding garbage.
 
 Value tags::
 
@@ -51,16 +59,23 @@ from __future__ import annotations
 
 import pickle
 import struct
+import zlib
 from typing import List, Tuple
 
 import numpy as np
 
+from multiverso_tpu.failsafe.errors import WireCorruption
 from multiverso_tpu.updaters.base import AddOption, GetOption
 
 #: first byte of every exchanged blob — lets the far side tell a verb
 #: window from a non-verb head marker (and catch format drift loudly)
 KIND_WINDOW = 0x57      # 'W'
 KIND_HEAD_BARRIER = 0x42  # 'B'
+
+#: every blob carries a little-endian CRC32 trailer over all preceding
+#: bytes: a flipped bit or truncated frame raises WireCorruption at
+#: decode instead of materializing garbage arrays (failsafe subsystem)
+CRC_TRAILER_BYTES = 4
 
 _U8 = struct.Struct("<B")
 _U32 = struct.Struct("<I")
@@ -217,10 +232,34 @@ def _encode_value(parts: list, v) -> None:
         parts.append(pb)
 
 
-def encode_window(verbs: List[Tuple[str, int, dict]]) -> bytes:
+def _seal(body: bytes) -> bytes:
+    """Append the CRC32 trailer (little-endian u32 over ``body``)."""
+    return body + _U32.pack(zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def check_crc(blob: bytes) -> None:
+    """Verify a sealed blob's CRC32 trailer; raises ``WireCorruption``
+    (counting ``wire.crc_failures``) on mismatch or truncation. Runs
+    BEFORE any parsing so corrupt bytes never reach the decoders."""
+    ok = len(blob) > CRC_TRAILER_BYTES and (
+        zlib.crc32(blob[:-CRC_TRAILER_BYTES]) & 0xFFFFFFFF
+        == _U32.unpack_from(blob, len(blob) - CRC_TRAILER_BYTES)[0])
+    if not ok:
+        from multiverso_tpu.telemetry import metrics as _tmetrics
+        _tmetrics.counter("wire.crc_failures").inc()
+        raise WireCorruption(
+            f"wire blob failed CRC32 check ({len(blob)} bytes) — "
+            f"corrupted or truncated frame")
+
+
+def encode_window(verbs: List[Tuple[str, int, dict]],
+                  seq: int = 0) -> bytes:
     """``[(kind, table_id, payload), ...]`` -> wire bytes. ``kind`` is a
-    single ascii char ('A'/'G'); payload is the verb's payload dict."""
-    parts: list = [_U8.pack(KIND_WINDOW), _U32.pack(len(verbs))]
+    single ascii char ('A'/'G'); payload is the verb's payload dict;
+    ``seq`` stamps the sender's window-exchange position (see module
+    docstring — the engine's lockstep-desync tripwire)."""
+    parts: list = [_U8.pack(KIND_WINDOW), _U32.pack(seq & 0xFFFFFFFF),
+                   _U32.pack(len(verbs))]
     for kind, table_id, payload in verbs:
         if len(payload) > 255:
             raise ValueError("wire payload too wide")
@@ -232,7 +271,7 @@ def encode_window(verbs: List[Tuple[str, int, dict]]) -> bytes:
             parts.append(_U8.pack(len(kb)))
             parts.append(kb)
             _encode_value(parts, payload[key])
-    blob = b"".join(parts)
+    blob = _seal(b"".join(parts))
     # telemetry byte accounting (per window — not per element, so the
     # registry lookup is off the hot loop); NULL instrument when off
     from multiverso_tpu.telemetry import metrics as _tmetrics
@@ -309,15 +348,19 @@ def _decode_value(cur: _Cursor):
     raise ValueError(f"unknown wire tag {tag!r}")
 
 
-def decode_window(blob: bytes) -> List[Tuple[str, int, dict]]:
-    """Wire bytes -> ``[(kind, table_id, payload), ...]``. Array entries
-    are zero-copy READ-ONLY views into ``blob``."""
+def decode_window_seq(blob: bytes):
+    """Wire bytes -> ``(seq, [(kind, table_id, payload), ...])``. Array
+    entries are zero-copy READ-ONLY views into ``blob``. The CRC32
+    trailer is verified FIRST: corruption raises ``WireCorruption``
+    before any byte is parsed."""
+    check_crc(blob)
     from multiverso_tpu.telemetry import metrics as _tmetrics
     _tmetrics.counter("wire.decode_bytes").inc(len(blob))
     cur = _Cursor(blob)
     (magic,) = cur.unpack(_U8)
     if magic != KIND_WINDOW:
         raise ValueError(f"not a window blob (leading byte {magic:#x})")
+    (seq,) = cur.unpack(_U32)
     (count,) = cur.unpack(_U32)
     out = []
     for _ in range(count):
@@ -328,7 +371,12 @@ def decode_window(blob: bytes) -> List[Tuple[str, int, dict]]:
             key = bytes(cur.take(klen)).decode("utf-8")
             payload[key] = _decode_value(cur)
         out.append((chr(kind), table_id, payload))
-    return out
+    return seq, out
+
+
+def decode_window(blob: bytes) -> List[Tuple[str, int, dict]]:
+    """``decode_window_seq`` without the sequence number."""
+    return decode_window_seq(blob)[1]
 
 
 def encode_head_barrier(msg_type: int) -> bytes:
@@ -336,17 +384,20 @@ def encode_head_barrier(msg_type: int) -> bytes:
     message (StoreLoad / barrier ping / FinishTrain): the peer ranks
     must be at the same head kind, and the loud mismatch CHECK needs the
     kinds on the wire to compare (sync/server.py _mh_windows)."""
-    return _U8.pack(KIND_HEAD_BARRIER) + _I64.pack(int(msg_type))
+    return _seal(_U8.pack(KIND_HEAD_BARRIER) + _I64.pack(int(msg_type)))
 
 
 def decode_head_kind(blob: bytes):
     """First-byte dispatch: ('window', None) or ('barrier', msg_type) —
-    raises on anything else (format drift is a loud error)."""
+    raises on anything else (format drift is a loud error). Barrier
+    markers are fully consumed here, so their CRC is verified here;
+    window blobs defer to decode_window's check."""
     if not blob:
         raise ValueError("empty wire blob")
     lead = blob[0]
     if lead == KIND_WINDOW:
         return "window", None
     if lead == KIND_HEAD_BARRIER:
+        check_crc(blob)
         return "barrier", _I64.unpack_from(blob, 1)[0]
     raise ValueError(f"unknown wire blob kind {lead:#x}")

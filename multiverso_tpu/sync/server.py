@@ -29,6 +29,11 @@ from typing import Deque, Dict, List
 import numpy as np
 
 from multiverso_tpu.actor import Actor, actor_names
+from multiverso_tpu.failsafe import chaos
+from multiverso_tpu.failsafe import deadline as fdeadline
+from multiverso_tpu.failsafe.dedup import DedupWindow
+from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            TransientError, WireCorruption)
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.parallel import wire
 from multiverso_tpu.telemetry import metrics as tmetrics
@@ -155,6 +160,13 @@ class Server(Actor):
         #: (multihost.capped_exchange) — evolves identically on every
         #: rank, keeping steady exchanges to ONE collective round
         self._mh_caps: Dict = {}
+        #: failsafe: window-exchange sequence stamp. Incremented only on
+        #: a SUCCESSFUL exchange, so every rank's counter marches in
+        #: lockstep; a rank that re-enters the exchange alone (after an
+        #: asymmetric CRC failure) pairs with its peers' NEXT round and
+        #: the seq mismatch CHECK fires loudly on every rank instead of
+        #: silently merging different windows
+        self._mh_seq = 0
         # telemetry (telemetry/metrics.py; NULL instruments when off).
         # The mh_* int attributes above stay — tests assert them — and
         # the typed instruments mirror them into snapshots/exports.
@@ -173,6 +185,21 @@ class Server(Actor):
         self._t_host_bytes = tmetrics.counter("server.wire.host_bytes")
         self._t_dev_bytes = tmetrics.counter("server.wire.device_bytes")
         self._t_budget = tmetrics.gauge("server.window.host_budget_bytes")
+        #: failsafe: (src, msg_id) at-most-once window for Adds + its
+        #: hit counter (worker retries / duplicate deliveries answered
+        #: from the record instead of re-applying)
+        try:
+            dedup_cap = int(GetFlag("mv_dedup_window"))
+        except Exception:
+            dedup_cap = 4096
+        self._dedup = DedupWindow(dedup_cap)
+        self._t_dedup_hits = tmetrics.counter("failsafe.dedup_hits")
+        # registered eagerly (not on first increment) so a healthy run's
+        # MV_MetricsSnapshot() shows the failsafe machinery at ZERO —
+        # dashboards can alert on these without probing for existence
+        tmetrics.counter("failsafe.deadline_exceeded")
+        tmetrics.counter("failsafe.retries")
+        tmetrics.counter("wire.crc_failures")
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self._add_entry)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
@@ -196,6 +223,92 @@ class Server(Actor):
     #:  Gets share one gather; the window stays modest so other messages
     #: are not starved for long.
     GET_PIPELINE_WINDOW = 16
+
+    def _admit(self, msg: Message) -> bool:
+        """Failsafe admission gate, applied to every drained message
+        BEFORE it can enter a window's verb stream.
+
+        (1) At-most-once Adds: the (src, msg_id) dedup window answers a
+        duplicate — a mailbox dup or a worker retry after a failed ack —
+        from the recorded outcome instead of re-applying, and keeps it
+        OUT of the SPMD verb stream, where an extra verb on one rank
+        would trip the cross-rank divergence CHECK.
+
+        (2) Chaos rehearsal: the armed injector may reject a tracked
+        verb with TransientError before applying (driving the worker
+        retry path) or mark an Add to apply-then-fail-its-ack (driving
+        the retry INTO the dedup window). Decisions are consulted for
+        every verb in admission order, so two SPMD ranks with the same
+        seed fault the same lockstep positions."""
+        if (msg.msg_type in (MsgType.Request_Add, MsgType.Request_Get)
+                and getattr(msg, "_fs_admitted", False)):
+            # duplicate delivery of the SAME object (a mailbox dup):
+            # the admitted copy owns the reply — drop silently. Object
+            # identity needs no window slot, so this holds for
+            # fire-and-forget Adds too — and it covers Gets, whose
+            # duplicate would double-tick the BSP get clock and desync
+            # the SyncServer's round accounting.
+            self._t_dedup_hits.inc()
+            return False
+        if msg.msg_type is MsgType.Request_Add and msg.msg_id:
+            key = (msg.src, msg.msg_id)
+            tracked = msg.waiter is not None
+            if tracked and self._dedup.seen(key):
+                self._t_dedup_hits.inc()
+                ready, outcome = self._dedup.outcome(key)
+                msg.reply(outcome if ready else TransientError(
+                    "duplicate Add while the original is in flight"))
+                return False
+            failack = False
+            cz = chaos.get()
+            if cz is not None:
+                action = cz.verb_action(tracked=tracked)
+                if action == "transient":
+                    msg.reply(TransientError("chaos: transient verb "
+                                             "fault (pre-apply)"))
+                    return False
+                failack = action == "failack"
+            msg._fs_admitted = True
+            if tracked:
+                # only TRACKED Adds occupy dedup slots: they are the
+                # only ones a worker can retry, and a high-rate
+                # fire-and-forget burst must not evict a pending retry
+                # record (that eviction would break at-most-once)
+                self._dedup.record(key)
+                self._fs_wrap_reply(msg, key, failack)
+            return True
+        if msg.msg_type is MsgType.Request_Get:
+            cz = chaos.get()
+            if (cz is not None
+                    and cz.verb_action(tracked=msg.waiter is not None)
+                    == "transient"):
+                # Gets only take the pre-serve transient fault — they
+                # are idempotent (retry re-serves), so failack has
+                # nothing to rehearse (the draw still advances, keeping
+                # schedules lockstep across ranks)
+                msg.reply(TransientError("chaos: transient verb fault"))
+                return False
+            msg._fs_admitted = True
+        return True
+
+    def _fs_wrap_reply(self, msg: Message, key, failack: bool) -> None:
+        """Shadow ``msg.reply`` so the apply outcome lands in the dedup
+        window the moment it is known (whichever engine path replies),
+        and — chaos failack — the ACK delivered to the worker is
+        corrupted into a TransientError while the recorded outcome stays
+        truthful: the retry must be answered from the record, not
+        re-applied."""
+        orig = msg.reply
+        dedup = self._dedup
+
+        def _reply(result=None):
+            dedup.set_outcome(key, result)
+            if failack and not isinstance(result, Exception):
+                orig(TransientError("chaos: ack failed after apply"))
+            else:
+                orig(result)
+
+        msg.reply = _reply
 
     def _get_entry(self, msg: Message) -> None:
         """Window handler for Request_Get AND Request_Add, async engine.
@@ -231,6 +344,12 @@ class Server(Actor):
             # drained members bypass _dispatch — observe their queue
             # wait here (idempotent; the head was noted there already)
             self.note_dequeue(m)
+        # failsafe admission (dedup + chaos) BEFORE windowing: a
+        # duplicate or chaos-rejected verb must never become a stream
+        # position (divergent descriptors across ranks otherwise)
+        batch = [m for m in batch if self._admit(m)]
+        if not batch:
+            return
         from multiverso_tpu.parallel import multihost
         if multihost.process_count() > 1:
             # multi-process WINDOWED protocol (round 5): one host
@@ -376,8 +495,31 @@ class Server(Actor):
         is the protocol's flow control, exactly as the r4 per-verb
         collectives blocked). Verbs beyond an exchange's agreed prefix
         stay in the local deque and lead the NEXT exchange — the loop
-        always drains fully before returning."""
+        always drains fully before returning.
+
+        A DeadlineExceeded from the exchange (peer gone / diverged,
+        -mv_deadline_s set) fails EVERY drained message — their waiters
+        raise instead of hanging — and then propagates with its fatal
+        mark so the actor poisons itself: after an abandoned collective
+        this rank's collective stream is unsound."""
         pending: Deque[Message] = collections.deque(batch)
+        try:
+            self._mh_windows_inner(pending)
+        except Exception as exc:
+            # ANY escape aborts the stream mid-window — an abandoned
+            # exchange (DeadlineExceeded), an exhausted frame retry or
+            # corrupted barrier marker (WireCorruption), a desync/
+            # divergence CHECK (FatalError) — and all of them leave
+            # this rank's collective position unsound: fail every
+            # drained waiter (per-position errors never escape; they
+            # reply locally), then poison the actor so no further
+            # collectives are issued from a desynced stream
+            for m in pending:
+                m.reply(exc)
+            exc.mv_fatal = True
+            raise
+
+    def _mh_windows_inner(self, pending: "Deque[Message]") -> None:
         while pending:
             head = pending[0]
             if head.msg_type not in (MsgType.Request_Add,
@@ -427,9 +569,11 @@ class Server(Actor):
         then fails at the runtime layer (mismatched buffer shapes) —
         still an error, not a silent hang."""
         from multiverso_tpu.parallel import multihost
-        blobs = multihost.capped_exchange(
-            wire.encode_head_barrier(int(head.msg_type)),
-            self._mh_caps, "HEAD_B")
+        marker = wire.encode_head_barrier(int(head.msg_type))
+        blobs = fdeadline.bounded(
+            lambda: multihost.capped_exchange(marker, self._mh_caps,
+                                              "HEAD_B"),
+            "window head-marker exchange")
         kinds = [wire.decode_head_kind(b) for b in blobs]
         CHECK(all(k == kinds[0] for k in kinds),
               f"multi-process window heads diverge: {kinds} — every "
@@ -492,6 +636,86 @@ class Server(Actor):
         self._t_window_s.observe(_time.perf_counter() - _t_start)
         return done
 
+    #: collective re-exchange attempts after a CRC-detected corrupt
+    #: frame. Recovery relies on SYMMETRIC detection — every rank sees
+    #: the same round corrupted, which holds for fabric-level faults of
+    #: the shared round and (by construction) for the seeded chaos
+    #: schedule — so each rank re-enters the exchange in lockstep. An
+    #: ASYMMETRIC corruption leaves the detecting rank raising
+    #: WireCorruption after its retries while peers move on: a loud
+    #: error, bounded on the peers by -mv_deadline_s — never silently
+    #: decoded garbage.
+    MH_WIRE_RETRIES = 2
+
+    def _mh_exchange_decode(self, local, my_rank: int) -> list:
+        """Encode + exchange + decode one window, deadline-bounded,
+        retrying the full (collective) exchange when a received frame
+        fails its CRC32 trailer. Returns every rank's verb list."""
+        from multiverso_tpu.parallel import multihost
+        last_exc = None
+        for attempt in range(1 + self.MH_WIRE_RETRIES):
+            # flat binary codec (parallel/wire.py): pickle's object-
+            # graph walk + buffer copies were pure overhead for payloads
+            # that are already contiguous arrays; decode below is
+            # zero-copy. server.wire.encode_s times the CODEC only
+            # (bench compares it against the pickled baseline)
+            _t0 = _time.perf_counter()
+            blob = wire.encode_window(local, seq=self._mh_seq)
+            self._t_encode_s.observe(_time.perf_counter() - _t0)
+            cz = chaos.get()
+            if cz is not None:
+                bad = cz.corrupt_blob(blob)
+                if bad is not None:
+                    blob = bad
+            self._t_host_bytes.inc(len(blob))
+            # standing-cap exchange keyed by the window HEAD verb: the
+            # head is the same global verb on every rank (FIFO + common-
+            # prefix processing), and per-head payload sizes are stable
+            # in steady loops — so the exchange stays on the 1-round path
+            with ttrace.span("server.window.exchange", cat="server",
+                             args={"bytes": len(blob)}):
+                blobs = fdeadline.bounded(
+                    lambda: multihost.capped_exchange(
+                        blob, self._mh_caps, (local[0][0], local[0][1])),
+                    "window exchange")
+            _t0 = _time.perf_counter()
+            try:
+                windows: list = []
+                for i, b in enumerate(blobs):
+                    if i == my_rank:
+                        # our own verbs verbatim — no decode round-trip,
+                        # and deferred values keep their .local arrays
+                        windows.append(local)
+                        continue
+                    head_kind, head_mt = wire.decode_head_kind(b)
+                    CHECK(head_kind == "window",
+                          f"multi-process window heads diverge: rank {i} "
+                          f"is at a non-verb barrier (msg_type {head_mt}) "
+                          f"while rank {my_rank} exchanges verbs — every "
+                          f"process must reach the same stream position "
+                          f"(the SPMD collective contract)")
+                    peer_seq, decoded = wire.decode_window_seq(b)
+                    CHECK(peer_seq == (self._mh_seq & 0xFFFFFFFF),
+                          f"window exchange desynchronized: rank {i} is "
+                          f"at exchange {peer_seq}, rank {my_rank} at "
+                          f"{self._mh_seq} — a rank re-entered the "
+                          f"exchange alone (asymmetric frame corruption "
+                          f"retry?); the stream cannot be trusted")
+                    windows.append(decoded)
+            except WireCorruption as exc:
+                last_exc = exc
+                Log.Error("window exchange frame corrupt (attempt "
+                          "%d/%d): %r — re-exchanging", attempt + 1,
+                          1 + self.MH_WIRE_RETRIES, exc)
+                continue
+            self._t_decode_s.observe(_time.perf_counter() - _t0)
+            self._mh_seq += 1
+            return windows
+        # retries exhausted: this rank cannot re-enter the exchange
+        # again without desyncing from peers — fatal for the actor
+        last_exc.mv_fatal = True
+        raise last_exc
+
     def _mh_collective_window_inner(self, verbs) -> int:
         from multiverso_tpu.parallel import multihost
         my_rank = multihost.process_index()
@@ -524,41 +748,7 @@ class Server(Actor):
             packed += nbytes
             local.append((kind, m.table_id, payload))
         self._t_budget.set(packed)
-        # flat binary codec (parallel/wire.py): pickle's object-graph
-        # walk + buffer copies were pure overhead for payloads that are
-        # already contiguous arrays; decode below is zero-copy.
-        # server.wire.encode_s times the CODEC only (bench compares it
-        # against the pickled baseline) — packing/transport selection
-        # above is engine work either wire would pay
-        _t0 = _time.perf_counter()
-        blob = wire.encode_window(local)
-        self._t_encode_s.observe(_time.perf_counter() - _t0)
-        self._t_host_bytes.inc(len(blob))
-        # standing-cap exchange keyed by the window HEAD verb: the head
-        # is the same global verb on every rank (FIFO + common-prefix
-        # processing), and per-head payload sizes are stable in steady
-        # loops — so the exchange stays on the 1-round path
-        with ttrace.span("server.window.exchange", cat="server",
-                         args={"bytes": len(blob)}):
-            blobs = multihost.capped_exchange(blob, self._mh_caps,
-                                              (local[0][0], local[0][1]))
-        _t0 = _time.perf_counter()
-        windows: list = []
-        for i, b in enumerate(blobs):
-            if i == my_rank:
-                # our own verbs verbatim — no decode round-trip, and
-                # deferred values keep their .local arrays
-                windows.append(local)
-                continue
-            head_kind, head_mt = wire.decode_head_kind(b)
-            CHECK(head_kind == "window",
-                  f"multi-process window heads diverge: rank {i} is at "
-                  f"a non-verb barrier (msg_type {head_mt}) while rank "
-                  f"{my_rank} exchanges verbs — every process must "
-                  f"reach the same stream position (the SPMD collective "
-                  f"contract)")
-            windows.append(wire.decode_window(b))
-        self._t_decode_s.observe(_time.perf_counter() - _t0)
+        windows = self._mh_exchange_decode(local, my_rank)
         self.mh_window_exchanges += 1
         self._t_exchanges.inc()
         prefix = min(len(w) for w in windows)
@@ -861,11 +1051,18 @@ class SyncServer(Server):
 
     def _get_entry(self, msg: Message) -> None:
         # no pipelining window under BSP: the vector-clock protocol's
-        # defer/drain decisions depend on strict one-at-a-time processing
+        # defer/drain decisions depend on strict one-at-a-time
+        # processing. The failsafe admission gate (dedup + chaos) still
+        # applies BEFORE the clocks see the verb — a duplicate Add must
+        # not tick a vector clock twice.
+        if not self._admit(msg):
+            return
         self.ProcessGet(msg)
 
     def _add_entry(self, msg: Message) -> None:
         # no add-coalescing under BSP either (same strictness)
+        if not self._admit(msg):
+            return
         self.ProcessAdd(msg)
 
     def ProcessGet(self, msg: Message) -> None:

@@ -26,20 +26,34 @@ half bound to the same table id.
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from multiverso_tpu.failsafe import deadline as fdeadline
+from multiverso_tpu.failsafe.errors import TransientError
 from multiverso_tpu.message import Message, MsgType, next_msg_id
 from multiverso_tpu.parallel.wire import payload_nbytes
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
+from multiverso_tpu.utils.configure import cached_int_flag
 from multiverso_tpu.utils.dashboard import monitor_region
-from multiverso_tpu.utils.log import CHECK
+from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.utils.waiter import Waiter
+
+#: retry backoff: base * 2**attempt plus uniform jitter of one base —
+#: small absolute values (transients here are engine-injected or
+#: momentary, not WAN outages) so tests and tight loops stay fast
+_RETRY_BACKOFF_BASE_S = 0.02
+
+#: listener-refreshed cache (Wait runs once per tracked verb — no
+#: GetFlag registry walk on that path); flag defined in failsafe.deadline
+_max_retries_flag = cached_int_flag("mv_max_retries", 3)
 
 
 @dataclass
@@ -212,6 +226,10 @@ class WorkerTable:
         self._lock = threading.Lock()
         self._waiters: Dict[int, Waiter] = {}
         self._results: Dict[int, Any] = {}
+        #: tracked requests' (msg_type, payload, src) — kept until Wait
+        #: so a TransientError reply can resubmit the SAME request under
+        #: the SAME msg_id (the server dedup window's retry identity)
+        self._inflight: Dict[int, tuple] = {}
         self._tele: Optional[Dict[str, Any]] = None
 
     def _tele_verbs(self) -> Dict[str, Any]:
@@ -245,6 +263,7 @@ class WorkerTable:
             waiter = Waiter(1)
             with self._lock:
                 self._waiters[msg_id] = waiter
+                self._inflight[msg_id] = (msg_type, payload, src)
             msg = Message(msg_type=msg_type, table_id=self.table_id,
                           msg_id=msg_id, src=src, payload=payload,
                           waiter=waiter, on_reply=self._on_reply)
@@ -261,18 +280,77 @@ class WorkerTable:
 
     def _on_reply(self, msg: Message) -> None:
         with self._lock:
-            self._results[msg.msg_id] = msg.result
+            # a reply landing after the request was abandoned (deadline
+            # expiry cleaned its slots) must not repopulate _results —
+            # nothing would ever pop it again
+            if msg.msg_id in self._waiters:
+                self._results[msg.msg_id] = msg.result
+
+    def _resubmit(self, msg_id: int) -> Waiter:
+        """Re-send a tracked request under its ORIGINAL msg_id after a
+        TransientError: the server's (src, msg_id) dedup window is what
+        makes the retry at-most-once for Adds."""
+        with self._lock:
+            msg_type, payload, src = self._inflight[msg_id]
+            waiter = Waiter(1)
+            self._waiters[msg_id] = waiter
+            self._results.pop(msg_id, None)
+        msg = Message(msg_type=msg_type, table_id=self.table_id,
+                      msg_id=msg_id, src=src, payload=payload,
+                      waiter=waiter, on_reply=self._on_reply)
+        msg.trace_ctx = ttrace.current_ctx()
+        ttrace.flow_start(msg.trace_ctx)
+        self._zoo.SendToServer(msg)
+        return waiter
 
     def Wait(self, msg_id: int) -> Any:
         """Block until the request's reply arrived; returns its result
-        (reference table.cpp:84-95)."""
+        (reference table.cpp:84-95).
+
+        Failsafe layer on top of the reference semantics: with
+        ``-mv_deadline_s`` set the wait is bounded (expiry raises
+        ``DeadlineExceeded`` with the diagnostic bundle; unset blocks
+        exactly as before), and a ``TransientError`` reply is retried
+        up to ``-mv_max_retries`` times with exponential backoff +
+        jitter — safe because retries reuse the msg_id and the server
+        dedup window never double-applies an Add."""
         with self._lock:
             waiter = self._waiters.get(msg_id)
         CHECK(waiter is not None, f"unknown msg_id {msg_id}")
-        waiter.Wait()
+        max_retries = _max_retries_flag()
+        attempt = 0
+        while True:
+            if not waiter.Wait(fdeadline.timeout_or_none()):
+                try:
+                    # bundle first (it reports THIS in-flight request),
+                    # then abandon it: every bookkeeping slot is dropped
+                    # (an app catching DeadlineExceeded per request must
+                    # not leak a waiter + pinned payload per miss;
+                    # _on_reply ignores replies to abandoned ids)
+                    fdeadline.raise_deadline(
+                        f"table {self.table_id} reply to msg_id {msg_id}")
+                finally:
+                    with self._lock:
+                        self._waiters.pop(msg_id, None)
+                        self._inflight.pop(msg_id, None)
+                        self._results.pop(msg_id, None)
+            with self._lock:
+                result = self._results.pop(msg_id, None)
+            if isinstance(result, TransientError) and attempt < max_retries:
+                attempt += 1
+                tmetrics.counter("failsafe.retries").inc()
+                backoff = _RETRY_BACKOFF_BASE_S * (2 ** (attempt - 1))
+                backoff += random.random() * _RETRY_BACKOFF_BASE_S
+                Log.Debug("table %d msg_id %d transient (%r) — retry "
+                          "%d/%d in %.3fs", self.table_id, msg_id,
+                          result, attempt, max_retries, backoff)
+                time.sleep(backoff)
+                waiter = self._resubmit(msg_id)
+                continue
+            break
         with self._lock:
             self._waiters.pop(msg_id, None)
-            result = self._results.pop(msg_id, None)
+            self._inflight.pop(msg_id, None)
         if isinstance(result, Exception):
             raise result
         return result

@@ -36,6 +36,9 @@ class ASyncBuffer(Generic[T]):
     def Get(self) -> T:
         """Wait for the in-flight fill, return it, prefetch the other buffer."""
         assert self._pending is not None
+        # unbounded-ok: fill() is caller code whose duration defines the
+        # buffer's readiness — a deadline here would hand back a
+        # half-filled buffer; a wedged fill is the caller's bug to bound
         self._pending.join()
         ready = self._buffers[self._ready_idx]
         self._ready_idx ^= 1
@@ -44,5 +47,6 @@ class ASyncBuffer(Generic[T]):
 
     def Join(self) -> None:
         if self._pending is not None:
+            # unbounded-ok: completion rendezvous with the last fill
             self._pending.join()
             self._pending = None

@@ -39,27 +39,40 @@ def _notify(name) -> None:
         fn(name)
 
 
-def cached_bool_flag(name: str, default: bool):
-    """Zero-arg callable reading ``name`` as a bool from a listener-
-    refreshed cache — for per-message gates (telemetry/trace) where a
-    GetFlag registry walk per call is too costly. ``default`` applies
-    while the flag is unregistered or the registry is torn down."""
+def cached_flag(name: str, default, cast):
+    """Zero-arg callable reading ``name`` through ``cast`` from a
+    listener-refreshed cache — for per-message gates (telemetry/trace,
+    failsafe deadlines/retries) where a GetFlag registry walk per call
+    is too costly. ``default`` applies while the flag is unregistered
+    or the registry is torn down."""
     state = {"v": default}
 
     def _refresh(changed=None):
         if changed is None or changed == name:
             try:
-                state["v"] = bool(GetFlag(name))
+                state["v"] = cast(GetFlag(name))
             except Exception:
                 state["v"] = default
 
     register_flag_listener(_refresh)
     _refresh()
 
-    def _get() -> bool:
+    def _get():
         return state["v"]
 
     return _get
+
+
+def cached_bool_flag(name: str, default: bool):
+    return cached_flag(name, default, bool)
+
+
+def cached_int_flag(name: str, default: int):
+    return cached_flag(name, default, int)
+
+
+def cached_float_flag(name: str, default: float):
+    return cached_flag(name, default, float)
 
 
 class _FlagRegister(Generic[T]):

@@ -3,6 +3,11 @@
 Behavioral equivalent of reference include/multiverso/util/mt_queue.h:19-149:
 ``Push``, blocking ``Pop`` (returns False after ``Exit``), non-blocking
 ``TryPop``, ``Size``, ``Empty``, ``Exit`` (wakes all blocked poppers).
+
+``Pop``/``Front`` take an optional ``timeout`` (the failsafe contract:
+every blocking primitive in the package has a timeout-capable path) —
+``(False, None)`` then means Exit OR expiry; callers that must tell the
+two apart check ``alive``.
 """
 
 from __future__ import annotations
@@ -25,11 +30,10 @@ class MtQueue(Generic[T]):
             self._deque.append(item)
             self._cv.notify()
 
-    def Pop(self) -> Tuple[bool, Optional[T]]:
-        """Block until an item or Exit. Returns (ok, item)."""
+    def Pop(self, timeout: Optional[float] = None) -> Tuple[bool, Optional[T]]:
+        """Block until an item, Exit, or ``timeout``. Returns (ok, item)."""
         with self._cv:
-            while not self._deque and not self._exit:
-                self._cv.wait()
+            self._cv.wait_for(lambda: self._deque or self._exit, timeout)
             if self._deque:
                 return True, self._deque.popleft()
             return False, None
@@ -40,11 +44,10 @@ class MtQueue(Generic[T]):
                 return True, self._deque.popleft()
             return False, None
 
-    def Front(self) -> Tuple[bool, Optional[T]]:
+    def Front(self, timeout: Optional[float] = None) -> Tuple[bool, Optional[T]]:
         """Blocking peek (reference mt_queue.h:107-118)."""
         with self._cv:
-            while not self._deque and not self._exit:
-                self._cv.wait()
+            self._cv.wait_for(lambda: self._deque or self._exit, timeout)
             if self._deque:
                 return True, self._deque[0]
             return False, None

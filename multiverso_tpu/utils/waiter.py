@@ -4,6 +4,12 @@
 decrements; ``Reset(n)`` re-arms for n notifications. Used by the table layer
 to wait for all per-server reply partitions of one request
 (reference src/table.cpp:84-110).
+
+``Wait(timeout)`` returns False on expiry — and since the failsafe
+subsystem, every runtime call site HONORS that bool (tables/base.py
+``WorkerTable.Wait``, zoo.py ``FinishTrain``/``DrainServer``), raising
+``DeadlineExceeded`` when ``-mv_deadline_s`` is set instead of silently
+treating a timed-out wait as satisfied.
 """
 
 from __future__ import annotations

@@ -33,12 +33,16 @@ import numpy as np
 from multiverso_tpu.message import Message, MsgType
 from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # Imported for their flag registrations (sync, backup_worker_ratio,
-# updater_type, omp_threads, telemetry/trace/stats_interval_s) — they
-# MUST be registered before Start()'s ParseCMDFlags runs, or a
-# first-call "-sync=true" would be silently dropped.
+# updater_type, omp_threads, telemetry/trace/stats_interval_s,
+# mv_deadline_s/chaos_spec/chaos_seed) — they MUST be registered before
+# Start()'s ParseCMDFlags runs, or a first-call "-sync=true" would be
+# silently dropped.
+import multiverso_tpu.failsafe  # noqa: F401
 import multiverso_tpu.sync.server  # noqa: F401
 import multiverso_tpu.telemetry  # noqa: F401
 import multiverso_tpu.updaters.base  # noqa: F401
+from multiverso_tpu.failsafe import deadline as fdeadline
+from multiverso_tpu.failsafe.errors import ActorDied, DeadlineExceeded
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
@@ -125,7 +129,15 @@ class Zoo:
         from multiverso_tpu.telemetry.export import stop_reporter
         stop_reporter()
         if self.server_engine is not None:
-            self.FinishTrain()
+            try:
+                self.FinishTrain()
+            except (DeadlineExceeded, ActorDied) as exc:
+                # shutdown must LOG a stuck (or already-dead) engine and
+                # keep tearing down (Actor.Stop below is itself bounded
+                # and names a stuck actor + queue depth), never hang or
+                # abandon the rest of the shutdown sequence
+                Log.Error("Zoo.Stop: engine drain failed (%r) — "
+                          "continuing shutdown", exc)
             self.server_engine.Stop()
             self.server_engine = None
         self.worker_tables.clear()
@@ -135,7 +147,9 @@ class Zoo:
 
     def FinishTrain(self) -> None:
         """Send Server_Finish_Train for every worker so a SyncServer drains
-        its caches (reference zoo.cpp:152-162)."""
+        its caches (reference zoo.cpp:152-162). Deadline-bounded when
+        -mv_deadline_s is set: a wedged engine raises DeadlineExceeded
+        (with the diagnostic bundle) instead of hanging the drain."""
         if self.server_engine is None:
             return
         waiters = []
@@ -146,7 +160,8 @@ class Zoo:
             self.server_engine.Receive(msg)
             waiters.append(w)
         for w in waiters:
-            w.Wait()
+            if not w.Wait(fdeadline.timeout_or_none()):
+                fdeadline.raise_deadline("engine FinishTrain drain")
 
     # -- identity (reference zoo.h:40-66) ------------------------------------
 
@@ -223,29 +238,52 @@ class Zoo:
         waiter = Waiter(1)
         msg = Message(msg_type=MsgType.Request_Barrier, waiter=waiter)
         self.server_engine.Receive(msg)
-        waiter.Wait()
+        if not waiter.Wait(fdeadline.timeout_or_none()):
+            fdeadline.raise_deadline("engine barrier ping (DrainServer)")
         if isinstance(msg.result, Exception):
             raise msg.result
+
+    def _barrier_wait(self, leg: str) -> int:
+        """One in-process barrier rendezvous, deadline-bounded: a worker
+        thread that never arrives raises DeadlineExceeded (with the
+        diagnostic bundle) on every waiting thread instead of blocking
+        them forever. timeout=None (flag unset) blocks exactly as
+        before."""
+        timeout = fdeadline.timeout_or_none()
+        try:
+            return self._barrier.wait(timeout)
+        except threading.BrokenBarrierError:
+            # Barrier.wait(timeout) breaks the barrier for EVERY waiter
+            # (and a peer's deadline/abort lands here too) — after a
+            # divergence the barrier stays broken, which is the correct
+            # fail-fast posture. Flag unset: propagate the raw
+            # BrokenBarrierError exactly as before.
+            if timeout is None:
+                raise
+            fdeadline.raise_deadline(f"worker barrier ({leg})")
 
     def Barrier(self) -> None:
         """Worker barrier (reference zoo.cpp:164-177 controller roundtrip):
         all in-process worker threads, then — multihost — all processes
         (one host_barrier per rendezvous, issued by every process
-        collectively)."""
+        collectively). With -mv_deadline_s set, a diverged rank (peer
+        never reaches the barrier) raises DeadlineExceeded within the
+        deadline instead of hanging in the collective."""
         CHECK(self._barrier is not None, "Zoo not started")
         _t0 = time.perf_counter()
-        idx = self._barrier.wait()
+        idx = self._barrier_wait("enter")
         if self._multihost:
             if idx == 0:
                 try:
-                    multihost.host_barrier()
+                    fdeadline.bounded(multihost.host_barrier,
+                                      "cross-host barrier")
                 except BaseException:
                     # release the peers loudly (BrokenBarrierError) instead
                     # of stranding them; a failed cross-host barrier means a
                     # peer process is gone — the job cannot proceed
                     self._barrier.abort()
                     raise
-            self._barrier.wait()  # hold threads until the cross-host leg ends
+            self._barrier_wait("exit")  # hold threads until cross-host ends
         # telemetry: how long this thread sat in the barrier (straggler
         # skew shows up as a wide distribution here)
         tmetrics.histogram("zoo.barrier_wait_s").observe(

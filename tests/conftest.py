@@ -25,7 +25,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+#: per-test hang guard (failsafe subsystem): if a single test runs this
+#: long, dump EVERY thread's stack to stderr so a deadlock yields a
+#: stack report in the tier-1 log instead of a silent `timeout -k`
+#: kill. Sits above the slowest legitimate test (2-proc children use
+#: inner timeouts up to 280s) and below the tier-1 global 870s budget.
+#: exit=False: the dump is a report, not a kill — the harness owns that.
+_HANG_DUMP_S = float(os.environ.get("MV_TEST_HANG_DUMP_S", "330"))
+
+
+@pytest.fixture(autouse=True)
+def _hang_guard():
+    if _HANG_DUMP_S <= 0:
+        yield
+        return
+    faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=False)
+    yield
+    faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture()

@@ -1,0 +1,417 @@
+"""Failsafe subsystem (multiverso_tpu/failsafe/).
+
+Covers the four tentpole pillars plus the satellites:
+
+* deadlines — ``-mv_deadline_s`` bounds ``WorkerTable.Wait``, the
+  worker/cross-host barrier, the engine drain; expiry raises a typed
+  ``DeadlineExceeded`` carrying the diagnostic bundle (thread stacks,
+  mailbox depths, in-flight ids, telemetry), demonstrated 1-proc and
+  with a deliberately diverged 2-proc barrier;
+* chaos — the seeded injector is deterministic (same spec+seed ⇒ same
+  schedule) and its faults drive the retry/dedup machinery;
+* at-most-once — a worker retry after a failed ack is answered from the
+  server's (src, msg_id) dedup window, never re-applied;
+* fail-fast actor death — a dead loop thread poisons its mailbox:
+  queued and future requests raise ``ActorDied`` immediately;
+* MV_ShutDown logs (never hangs on) a stuck actor, naming it and its
+  queue depth;
+* a lint over the package: every ``.wait()``/``.join()`` either takes a
+  timeout-capable path or carries an ``unbounded-ok:`` justification.
+"""
+
+import re
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import multiverso_tpu
+from multiverso_tpu.failsafe import chaos as fchaos
+from multiverso_tpu.failsafe.dedup import PENDING, DedupWindow
+from multiverso_tpu.failsafe.errors import (ActorDied, DeadlineExceeded,
+                                            TransientError)
+
+
+class TestDeadlineOnTableWait:
+    def test_wedged_engine_raises_deadline_with_bundle(self, mv_env,
+                                                       monkeypatch):
+        """A Get whose server-side handler wedges raises DeadlineExceeded
+        within the configured deadline — with the diagnostic bundle
+        (thread stacks, engine state, in-flight ids) in the message —
+        instead of blocking the worker forever."""
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.zoo import Zoo
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        srv = Zoo.Get().server_tables[0]
+        release = threading.Event()
+        monkeypatch.setattr(srv, "ProcessGetAsync", lambda **kw: None)
+        monkeypatch.setattr(
+            srv, "ProcessGet",
+            lambda **kw: release.wait(3.0) and np.zeros(4, np.float32))
+        mv_env.MV_SetFlag("mv_deadline_s", 0.3)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded) as ei:
+            arr.Get()
+        assert time.monotonic() - t0 < 2.5
+        text = str(ei.value)
+        assert "diagnostic bundle" in text
+        assert "-- threads --" in text and "-- engine --" in text
+        assert "mailbox depth" in text
+        assert "msg_ids" in text            # the in-flight request shows
+        # the abandoned request leaks NO bookkeeping...
+        assert arr._waiters == {} and arr._inflight == {}
+        release.set()                       # let the engine finish clean
+        time.sleep(0.3)
+        # ...and its LATE reply is ignored, not re-accumulated
+        assert arr._results == {}
+        mv_env.MV_SetFlag("mv_deadline_s", 0.0)
+
+    def test_deadline_counter_visible_in_snapshot(self, mv_env):
+        from multiverso_tpu.telemetry import metrics
+        from multiverso_tpu.utils.waiter import Waiter
+        from multiverso_tpu.failsafe import deadline as fdeadline
+        mv_env.MV_SetFlag("mv_deadline_s", 0.05)
+        before = metrics.counter("failsafe.deadline_exceeded").value
+        with pytest.raises(DeadlineExceeded):
+            if not Waiter(1).Wait(fdeadline.timeout_or_none()):
+                fdeadline.raise_deadline("test waiter")
+        assert (metrics.counter("failsafe.deadline_exceeded").value
+                == before + 1)
+        snap = mv_env.MV_MetricsSnapshot()
+        assert snap["failsafe.deadline_exceeded"]["value"] >= 1
+        mv_env.MV_SetFlag("mv_deadline_s", 0.0)
+
+    def test_flag_unset_keeps_blocking_semantics(self, mv_env):
+        """mv_deadline_s=0 (the default) must hand Waiter.Wait a None
+        timeout — the byte-identical legacy blocking path."""
+        from multiverso_tpu.failsafe import deadline as fdeadline
+        assert fdeadline.timeout_or_none() is None
+        assert fdeadline.deadline_s() == 0.0
+
+
+class TestShutdownDrain:
+    def test_stuck_actor_logged_not_hung(self, capfd, monkeypatch):
+        """MV_ShutDown with a wedged engine logs the stuck actor's name
+        and queue depth within the bound instead of hanging."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.zoo import Zoo
+        mv.MV_Init([])
+        arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+        srv = Zoo.Get().server_tables[0]
+        release = threading.Event()
+
+        def _wedge(**kw):
+            release.wait(8.0)
+
+        monkeypatch.setattr(srv, "ProcessAddRun", lambda payloads: False)
+        monkeypatch.setattr(srv, "ProcessAdd", _wedge)
+        mv.MV_SetFlag("mv_deadline_s", 0.3)
+        arr.AddFireForget(np.ones(4, np.float32))   # wedges the engine
+        time.sleep(0.1)                             # let it enter the handler
+        t0 = time.monotonic()
+        mv.MV_ShutDown()
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+        err = capfd.readouterr().err
+        assert "stuck at shutdown" in err
+        assert "server" in err and "mailbox depth" in err
+
+
+class TestActorPoisoning:
+    def test_dead_loop_fails_pending_and_future_messages(self):
+        from multiverso_tpu.actor import Actor
+        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.utils.waiter import Waiter
+        actor = Actor("t-poison")
+        bomb = RuntimeError("boom")
+
+        def _die(msg):
+            raise SystemExit(bomb)      # BaseException: kills the loop
+
+        actor.RegisterHandler(MsgType.Request_Get, _die)
+        actor.Start()
+        w1, w2 = Waiter(1), Waiter(1)
+        m1 = Message(msg_type=MsgType.Request_Get, msg_id=1, waiter=w1)
+        m2 = Message(msg_type=MsgType.Request_Get, msg_id=2, waiter=w2)
+        actor.Receive(m1)
+        actor.Receive(m2)
+        # the loop dies on m1; m2 (queued) must be failed, not stranded
+        assert w2.Wait(5.0), "queued message's waiter never released"
+        assert isinstance(m2.result, ActorDied)
+        assert m2.result.actor_name == "t-poison"
+        # in-dispatch message is failed too (its handler never replied)
+        assert w1.Wait(5.0)
+        assert isinstance(m1.result, ActorDied)
+        # future sends fail fast with the original traceback chained
+        with pytest.raises(ActorDied) as ei:
+            actor.Receive(Message(msg_type=MsgType.Request_Get, msg_id=3))
+        assert isinstance(ei.value.__cause__, SystemExit)
+        actor.Stop()
+
+    def test_shutdown_after_engine_poison_completes(self):
+        """A poisoned ENGINE must not wedge MV_ShutDown: the drain's
+        ActorDied is logged and teardown completes."""
+        import sys
+
+        import multiverso_tpu as mv
+        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.utils.waiter import Waiter
+        from multiverso_tpu.zoo import Zoo
+        mv.MV_Init([])
+        arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+        arr.Add(np.ones(4, np.float32))
+        engine = Zoo.Get().server_engine
+        # SystemExit escapes the handler's `except Exception` and kills
+        # the loop thread — the fail-fast path this PR adds
+        w = Waiter(1)
+        Zoo.Get().SendToServer(Message(
+            msg_type=MsgType.Request_StoreLoad, waiter=w,
+            payload={"fn": sys.exit}))
+        assert w.Wait(5.0)
+        deadline = time.monotonic() + 5.0
+        while engine._poison is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine._poison is not None
+        # verbs now fail fast instead of hanging
+        with pytest.raises(ActorDied):
+            arr.Add(np.ones(4, np.float32))
+        t0 = time.monotonic()
+        mv.MV_ShutDown()                 # must complete, not hang/raise
+        assert time.monotonic() - t0 < 10.0
+
+    def test_healthy_actor_unaffected(self):
+        from multiverso_tpu.actor import Actor
+        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.utils.waiter import Waiter
+        actor = Actor("t-healthy")
+        actor.RegisterHandler(MsgType.Request_Get,
+                              lambda m: m.reply("ok"))
+        actor.Start()
+        w = Waiter(1)
+        m = Message(msg_type=MsgType.Request_Get, msg_id=1, waiter=w)
+        actor.Receive(m)
+        assert w.Wait(5.0) and m.result == "ok"
+        actor.Stop()
+
+
+class TestDedupWindow:
+    def test_record_outcome_lifecycle(self):
+        d = DedupWindow(capacity=8)
+        assert not d.seen(("a", 1))
+        d.record(("a", 1))
+        assert d.seen(("a", 1))
+        ready, _ = d.outcome(("a", 1))
+        assert not ready                    # still pending
+        d.set_outcome(("a", 1), None)
+        ready, out = d.outcome(("a", 1))
+        assert ready and out is None
+        # first outcome wins
+        d.set_outcome(("a", 1), RuntimeError("late"))
+        ready, out = d.outcome(("a", 1))
+        assert ready and out is None
+
+    def test_eviction_is_fifo_and_bounded(self):
+        d = DedupWindow(capacity=4)
+        for i in range(10):
+            d.record(("w", i))
+        assert len(d) == 4
+        assert not d.seen(("w", 0)) and d.seen(("w", 9))
+
+    def test_pending_sentinel_never_leaks(self):
+        d = DedupWindow(4)
+        d.record("k")
+        ready, out = d.outcome("k")
+        assert not ready and out is not PENDING
+
+
+class TestRetryAndDedup:
+    def test_failack_retry_is_answered_from_dedup_not_reapplied(self):
+        """chaos verb.failack at probability 1: every tracked Add is
+        APPLIED once, its ack corrupted into TransientError; the worker
+        retry (same msg_id) must be answered from the dedup window —
+        the table value proves no double-apply."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.telemetry import metrics
+        mv.MV_Init(["-chaos_spec=verb.failack:1.0", "-chaos_seed=3"])
+        try:
+            arr = mv.MV_CreateTable(ArrayTableOption(size=8))
+            arr.Add(np.ones(8, np.float32))         # tracked, blocking
+            arr.Add(np.ones(8, np.float32))
+            mv.MV_SetFlag("chaos_spec", "")         # clean reads below
+            got = arr.Get()
+            np.testing.assert_allclose(got, 2.0)    # applied EXACTLY twice
+            assert metrics.counter("failsafe.retries").value >= 2
+            assert metrics.counter("failsafe.dedup_hits").value >= 2
+            assert metrics.counter("chaos.verb.failack").value >= 2
+            snap = mv.MV_MetricsSnapshot()
+            assert snap["failsafe.dedup_hits"]["value"] >= 2
+            assert snap["failsafe.retries"]["value"] >= 2
+        finally:
+            mv.MV_ShutDown()
+
+    def test_transient_preapply_retries_to_success(self):
+        """chaos verb.transient rejects before applying; the retry loop
+        (backoff + jitter) lands the Add exactly once. Probability 0.5
+        with a fixed seed: some verbs fault, none exhaust 3 retries."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.telemetry import metrics
+        mv.MV_Init(["-chaos_spec=verb.transient:0.5", "-chaos_seed=11",
+                    "-mv_max_retries=12"])
+        try:
+            arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+            for _ in range(6):
+                arr.Add(np.ones(4, np.float32))
+            mv.MV_SetFlag("chaos_spec", "")
+            np.testing.assert_allclose(arr.Get(), 6.0)
+            assert metrics.counter("chaos.verb.transient").value >= 1
+            assert metrics.counter("failsafe.retries").value >= 1
+        finally:
+            mv.MV_ShutDown()
+
+    def test_mailbox_dup_is_skipped_by_dedup(self):
+        """chaos mailbox.dup enqueues every verb twice; the dedup window
+        must drop the copy before it reaches the apply path."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.telemetry import metrics
+        mv.MV_Init(["-chaos_spec=mailbox.dup:1.0", "-chaos_seed=5"])
+        try:
+            arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+            for _ in range(4):
+                arr.Add(np.ones(4, np.float32))
+            mv.MV_SetFlag("chaos_spec", "")
+            fchaos.quiesce()
+            np.testing.assert_allclose(arr.Get(), 4.0)
+            assert metrics.counter("chaos.mailbox.dup").value >= 4
+            assert metrics.counter("failsafe.dedup_hits").value >= 4
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestBspChaosDup:
+    def test_dup_deliveries_do_not_double_tick_bsp_clocks(self):
+        """A duplicated delivery of a Get/Add must be dropped by object
+        identity BEFORE the SyncServer's vector clocks see it — a
+        double tick would desync the BSP round accounting."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import ArrayTableOption
+        mv.MV_Init(["-sync=true", "-chaos_spec=mailbox.dup:1.0",
+                    "-chaos_seed=2"])
+        try:
+            arr = mv.MV_CreateTable(ArrayTableOption(size=4))
+            for i in range(4):
+                arr.Add(np.ones(4, np.float32))
+                got = arr.Get()     # every copy of every verb is dup'd
+                np.testing.assert_allclose(got, float(i + 1))
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestChaosDeterminism:
+    _SPEC = ("mailbox.drop:0.1,mailbox.dup:0.2,mailbox.delay:0.15,"
+             "wire.bitflip:0.3,verb.transient:0.25,verb.failack:0.1")
+
+    def _schedule(self, seed, n=200):
+        inj = fchaos.ChaosInjector(fchaos.parse_spec(self._SPEC), seed)
+        out = []
+        blob = bytes(range(64))
+        for i in range(n):
+            out.append(inj.mailbox_action())
+            out.append(inj.verb_action(tracked=bool(i % 2)))
+            out.append(inj.corrupt_blob(blob))
+        return out
+
+    def test_same_spec_and_seed_same_schedule(self):
+        assert self._schedule(42) == self._schedule(42)
+
+    def test_different_seed_different_schedule(self):
+        assert self._schedule(42) != self._schedule(43)
+
+    def test_sites_draw_independently(self):
+        """A site's schedule depends only on (seed, call index), never
+        on which OTHER sites are enabled — adding a site to the spec
+        must not reshuffle existing schedules."""
+        full = fchaos.ChaosInjector(fchaos.parse_spec(self._SPEC), 7)
+        solo = fchaos.ChaosInjector(
+            fchaos.parse_spec("verb.transient:0.25"), 7)
+        full_hits = [full.verb_action(tracked=True) == "transient"
+                     for _ in range(100)]
+        solo_hits = [solo.verb_action(tracked=True) == "transient"
+                     for _ in range(100)]
+        assert full_hits == solo_hits
+
+    def test_spec_validation_is_loud(self):
+        from multiverso_tpu.utils.log import FatalError
+        with pytest.raises(FatalError):
+            fchaos.parse_spec("bogus.site:0.5")
+        with pytest.raises(FatalError):
+            fchaos.parse_spec("verb.transient:1.5")
+        assert fchaos.parse_spec("") == {}
+
+    def test_corrupt_blob_never_touches_kind_byte(self):
+        inj = fchaos.ChaosInjector(
+            fchaos.parse_spec("wire.bitflip:1.0"), 9)
+        blob = bytes(range(40))
+        for _ in range(50):
+            bad = inj.corrupt_blob(blob)
+            assert bad is not None and bad[0] == blob[0]
+            assert bad != blob and len(bad) == len(blob)
+
+
+class TestBlockingPathLint:
+    """Every bare ``.wait()`` / ``.join()`` in the package must either
+    not exist (a timeout-capable call replaced it) or carry an
+    ``unbounded-ok:`` justification within the 3 preceding lines; whole
+    files may be allowlisted with a justification here."""
+
+    FILE_ALLOW = {
+        # pallas DMA semaphore waits: device-side copy completion inside
+        # traced kernels — not host thread blocking, no timeout concept
+        "ops/pallas_rows.py":
+            "pallas DMA semaphore .wait() inside traced kernels",
+    }
+
+    # case-insensitive: the package's own primitives are capitalized
+    # (Waiter.Wait, ASyncBuffer.Join) and are exactly what the failsafe
+    # contract is about — a lowercase-only lint would miss them
+    _PATTERN = re.compile(r"\.(?:wait|join)\(\s*\)", re.IGNORECASE)
+
+    def test_no_unbounded_wait_or_join_without_justification(self):
+        pkg = Path(multiverso_tpu.__file__).parent
+        offenders = []
+        for py in sorted(pkg.rglob("*.py")):
+            rel = str(py.relative_to(pkg))
+            if rel in self.FILE_ALLOW:
+                continue
+            lines = py.read_text().splitlines()
+            for i, line in enumerate(lines):
+                if not self._PATTERN.search(line):
+                    continue
+                context = lines[max(0, i - 3): i + 1]
+                if any("unbounded-ok:" in ln for ln in context):
+                    continue
+                offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+        assert not offenders, (
+            "unbounded blocking calls without a timeout-capable path or "
+            "an 'unbounded-ok:' justification:\n" + "\n".join(offenders))
+
+    def test_blocking_primitives_expose_timeouts(self):
+        """The package's own blocking primitives all take timeouts."""
+        import inspect
+
+        from multiverso_tpu.utils.mt_queue import MtQueue
+        from multiverso_tpu.utils.waiter import Waiter
+        assert "timeout" in inspect.signature(MtQueue.Pop).parameters
+        assert "timeout" in inspect.signature(MtQueue.Front).parameters
+        assert "timeout" in inspect.signature(Waiter.Wait).parameters
+        q = MtQueue()
+        t0 = time.monotonic()
+        ok, item = q.Pop(timeout=0.05)
+        assert not ok and item is None
+        assert time.monotonic() - t0 < 2.0

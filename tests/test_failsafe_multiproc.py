@@ -1,0 +1,247 @@
+"""Failsafe subsystem, multi-process acceptance drills.
+
+* diverged barrier — one rank never reaches MV_Barrier; with
+  ``-mv_deadline_s`` set the waiting rank raises ``DeadlineExceeded``
+  (with the stack/diagnostic bundle) WITHIN the deadline instead of
+  hanging in the collective;
+* chaos soak — a seeded drop/dup/delay + verb-fault + wire-bitflip run
+  over the 2-proc windowed engine: corruption is caught by CRC (and the
+  lockstep re-exchange recovers), retries are deduped (no double-apply,
+  asserted on table values), and the final state matches the fault-free
+  oracle;
+* crash-recovery drill — kill one rank mid-window; the survivor reports
+  a bounded, typed failure; a fresh world ``MV_LoadCheckpoint``s and
+  re-runs the lost steps to exact parity with an uninterrupted run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.test_multihost import run_two_process
+
+_HDR = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+'''
+
+
+_BARRIER_DIVERGE_CHILD = _HDR + r'''
+import time
+from multiverso_tpu.failsafe.errors import DeadlineExceeded
+
+sentinel = os.path.join(sys.argv[3], "rank0_deadline_fired")
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=3"])
+if rank == 0:
+    t0 = time.monotonic()
+    try:
+        mv.MV_Barrier()
+        print("child 0 NO-RAISE", flush=True)
+    except DeadlineExceeded as e:
+        dt = time.monotonic() - t0
+        text = str(e)
+        assert dt < 10, f"deadline fired late: {dt}"
+        assert "diagnostic bundle" in text, text[:500]
+        assert "-- threads --" in text, text[:500]
+        assert "-- engine --" in text and "mailbox depth" in text
+        assert "host_barrier" in text, "stuck collective not in stacks"
+        print("child 0 DIVERGED-BARRIER OK", flush=True)
+    with open(sentinel, "w") as f:
+        f.write("fired")
+    # the COORDINATOR (rank 0) must outlive rank 1's clean exit, or
+    # rank 1's jax.distributed client aborts on coordinator loss
+    time.sleep(2.5)
+else:
+    # the divergence: rank 1 NEVER calls the barrier; it stays alive —
+    # genuinely blocking rank 0's collective — until rank 0 reports
+    t0 = time.monotonic()
+    while not os.path.exists(sentinel) and time.monotonic() - t0 < 60:
+        time.sleep(0.1)
+    assert os.path.exists(sentinel), "rank 0 never hit its deadline"
+    print("child 1 DIVERGED-BARRIER OK", flush=True)
+os._exit(0)
+'''
+
+
+_SOAK_CHILD = _HDR + r'''
+from multiverso_tpu.failsafe import chaos
+from multiverso_tpu.tables import MatrixTableOption
+
+SPEC = ("mailbox.drop:0.06,mailbox.dup:0.08,mailbox.delay:0.08@0.002,"
+        "verb.transient:0.06,verb.failack:0.06,wire.bitflip:0.05")
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=120", "-mv_max_retries=12",
+            f"-chaos_spec={SPEC}", "-chaos_seed=1234"])
+R, C, STEPS = 48, 4, 30
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+rng = np.random.default_rng(100 + rank)
+for step in range(STEPS):
+    ids = np.sort(rng.choice(R, 6, replace=False)).astype(np.int32)
+    deltas = rng.standard_normal((6, C)).astype(np.float32)
+    mat.AddRows(ids, deltas)          # tracked: chaos can fault + retry
+# quiesce chaos before the read-out so no delayed delivery is in flight
+chaos.quiesce()
+mv.MV_SetFlag("chaos_spec", "")
+chaos.quiesce()
+got = mat.GetRows(np.arange(R, dtype=np.int32))
+
+# fault-free oracle: sum of both ranks' deterministic delta streams
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(100 + r)
+    for step in range(STEPS):
+        oids = np.sort(orng.choice(R, 6, replace=False)).astype(np.int32)
+        od = orng.standard_normal((6, C)).astype(np.float32)
+        np.add.at(oracle, oids, od)
+np.testing.assert_allclose(got, oracle, rtol=2e-4, atol=2e-4)
+
+mv.MV_Barrier()
+snap = mv.MV_MetricsSnapshot()        # collective: both ranks, same spot
+def val(name):
+    return snap.get(name, {}).get("value", 0)
+# every chaos kind actually fired somewhere in the job...
+for kind in ("chaos.mailbox.drop", "chaos.mailbox.dup",
+             "chaos.mailbox.delay", "chaos.verb.transient",
+             "chaos.verb.failack", "chaos.wire.bitflip"):
+    assert val(kind) >= 1, (kind, {k: v for k, v in snap.items()
+                                   if k.startswith(("chaos", "fail",
+                                                    "wire"))})
+# ...and the recovery machinery it exercises engaged: retries happened,
+# the dedup window absorbed dup/failack duplicates, and the CRC trailer
+# caught the bit-flipped frames (the lockstep re-exchange then healed)
+assert val("failsafe.retries") >= 1, snap.get("failsafe.retries")
+assert val("failsafe.dedup_hits") >= 1, snap.get("failsafe.dedup_hits")
+assert val("wire.crc_failures") >= 1, snap.get("wire.crc_failures")
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} SOAK OK", flush=True)
+'''
+
+
+_DRILL_CHILD = _HDR + r'''
+ckpt, phase = sys.argv[3], sys.argv[4]
+from multiverso_tpu.tables import MatrixTableOption
+
+R, C, CKPT_STEP, TOTAL = 24, 4, 5, 8
+
+def step_add(step, r):
+    """Deterministic integer-valued deltas: f32 sums are exact, so
+    parity below is exact equality, not a tolerance."""
+    ids = np.array([r, 10 + (step % 5), 20], np.int32)
+    deltas = np.full((3, C), float(step + 1 + r), np.float32)
+    return ids, deltas
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=5"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+
+if phase == "crash":
+    for step in range(CKPT_STEP):
+        mat.AddRows(*step_add(step, rank))
+    mv.MV_SaveCheckpoint(ckpt)
+    try:
+        for step in range(CKPT_STEP, TOTAL):
+            ids, deltas = step_add(step, rank)
+            if rank == 1 and step == CKPT_STEP + 1:
+                # die MID-WINDOW: enqueue a fire-and-forget add and
+                # kill the process before the window exchange completes
+                mat.AddFireForget(deltas, row_ids=ids)
+                os._exit(3)
+            mat.AddRows(ids, deltas)
+        print("child 0 UNEXPECTED-COMPLETION", flush=True)
+        os._exit(4)
+    except BaseException as e:
+        # the survivor must FAIL BOUNDED AND TYPED, not hang: either
+        # the deadline fired (DeadlineExceeded) or the transport
+        # surfaced the dead peer — both reach the worker as a raise
+        print(f"child 0 CRASH-DETECTED {type(e).__name__}", flush=True)
+        os._exit(0)
+else:
+    # restart: restore the checkpoint, re-run the lost steps, and
+    # demand exact parity with an uninterrupted run
+    mv.MV_LoadCheckpoint(ckpt)
+    for step in range(CKPT_STEP, TOTAL):
+        mat.AddRows(*step_add(step, rank))
+    got = mat.GetRows(np.arange(R, dtype=np.int32))
+    oracle = np.zeros((R, C), np.float32)
+    for r in range(2):
+        for step in range(TOTAL):
+            ids, deltas = step_add(step, r)
+            np.add.at(oracle, ids, deltas)
+    np.testing.assert_array_equal(got, oracle)
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    print(f"child {rank} RESTORE OK", flush=True)
+'''
+
+
+class TestDivergedBarrierDeadline:
+    def test_waiting_rank_raises_within_deadline(self, tmp_path):
+        """Acceptance: a deliberately diverged 2-proc barrier (one rank
+        never calls it) raises DeadlineExceeded with the stack/
+        diagnostic bundle within the deadline on the waiting rank."""
+        outs = run_two_process(_BARRIER_DIVERGE_CHILD, tmp_path,
+                               str(tmp_path),
+                               expect="DIVERGED-BARRIER OK")
+        assert "NO-RAISE" not in outs[0]
+
+
+class TestChaosSoak:
+    def test_soak_converges_and_recovery_machinery_engages(self, tmp_path):
+        """Acceptance: seeded drop/dup/delay + verb faults + wire
+        bit-flips over a 2-proc windowed run — CRC catches corruption,
+        retries are deduped (no double-apply), and the final state
+        equals the fault-free oracle."""
+        run_two_process(_SOAK_CHILD, tmp_path, expect="SOAK OK",
+                        timeout=280)
+
+
+class TestCrashRecoveryDrill:
+    def test_kill_restart_load_checkpoint_parity(self, tmp_path):
+        """Acceptance: kill one rank mid-window; the survivor fails
+        bounded+typed; a restarted world loads the checkpoint, re-runs
+        the lost steps, and matches the uninterrupted run exactly."""
+        ckpt = f"file://{tmp_path}/drill.mvt"
+        child = tmp_path / "drill_child.py"
+        child.write_text(_DRILL_CHILD)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        procs = [subprocess.Popen(
+            [sys.executable, str(child), str(r), str(port), ckpt, "crash"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        outs = []
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                out, _ = p.communicate()
+                pytest.fail(f"crash phase hung (survivor unbounded):\n"
+                            f"{out[-2000:]}")
+            outs.append((p.returncode, out))
+        rc0, out0 = outs[0]
+        rc1, out1 = outs[1]
+        assert rc1 == 3, f"rank 1 should have died mid-window:\n{out1[-800:]}"
+        assert rc0 == 0, f"survivor exited uncleanly:\n{out0[-2000:]}"
+        assert "CRASH-DETECTED" in out0, out0[-2000:]
+        assert "UNEXPECTED-COMPLETION" not in out0
+        # restart: fresh 2-proc world, restore, re-run, exact parity
+        run_two_process(_DRILL_CHILD, tmp_path, ckpt, "restore",
+                        expect="RESTORE OK")

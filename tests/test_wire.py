@@ -183,7 +183,18 @@ class TestRoundTrip:
 
     def test_empty_window(self):
         blob, out = roundtrip([])
-        assert out == [] and len(blob) == 5
+        # 1 kind + 4 seq + 4 count + 4 CRC trailer
+        assert out == [] and len(blob) == 9 + wire.CRC_TRAILER_BYTES
+
+    def test_exchange_seq_roundtrips(self):
+        """The window's exchange sequence stamp (the engine's lockstep
+        desync tripwire) survives the wire, including u32 wraparound."""
+        verbs = [("A", 0, {"values": np.ones(4, np.float32)})]
+        for seq in (0, 7, 2**32 - 1, 2**32 + 5):
+            blob = wire.encode_window(verbs, seq=seq)
+            got_seq, got = wire.decode_window_seq(blob)
+            assert got_seq == seq % 2**32
+            assert len(got) == 1
 
     def test_head_barrier_marker(self):
         blob = wire.encode_head_barrier(35)
@@ -194,6 +205,46 @@ class TestRoundTrip:
             wire.decode_head_kind(b"\xff junk")
         with pytest.raises(ValueError):
             wire.decode_head_kind(b"")
+
+    def test_crc_detects_bitflips_everywhere(self):
+        """Any single flipped bit past the kind byte raises
+        WireCorruption BEFORE decoding — never garbage arrays."""
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        blob = wire.encode_window(
+            [("A", 0, {"values": np.arange(32, dtype=np.float32),
+                       "option": AddOption(worker_id=1)})])
+        for pos in range(1, len(blob)):
+            bad = bytearray(blob)
+            bad[pos] ^= 0x10
+            with pytest.raises(WireCorruption):
+                wire.decode_window(bytes(bad))
+
+    def test_crc_detects_truncation(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        blob = wire.encode_window(
+            [("A", 0, {"values": np.ones(8, np.float32)})])
+        for cut in (1, 4, 5, len(blob) - 1):
+            with pytest.raises(WireCorruption):
+                wire.decode_window(blob[:-cut])
+        with pytest.raises(WireCorruption):
+            wire.decode_window(b"")
+
+    def test_crc_on_head_barrier_marker(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        blob = wire.encode_head_barrier(35)
+        bad = bytearray(blob)
+        bad[3] ^= 0x01
+        with pytest.raises(WireCorruption):
+            wire.decode_head_kind(bytes(bad))
+
+    def test_crc_failures_counted(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        from multiverso_tpu.telemetry import metrics
+        blob = wire.encode_window([("G", 1, {"keys": None})])
+        before = metrics.counter("wire.crc_failures").value
+        with pytest.raises(WireCorruption):
+            wire.decode_window(blob[:-1])
+        assert metrics.counter("wire.crc_failures").value == before + 1
 
     @pytest.mark.parametrize("seed", [3, 17])
     def test_randomized_property_windows(self, seed):
