@@ -1112,6 +1112,8 @@ _COMPACT_PRIORITY = [
     "matrix_table_2proc_wire_codec_ms_per_window",
     "matrix_table_2proc_wire_pickle_ms_per_window",
     "kv_burst_2proc_collectives_per_op",
+    "matrix_table_2proc_overlap_pct",
+    "matrix_table_2proc_pipeline_burst_per_proc_Melem_s",
     "two_proc_transport_crossover_MB",
     "matrix_table_2proc_bsp_per_proc_Melem_s",
     "compress_sparse_2proc_wire_reduction_x",
@@ -1309,6 +1311,22 @@ from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.zoo import Zoo
 eng = Zoo.Get().server_engine
 
+ids_h, deltas_h = ids[:K // 2], deltas[:K // 2]     # 0.5MB per add
+BURST_N = 32            # adds per burst; burst_secs below divides by it
+
+def pipe_burst(n):
+    # one long fire-and-forget run spanning SEVERAL window byte
+    # budgets: the pipelined engine exchanges window N+1 while window
+    # N applies — unlike window() above, whose whole burst fits one
+    # window and whose next burst waits on this one's replies (nothing
+    # to overlap). Half-size adds keep the worker-combined payloads
+    # (8 x 0.5MB) under -window_device_min_bytes, so the burst
+    # measures HOST-wire pipelining (a deferred device-wire window
+    # fences the overlap gate by design — its apply is collective)
+    for _ in range(n):
+        table.AddFireForget(deltas_h, row_ids=ids_h)
+    table.Wait(table.GetAsyncHandle(row_ids=ids[:64]))
+
 def _wire_seconds():
     # telemetry histograms replaced the r6 ad-hoc STATS keys: the
     # engine observes each window's codec encode/decode time into
@@ -1328,6 +1346,15 @@ multihost.host_barrier()
 pipe_secs = (time.perf_counter() - t0) / (ROUNDS * W)
 pipe_coll_per_op = (multihost.STATS["host_collective_rounds"] - c0
                     - barrier_cost) / (2 * W * ROUNDS)
+# round 7 — pipelined engine burst: exchange/apply overlap needs a
+# run long enough to span multiple windows (see pipe_burst)
+pipe_burst(BURST_N)                                     # warm
+multihost.host_barrier()
+t0 = time.perf_counter()
+for _ in range(4):
+    pipe_burst(BURST_N)
+multihost.host_barrier()
+burst_secs = (time.perf_counter() - t0) / (4 * BURST_N)
 # flat-codec cost the ENGINE actually paid per window exchange (encode
 # + zero-copy decode, parallel/wire.py), vs a pickled baseline of the
 # same representative window payload — the r5 wire pickled everything
@@ -1405,11 +1432,21 @@ if nproc > 1:
         "device_parts_round_floor_ms": round(dev_floor_ms, 1),
     }
 
+overlap_pct = tmetrics.snapshot().get("engine.overlap_pct",
+                                      {}).get("value", 0.0)
 mv.MV_Barrier()
 mv.MV_ShutDown()
 if rank == 0:
     per_op = 2 * K * C / 1e6
     print("NPROC_RESULT " + json.dumps(dict(prof, **{
+        # round 7: share of exchange-stage wall that overlapped an
+        # apply (pipelined engine; bursty pipelined rounds drive it,
+        # blocking rounds leave it ~0 — one verb in flight at a time)
+        "overlap_pct": round(overlap_pct, 1),
+        # add-only Melem/s of the multi-window fire-and-forget burst
+        # (K/2*C elems per add; the drain Get excluded from the count)
+        "pipeline_burst_per_proc_Melem_s": round(
+            K // 2 * C / 1e6 / burst_secs, 1),
         "host_per_proc_Melem_s": round(per_op / host_secs, 1),
         "host_aggregate_Melem_s": round(nproc * per_op / host_secs, 1),
         "host_collectives_per_op": round(host_coll_per_op, 2),
@@ -1785,7 +1822,37 @@ def update_doc(json_path: str,
     return 0
 
 
+#: guard baseline for the tier-1 bench regression test
+#: (tests/test_bench_guard.py): the last ACCEPTED run's headline
+#: metrics, frozen by --update-guard and committed
+GUARD_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "BENCH_GUARD.json")
+
+
+def update_guard(json_path: str = FULL_JSON_PATH) -> int:
+    """Freeze the current artifact's guarded metrics (plus the platform/
+    host identity that scopes the comparison) into docs/BENCH_GUARD.json.
+    Run after accepting a bench run; the tier-1 guard test then fails
+    any later run that regresses >20% on these."""
+    with open(json_path) as f:
+        data = json.load(f)
+    keep = ("platform", "host_cores", "logreg_train_samples_per_sec",
+            "matrix_table_2proc_host_per_proc_Melem_s",
+            "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec")
+    guard = {k: data[k] for k in keep if k in data}
+    if data.get("metric") in keep and "value" in data:
+        # the headline rides the artifact as metric/value, not a named key
+        guard[data["metric"]] = data["value"]
+    with open(GUARD_JSON_PATH, "w") as f:
+        json.dump(guard, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"updated {GUARD_JSON_PATH} from {json_path}: {guard}")
+    return 0
+
+
 if __name__ == "__main__":
+    if sys.argv[1:2] == ["--update-guard"]:
+        sys.exit(update_guard(*sys.argv[2:3]))
     if sys.argv[1:2] == ["--update-doc"]:
         if len(sys.argv) < 3:
             print("usage: bench.py --update-doc <bench-json>",

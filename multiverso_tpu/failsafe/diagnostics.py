@@ -56,6 +56,17 @@ def _engine_state() -> str:
             f"{getattr(srv, 'mh_window_exchanges', 0)}, "
             f"window_verbs={getattr(srv, 'mh_window_verbs', 0)}, "
             f"barrier_splits={getattr(srv, 'window_barrier_splits', 0)}")
+        stage = getattr(srv, "_ex_stage", None)
+        if stage is not None:
+            # pipelined engine (round 7): where each stage stood at
+            # expiry — an exchange stuck waiting for peers shows depth
+            # + busy, a wedged apply shows unapplied items piling up
+            lines.append(
+                f"exchange stage: depth={stage.depth()} "
+                f"(exchanged, unapplied), pending_verbs="
+                f"{stage.pending_verbs()}, "
+                f"mid_exchange={bool(stage.busy_since)}, "
+                f"dead={stage.dead!r}")
         for attr, label in (("_get_clocks", "get clocks"),
                             ("_add_clocks", "add clocks")):
             clock = getattr(srv, attr, None)

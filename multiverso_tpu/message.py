@@ -19,6 +19,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+import numpy as np
+
 from multiverso_tpu.utils.waiter import Waiter
 
 
@@ -53,6 +55,21 @@ def to_worker(t: MsgType) -> bool:
 
 def to_controller(t: MsgType) -> bool:
     return int(t) > 32
+
+
+def copy_result(result):
+    """Fresh buffers for a result served to more than one owner — a
+    deduped Get's extra repliers (sync/server.py) or a worker-side
+    cache hit (tables/base.py): callers own and may mutate their
+    result arrays, so every extra serving gets copies. Non-array
+    leaves are shared."""
+    if isinstance(result, np.ndarray):
+        return result.copy()
+    if isinstance(result, tuple):
+        return tuple(copy_result(r) for r in result)
+    if isinstance(result, list):
+        return [copy_result(r) for r in result]
+    return result
 
 
 _msg_id_counter = itertools.count(1)

@@ -50,21 +50,18 @@ class Communicator:
 
     def request_parameter(self, input_rows: np.ndarray,
                           output_rows: np.ndarray) -> Tuple[TrainState, dict]:
-        """Fetch the block's rows; returns (device state, fetched host copy)."""
-        ie = self.input_table.GetRows(input_rows)
-        eo = self.output_table.GetRows(output_rows)
-        fetched = {"ie": ie, "eo": eo}
-        ie_g2 = eo_g2 = None
-        if self.opt.use_adagrad:
-            ie_g2 = self.ie_g2_table.GetRows(input_rows)
-            eo_g2 = self.eo_g2_table.GetRows(output_rows)
-            fetched["ie_g2"] = ie_g2
-            fetched["eo_g2"] = eo_g2
-        state = TrainState(
-            ie=jnp.asarray(ie), eo=jnp.asarray(eo),
-            ie_g2=None if ie_g2 is None else jnp.asarray(ie_g2),
-            eo_g2=None if eo_g2 is None else jnp.asarray(eo_g2))
-        return state, fetched
+        """Fetch the block's rows; returns (device state, fetched host copy).
+
+        Issues every table's Get asynchronously BEFORE waiting any
+        (round 7): the engine drains the burst into one window — one
+        host exchange serves all four tables in a 2-proc world instead
+        of four blocking round trips, and under the pipelined engine
+        the previous block's delta pushes apply while this exchange is
+        on the wire. The reference's sequential blocking fetch
+        (communicator.cpp:117-155) was the WE app's 2-proc
+        anti-scaling hot spot (BENCH_r05)."""
+        return self.wait_parameter(
+            self.request_parameter_async(input_rows, output_rows))
 
     def request_parameter_async(self, input_rows: np.ndarray,
                                 output_rows: np.ndarray) -> dict:

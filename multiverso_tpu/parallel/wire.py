@@ -139,6 +139,21 @@ def payload_nbytes(payload: dict) -> int:
     return total
 
 
+def payload_has_deferred(payload: dict) -> bool:
+    """True when any value of a decoded verb payload is a DeferredArray
+    placeholder — its bytes ride the DEVICE wire, so applying the verb
+    is a collective device program. The pipelined engine's overlap gate
+    (sync/server.py _mh_overlap_ok) fences such windows: a device
+    collective on the apply thread must never run concurrently with the
+    exchange thread's host allgather (rank-divergent interleavings
+    deadlock the world). Deferral only ever replaces a payload's
+    top-level ``values`` entry, but checking every value is as cheap."""
+    for v in payload.values():
+        if isinstance(v, DeferredArray):
+            return True
+    return False
+
+
 def dtype_wire_safe(dt) -> bool:
     """True when ``dt`` survives the flat wire: its ``.str`` tag decodes
     back to the SAME dtype. Extension dtypes (e.g. ml_dtypes.bfloat16,

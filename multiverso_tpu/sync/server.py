@@ -23,8 +23,9 @@ Selection by the ``sync`` flag mirrors ``Server::GetServer``
 from __future__ import annotations
 
 import collections
+import threading
 import time as _time
-from typing import Deque, Dict, List
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -34,27 +35,18 @@ from multiverso_tpu.failsafe import deadline as fdeadline
 from multiverso_tpu.failsafe.dedup import DedupWindow
 from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
                                             TransientError, WireCorruption)
-from multiverso_tpu.message import Message, MsgType
+from multiverso_tpu.message import Message, MsgType, copy_result
 from multiverso_tpu.parallel import wire
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
-                                            MV_DEFINE_int, MV_DEFINE_string)
+                                            MV_DEFINE_int, MV_DEFINE_string,
+                                            cached_bool_flag)
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK, Log
+from multiverso_tpu.utils.mt_queue import MtQueue
 
-
-def _copy_result(result):
-    """Fresh buffers for a deduped Get's extra repliers (callers own and
-    may mutate their result arrays). Non-array results are shared."""
-    if isinstance(result, np.ndarray):
-        return result.copy()
-    if isinstance(result, tuple):
-        return tuple(_copy_result(r) for r in result)
-    if isinstance(result, list):
-        return [_copy_result(r) for r in result]
-    return result
 
 MV_DEFINE_bool("sync", False, "sync or async")
 # Declared-but-dead in the reference (server.cpp:21); kept for flag parity.
@@ -82,8 +74,62 @@ MV_DEFINE_int("window_device_min_bytes", 6 << 20,
               "auto transport: defer Add values >= this many bytes to "
               "the device wire (default just above this host's measured "
               "crossover)")
+# Round 7 — PIPELINED window engine. The serial engine ran drain ->
+# encode -> exchange -> apply strictly in sequence on the actor thread,
+# parking every worker behind the whole chain. With the pipeline a
+# dedicated EXCHANGE thread owns the host-wire collective stream
+# (encode + capped_exchange + decode, strictly in SEQ order — the
+# collective sequence every rank issues is unchanged) while the engine
+# actor stays the APPLY stage: window N applies while window N+1
+# exchanges, but ONLY when window N's apply is host-local on every rank
+# (no device-wire positions and every touched table's
+# mh_apply_is_local() — both decided from EXCHANGED bytes, so all ranks
+# gate identically and an apply-side device collective can never race
+# the exchange thread's allgather into a rank-divergent order).
+# -mv_pipeline=false restores the serial engine exactly.
+MV_DEFINE_bool("mv_pipeline", True,
+               "pipelined windowed engine: overlap window N's apply "
+               "with window N+1's host exchange (false = serial engine)")
+_pipeline_flag = cached_bool_flag("mv_pipeline", True)
+# Worker-side fast paths (tables/base.py reads these through listener
+# caches; they are DEFINED here so zoo's eager `import
+# multiverso_tpu.sync.server` registers them before MV_Init's
+# ParseCMDFlags — a flag defined in a lazily-imported module would
+# silently drop its first-call CLI setting).
+MV_DEFINE_int("mv_write_combine", 8,
+              "worker-side write combining: coalesce up to N "
+              "consecutive fire-and-forget Adds to one table into ONE "
+              "request before the mailbox hop (0 = off, byte-identical "
+              "message stream). A COUNT cap, deliberately not bytes: "
+              "fire-and-forget call sequences are program-structural "
+              "and therefore lockstep across SPMD ranks, while payload "
+              "bytes can skew per rank — a byte cap would flush ranks "
+              "at different call positions and diverge the multi-"
+              "process verb streams.")
+MV_DEFINE_int("mv_get_staleness", 0,
+              "worker-side Get cache: serve a repeated identical Get "
+              "from the last fetched result while the engine has "
+              "applied at most N windows since the fill and this "
+              "worker process wrote nothing to the table (SSP-style "
+              "bounded staleness; 0 = off, every Get exact). "
+              "Single-process worlds only — a cache hit removes a verb "
+              "from the stream, which the multi-process SPMD collective "
+              "contract cannot tolerate.")
+
+#: apply-stage poll granularity while an exchange is in flight: the
+#: actor keeps draining the mailbox (feeding the NEXT window) between
+#: polls instead of blocking inside the collective like the serial
+#: engine did. One exchange costs >= the ~1.6ms allgather latency, so
+#: 2ms polls add at most one spin per window.
+_PL_POLL_S = 0.002
 
 _INF = float("inf")
+
+
+class _StageKilled(Exception):
+    """Internal: the apply stage killed the exchange stage after a
+    fatal engine error — exit quietly, the actor already failed every
+    in-pipeline waiter."""
 
 
 class VectorClock:
@@ -134,6 +180,216 @@ class VectorClock:
     def DebugString(self) -> str:
         local = " ".join("-1" if v == _INF else str(int(v)) for v in self._local)
         return f"global {self._global} local: {local}"
+
+
+class _ExchangeStage:
+    """EXCHANGE stage of the pipelined windowed engine (round 7).
+
+    One daemon thread owns the host-wire collective stream: every window
+    exchange and barrier head-marker exchange runs here, strictly in
+    stream order, so the collective sequence each rank issues is
+    identical to the serial engine's however the apply stage is
+    scheduled. Items flow actor -> ``_in`` -> this thread -> ``out`` ->
+    actor:
+
+    * ``("verbs", [msgs])`` — admitted Get/Add messages, appended to the
+      stage's pending deque. The thread packs pending into windows
+      (byte budget + transport deferral), exchanges each, agrees on the
+      cross-rank prefix, and emits ``("window", mine, windows, prefix,
+      descs0, t0)``; verbs beyond the agreed prefix stay pending and
+      lead the next exchange (the serial engine's re-led-window rule).
+    * ``("barrier", msg)`` — a non-verb window head: the thread flushes
+      every pending verb first (stream order), runs the head-marker
+      exchange, and emits ``("barrier", msg)`` for the actor to
+      dispatch in order.
+    * ``("stop", None)`` — thread exit (engine shutdown).
+
+    OVERLAP GATE: after emitting a window whose apply is NOT host-local
+    (any device-wire position, or a table without mh_apply_is_local())
+    — and after every barrier, whose dispatch may itself run
+    collectives — the thread FENCES: no further collective until the
+    actor reports that item applied. The gate decision derives only
+    from exchanged bytes and rank-agreed table state, so every rank
+    fences at the same windows and apply-side device collectives never
+    interleave with exchange-thread allgathers in rank-divergent order.
+
+    Failsafe: the collective itself stays deadline-bounded
+    (fdeadline.bounded inside _mh_exchange_decode); a fence that never
+    lifts (apply stage wedged) raises DeadlineExceeded under
+    -mv_deadline_s. ANY escape parks the stage (``dead``) and emits
+    ``("error", exc)`` — the actor fails every in-pipeline waiter and
+    poisons itself, exactly the serial engine's fatal contract.
+    """
+
+    #: max exchanged-but-not-yet-applied items: bounds how far the
+    #: exchange runs ahead (decoded windows pin their blobs in memory)
+    DEPTH = 2
+
+    def __init__(self, srv: "Server"):
+        self._srv = srv
+        self._in: MtQueue = MtQueue()
+        self.out: MtQueue = MtQueue()
+        self._pending: Deque[Message] = collections.deque()
+        self._emitted = 0
+        self._applied = 0
+        self._fence_at = 0
+        self._cv = threading.Condition()
+        self._killed = False
+        self.dead: Optional[BaseException] = None
+        #: overlap telemetry: wall-clock start of the in-flight exchange
+        #: (0.0 = idle) + total busy seconds; the apply stage intersects
+        #: its intervals against these (see Server._note_overlap)
+        self.busy_since = 0.0
+        self.busy_s = 0.0
+        from multiverso_tpu.parallel import multihost
+        self._my_rank = multihost.process_index()
+        self._thread = threading.Thread(target=self._main,
+                                        name="mv-engine-exchange",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- actor-side API -----------------------------------------------------
+
+    def feed_verbs(self, msgs: List[Message]) -> None:
+        self._in.Push(("verbs", msgs))
+
+    def feed_barrier(self, msg: Message) -> None:
+        self._in.Push(("barrier", msg))
+
+    def note_applied(self) -> None:
+        """The actor finished processing one emitted item — lifts the
+        depth bound and any fence waiting on it."""
+        with self._cv:
+            self._applied += 1
+            self._cv.notify_all()
+
+    def stop(self) -> None:
+        self._in.Push(("stop", None))
+        self._in.Exit()
+
+    def poison(self) -> None:
+        """Apply-stage kill switch after a fatal engine error: a stage
+        left with pending verbs must issue NO further collectives (the
+        stream is desynced) and must not block shutdown on a fence the
+        dead actor will never lift."""
+        self._killed = True
+        with self._cv:
+            self._cv.notify_all()
+        self._in.Exit()
+
+    def depth(self) -> int:
+        """Exchanged-but-unapplied items (diagnostics)."""
+        return self._emitted - self._applied
+
+    def pending_verbs(self) -> int:
+        return len(self._pending)
+
+    # -- stage thread -------------------------------------------------------
+
+    def _wait_applied(self, upto: int, what: str) -> None:
+        timeout = fdeadline.timeout_or_none()
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._applied >= upto or self._killed, timeout)
+        if self._killed:
+            raise _StageKilled()
+        if not ok:
+            fdeadline.raise_deadline(what, fatal=True)
+
+    def _gate(self) -> None:
+        """Before ANY new collective: honour the fence (a non-local
+        apply or barrier dispatch may be running device collectives on
+        the actor thread) and the pipeline depth bound."""
+        self._wait_applied(
+            max(self._fence_at, self._emitted - self.DEPTH + 1),
+            "pipelined engine apply fence (apply stage did not drain)")
+
+    def _main(self) -> None:
+        try:
+            self._loop()
+        except _StageKilled as exc:
+            # actor-side kill: every waiter was already failed there —
+            # park dead WITHOUT emitting an error item
+            self.dead = self.dead or exc
+        except BaseException as exc:  # delivered to the apply stage
+            self.dead = exc
+            self.out.Push(("error", exc))
+
+    def _loop(self) -> None:
+        items: Deque = collections.deque()
+        while not self._killed:
+            # absorb everything already queued (larger windows, and a
+            # barrier behind queued verbs is seen before we block)
+            while True:
+                ok, it = self._in.TryPop()
+                if not ok:
+                    break
+                items.append(it)
+            if not items and not self._pending:
+                ok, it = self._in.Pop()     # idle: block for work
+                if not ok:
+                    return
+                items.append(it)
+                continue
+            # input order is admission order: only LEADING verb items
+            # may join pending ahead of a queued barrier
+            while items and items[0][0] == "verbs":
+                self._pending.extend(items.popleft()[1])
+            if self._pending:
+                self._exchange_one()
+                continue
+            kind, payload = items.popleft()
+            if kind == "stop":
+                return
+            # barrier head: marker exchange at this stream position;
+            # its dispatch (actor side) may run collectives, so fence
+            # until the actor reports it done
+            self._gate()
+            self._srv._mh_check_barrier_head(payload)
+            self._emitted += 1
+            self._fence_at = self._emitted
+            self.out.Push(("barrier", payload))
+
+    def _exchange_one(self) -> None:
+        srv = self._srv
+        self._gate()
+        verbs = list(self._pending)
+        t0 = _time.perf_counter()
+        self.busy_since = t0
+        try:
+            # the "server.window" span opens HERE (parented to the head
+            # verb, exactly like the serial engine) so the nested
+            # exchange span stays its child and the apply stage parents
+            # its apply span to it — one tree per window across both
+            # stage threads
+            with ttrace.span("server.window", cat="server",
+                             parent=verbs[0].trace_ctx,
+                             args={"pending": len(verbs)}) as win_ctx:
+                local, used = srv._mh_pack_window(verbs)
+                windows = srv._mh_exchange_decode(local, self._my_rank)
+        finally:
+            now = _time.perf_counter()
+            self.busy_since = 0.0
+            self.busy_s += now - t0
+            a0 = srv._apply_since
+            if a0:
+                # this exchange ended while an apply was running: the
+                # overlapped stretch is ours to record (the apply-side
+                # intersection only sees exchanges still in flight)
+                srv._note_overlap(max(0.0, now - max(a0, t0)))
+        prefix = min(len(w) for w in windows)
+        descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
+        CHECK(all(d == descs[0] for d in descs),
+              f"multi-process verb streams diverge inside a window: "
+              f"{descs} — every process must issue the same table-verb "
+              f"sequence (the SPMD collective contract)")
+        for _ in range(prefix):
+            self._pending.popleft()
+        self._emitted += 1
+        if not srv._mh_overlap_ok(descs[0], windows, prefix):
+            self._fence_at = self._emitted
+        self.out.Push(("window", used[:prefix], windows, prefix, descs[0],
+                       t0, win_ctx))
 
 
 class Server(Actor):
@@ -200,6 +456,20 @@ class Server(Actor):
         tmetrics.counter("failsafe.deadline_exceeded")
         tmetrics.counter("failsafe.retries")
         tmetrics.counter("wire.crc_failures")
+        # round 7 — pipelined engine + worker-side fast paths:
+        #: windows applied by THIS engine (every topology) — the
+        #: worker-side staleness-bounded Get cache's epoch source
+        #: (tables/base.py; a plain int: GIL-atomic reads from workers)
+        self.window_epoch = 0
+        #: exchange/apply overlap telemetry: percentage of exchange-
+        #: stage busy seconds that ran concurrently with an apply
+        self._t_overlap_pct = tmetrics.gauge("engine.overlap_pct")
+        tmetrics.counter("worker.write_combine_hits")   # eager (see above)
+        tmetrics.counter("worker.get_cache_hits")
+        self._ex_stage: Optional[_ExchangeStage] = None
+        self._apply_since = 0.0   # apply interval start (overlap calc)
+        self._overlap_s = 0.0
+        self._overlap_lock = threading.Lock()
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self._add_entry)
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
@@ -212,10 +482,38 @@ class Server(Actor):
         # kLoadTable parity, native/src/store.cc HandleStoreLoad)
         self.RegisterHandler(MsgType.Request_StoreLoad, self._store_load_entry)
 
+    #: worker-side fast paths gate on the engine's consistency mode:
+    #: the async engine's contract (a Get may observe more progress,
+    #: never less) admits both; the BSP SyncServer counts Get/Add
+    #: MESSAGES into its vector clocks, so combining N Adds into one
+    #: message (or serving a Get without a message) would desync the
+    #: round accounting — SyncServer overrides both to False.
+    GET_CACHE_OK = True
+    WRITE_COMBINE_OK = True
+
     def RegisterTable(self, server_table) -> int:
         table_id = len(self.store_)
         self.store_.append(server_table)
         return table_id
+
+    def Stop(self) -> None:
+        if self._ex_stage is not None:
+            self._ex_stage.stop()
+        super().Stop()
+
+    def _note_overlap(self, s: float) -> None:
+        """Record ``s`` seconds of exchange/apply concurrency (called by
+        whichever stage's interval closed while the other was active)
+        and refresh the engine.overlap_pct gauge."""
+        if s <= 0:
+            return
+        st = self._ex_stage
+        with self._overlap_lock:
+            self._overlap_s += s
+            busy = st.busy_s if st is not None else 0.0
+            if busy > 0:
+                self._t_overlap_pct.set(
+                    min(100.0, 100.0 * self._overlap_s / busy))
 
     #: how many queued messages one Get/Add drains into its window.
     #: Each pipelined Get hides one device->host copy RTT, queued Adds to
@@ -361,6 +659,7 @@ class Server(Actor):
         with ttrace.span("server.window", cat="server",
                          args={"verbs": len(batch)}):
             self._local_window(batch)
+        self.window_epoch += 1     # worker get-cache staleness clock
         self._t_window_s.observe(_time.perf_counter() - _t0)
         # count Add/Get verbs only, like the mh path's prefix count —
         # the counter must mean the same thing in every topology
@@ -449,7 +748,7 @@ class Server(Actor):
             msgs[0].reply(result)
             for m in msgs[1:]:
                 # each deduped caller owns its result arrays
-                m.reply(_copy_result(result))
+                m.reply(copy_result(result))
 
     # -- multi-process WINDOWED protocol (round 5) --------------------------
     # The r4 design took the strict path: every table verb ran its own
@@ -497,6 +796,12 @@ class Server(Actor):
         stay in the local deque and lead the NEXT exchange — the loop
         always drains fully before returning.
 
+        Round 7: with ``-mv_pipeline`` (default) the exchange half runs
+        on the dedicated stage thread and THIS thread becomes the apply
+        stage — window N applies while window N+1 exchanges whenever
+        the overlap gate allows (see _ExchangeStage). The serial path
+        below is byte-identical to the round-5/6 engine.
+
         A DeadlineExceeded from the exchange (peer gone / diverged,
         -mv_deadline_s set) fails EVERY drained message — their waiters
         raise instead of hanging — and then propagates with its fatal
@@ -504,7 +809,10 @@ class Server(Actor):
         this rank's collective stream is unsound."""
         pending: Deque[Message] = collections.deque(batch)
         try:
-            self._mh_windows_inner(pending)
+            if _pipeline_flag():
+                self._mh_pipelined(pending)
+            else:
+                self._mh_windows_inner(pending)
         except Exception as exc:
             # ANY escape aborts the stream mid-window — an abandoned
             # exchange (DeadlineExceeded), an exhausted frame retry or
@@ -513,11 +821,115 @@ class Server(Actor):
             # this rank's collective position unsound: fail every
             # drained waiter (per-position errors never escape; they
             # reply locally), then poison the actor so no further
-            # collectives are issued from a desynced stream
+            # collectives are issued from a desynced stream. The
+            # pipelined path keeps ``pending`` holding every message
+            # currently owned by EITHER stage, so both drain here —
+            # and the stage is killed so it issues no further
+            # collectives from the desynced stream.
+            if self._ex_stage is not None:
+                self._ex_stage.poison()
             for m in pending:
                 m.reply(exc)
             exc.mv_fatal = True
             raise
+
+    # -- round 7: PIPELINED window engine (apply stage) ---------------------
+
+    def _mh_pipelined(self, fed: "Deque[Message]") -> None:
+        """Apply stage + scheduler: feed admitted messages to the
+        exchange stage in admission order, keep draining the mailbox
+        while exchanges are in flight (the NEXT window forms while the
+        current one is still on the wire — this is where the overlap
+        comes from), and apply completed windows strictly in emission
+        (= SEQ) order. ``fed`` always holds every message owned by the
+        pipeline, oldest first — the caller's error path fails exactly
+        those."""
+        stage = self._ex_stage
+        if stage is None or stage.dead is not None:
+            stage = self._ex_stage = _ExchangeStage(self)
+        for m in fed:
+            self._pl_feed(stage, m)
+        deadline = fdeadline.timeout_or_none()
+        stall_s = 0.0
+        while fed:
+            # opportunistic drain: verbs arriving during an exchange
+            # join the stage's pending deque and form the next window
+            # (bounded per spin so applies are never starved)
+            for _ in range(64):
+                ok, m = self.mailbox.TryPop()
+                if not ok:
+                    break
+                self.note_dequeue(m)
+                if self._admit(m):
+                    fed.append(m)
+                    self._pl_feed(stage, m)
+            ok, item = stage.out.TryPop()
+            if not ok:
+                ok, item = stage.out.Pop(timeout=_PL_POLL_S)
+            if not ok:
+                # exchange still in flight (or waiting for peers). The
+                # stage bounds its own collective; this guard catches a
+                # stage that died without emitting (interpreter
+                # teardown) — grace past the stage's own deadline so
+                # its richer error wins the race when both fire.
+                stall_s += _PL_POLL_S
+                if deadline is not None and stall_s > deadline + 1.0:
+                    fdeadline.raise_deadline(
+                        "pipelined window flush (exchange stage stalled)",
+                        fatal=True)
+                continue
+            stall_s = 0.0
+            kind = item[0]
+            if kind == "error":
+                raise item[1]
+            try:
+                if kind == "barrier":
+                    head = item[1]
+                    CHECK(fed.popleft() is head,
+                          "pipeline completion order desync (engine bug)")
+                    self.window_barrier_splits += 1
+                    self._t_splits.inc()
+                    self._dispatch(head)
+                else:
+                    _, mine, windows, prefix, descs0, t0, win_ctx = item
+                    self._pl_apply(mine, windows, prefix, descs0, win_ctx)
+                    for m in mine:
+                        CHECK(fed.popleft() is m,
+                              "pipeline completion order desync "
+                              "(engine bug)")
+                    self._t_window_s.observe(_time.perf_counter() - t0)
+            finally:
+                # ALWAYS lift the stage's fence/depth gate — even when a
+                # fatal apply error is about to poison the actor, the
+                # stage must not hang inside _wait_applied
+                stage.note_applied()
+
+    def _pl_feed(self, stage: _ExchangeStage, m: Message) -> None:
+        if m.msg_type in (MsgType.Request_Add, MsgType.Request_Get):
+            stage.feed_verbs([m])
+        else:
+            stage.feed_barrier(m)
+
+    def _pl_apply(self, verbs, windows, prefix, descs0, win_ctx) -> None:
+        """Apply one exchanged window on the actor thread, recording
+        the apply interval for the overlap telemetry."""
+        t0 = _time.perf_counter()
+        self._apply_since = t0
+        try:
+            with ttrace.span("server.window.apply", cat="server",
+                             parent=win_ctx, args={"verbs": prefix}):
+                self._mh_apply_window(verbs, windows, prefix, descs0)
+        finally:
+            now = _time.perf_counter()
+            self._apply_since = 0.0
+            st = self._ex_stage
+            b0 = st.busy_since if st is not None else 0.0
+            if b0:
+                # an exchange is STILL in flight as this apply ends:
+                # record the stretch both were busy (the stage records
+                # the symmetric case when its exchange ends first)
+                self._note_overlap(max(0.0, now - max(b0, t0)))
+            self.window_epoch += 1
 
     def _mh_windows_inner(self, pending: "Deque[Message]") -> None:
         while pending:
@@ -710,23 +1122,26 @@ class Server(Actor):
                 continue
             self._t_decode_s.observe(_time.perf_counter() - _t0)
             self._mh_seq += 1
+            self.mh_window_exchanges += 1
+            self._t_exchanges.inc()
             return windows
         # retries exhausted: this rank cannot re-enter the exchange
         # again without desyncing from peers — fatal for the actor
         last_exc.mv_fatal = True
         raise last_exc
 
-    def _mh_collective_window_inner(self, verbs) -> int:
-        from multiverso_tpu.parallel import multihost
-        my_rank = multihost.process_index()
+    def _mh_pack_window(self, verbs):
+        """Pack a window from ``verbs`` under the byte budget; returns
+        ``(local, used)`` — the packed (kind, table, payload) records
+        and the messages they came from (always >= 1). The budget
+        counts what rides the HOST wire, so values deferred to the
+        device wire (DeferredArray — dtype/shape header only) cost
+        ~nothing here and a device-transport burst of large Adds still
+        coalesces into one exchange."""
         mode = self._mh_transport()
         min_bytes = int(GetFlag("window_device_min_bytes"))
-        # pack + byte-budget in ONE pass (always >= 1 verb): the budget
-        # counts what rides the HOST wire, so values deferred to the
-        # device wire (DeferredArray — dtype/shape header only) cost
-        # ~nothing here and a device-transport burst of large Adds
-        # still coalesces into one exchange
         local = []
+        used = []
         packed = 0
         for i, m in enumerate(verbs):
             kind = "A" if m.msg_type is MsgType.Request_Add else "G"
@@ -743,37 +1158,78 @@ class Server(Actor):
             if packed + nbytes > self.MH_WINDOW_BYTES and i > 0:
                 # over-budget verb waits for the next exchange — its
                 # bytes stay OUT of this window's budget accounting
-                verbs = verbs[:i]
                 break
             packed += nbytes
             local.append((kind, m.table_id, payload))
+            used.append(m)
         self._t_budget.set(packed)
+        return local, used
+
+    def _mh_overlap_ok(self, descs0, windows, prefix) -> bool:
+        """True when THIS window's apply runs entirely on the host —
+        the pipelined engine's overlap gate. Decided from EXCHANGED
+        data (every rank holds identical windows) plus table state that
+        evolves at lockstep verb positions (tables/base.py
+        mh_apply_is_local contract), so every rank gates identically:
+        overlap never pairs an apply-side device collective on one rank
+        with an exchange-thread allgather on another."""
+        tables_ok: Dict[int, bool] = {}
+        for kind, tid in descs0:
+            ok = tables_ok.get(tid)
+            if ok is None:
+                try:
+                    ok = bool(self.store_[tid].mh_apply_is_local())
+                except Exception:
+                    ok = False   # bad table id: per-position error path
+                tables_ok[tid] = ok
+            if not ok:
+                return False
+        for w in windows:
+            for _, _, payload in w[:prefix]:
+                if wire.payload_has_deferred(payload):
+                    return False   # device-wire values: collective apply
+        return True
+
+    def _mh_collective_window_inner(self, verbs) -> int:
+        from multiverso_tpu.parallel import multihost
+        my_rank = multihost.process_index()
+        local, used = self._mh_pack_window(verbs)
         windows = self._mh_exchange_decode(local, my_rank)
-        self.mh_window_exchanges += 1
-        self._t_exchanges.inc()
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
         CHECK(all(d == descs[0] for d in descs),
               f"multi-process verb streams diverge inside a window: "
               f"{descs} — every process must issue the same table-verb "
               f"sequence (the SPMD collective contract)")
+        self._mh_apply_window(used[:prefix], windows, prefix, descs[0])
+        self.window_epoch += 1
+        return prefix
+
+    def _mh_apply_window(self, verbs, windows, prefix, descs0) -> None:
+        """Apply one exchanged window's agreed prefix: cross-rank
+        coalesced add runs + deduped get groups, replies to this rank's
+        own messages. Shared by the serial engine and the pipelined
+        apply stage — the semantics (ordering, grouping, error routing)
+        are identical in both."""
+        from multiverso_tpu.parallel import multihost
+        my_rank = multihost.process_index()
         self.mh_window_verbs += prefix
         self._t_verbs.inc(prefix)
         # group per table: Add positions, and Get positions split into
         # the before/after segment around the table's one add-run
         add_pos: Dict[int, list] = {}
-        for i, (kind, tid) in enumerate(descs[0]):
+        for i, (kind, tid) in enumerate(descs0):
             if kind == "A":
                 add_pos.setdefault(tid, []).append(i)
         get_groups: Dict[tuple, list] = {}   # (tid, segment) -> positions
-        for i, (kind, tid) in enumerate(descs[0]):
+        for i, (kind, tid) in enumerate(descs0):
             if kind == "G":
                 seg = 0 if (tid not in add_pos or i < add_pos[tid][0]) else 1
                 get_groups.setdefault((tid, seg), []).append(i)
         parts_at = [[w[i][2] for w in windows] for i in range(prefix)]
         applied: set = set()
         served: set = set()
-        for i, (kind, tid) in enumerate(descs[0]):
+        for i, (kind, tid) in enumerate(descs0):
             if kind == "A":
                 if tid in applied:
                     continue
@@ -792,7 +1248,6 @@ class Server(Actor):
                                  args={"table_id": tid}):
                     self._mh_get_group(tid, get_groups[(tid, seg)],
                                        parts_at, verbs, my_rank)
-        return prefix
 
     def _mh_add_run(self, tid: int, positions, parts_at, verbs,
                     my_rank: int) -> None:
@@ -1010,6 +1465,12 @@ class Server(Actor):
 
 class SyncServer(Server):
     """BSP server (reference server.cpp:60-222). See module docstring."""
+
+    #: the vector-clock protocol counts Get/Add MESSAGES per worker:
+    #: worker-side write combining / get caching would break the round
+    #: accounting ("all workers issue the same number of Gets/Adds")
+    GET_CACHE_OK = False
+    WRITE_COMBINE_OK = False
 
     def __init__(self, num_workers: int):
         super().__init__()

@@ -36,7 +36,8 @@ import numpy as np
 
 from multiverso_tpu.failsafe import deadline as fdeadline
 from multiverso_tpu.failsafe.errors import TransientError
-from multiverso_tpu.message import Message, MsgType, next_msg_id
+from multiverso_tpu.message import (Message, MsgType, copy_result,
+                                    next_msg_id)
 from multiverso_tpu.parallel.wire import payload_nbytes
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.telemetry import trace as ttrace
@@ -54,6 +55,16 @@ _RETRY_BACKOFF_BASE_S = 0.02
 #: listener-refreshed cache (Wait runs once per tracked verb — no
 #: GetFlag registry walk on that path); flag defined in failsafe.deadline
 _max_retries_flag = cached_int_flag("mv_max_retries", 3)
+
+#: round 7 worker-side fast paths; flags DEFINED in sync/server.py (the
+#: eagerly-imported flag home) and read here through listener caches
+_write_combine_flag = cached_int_flag("mv_write_combine", 8)
+_get_staleness_flag = cached_int_flag("mv_get_staleness", 0)
+
+#: bound on the staleness-bounded Get cache: distinct request keys kept
+#: per table (repeated training loops reuse a handful of request
+#: shapes; an unbounded key set would pin every result ever fetched)
+_GET_CACHE_ENTRIES = 64
 
 
 @dataclass
@@ -162,6 +173,24 @@ class ServerTable:
         ProcessGetParts then runs)."""
         return None
 
+    def mh_apply_is_local(self) -> bool:
+        """True when EVERY windowed-engine apply/serve path of this
+        table for already-exchanged parts runs entirely on the host —
+        no collective device programs. The pipelined engine (round 7,
+        sync/server.py) overlaps window N's apply with window N+1's
+        host exchange only for all-local windows: an apply-side device
+        collective racing the exchange thread's allgather could
+        interleave in a different order on different ranks and deadlock
+        the world.
+
+        CONTRACT: the answer must be rank-agreed — derive it only from
+        creation-time-agreed configuration and state that evolves at
+        lockstep verb positions (e.g. the replicated host mirrors,
+        created by the first host verb on every rank), never from
+        per-rank racy conditions. False is always safe (the engine then
+        fences the window, exactly the serial schedule)."""
+        return False
+
     # -- DEVICE-wire transport hooks (round 6; sync/server.py adaptive
     # transport). When the engine selects the device wire for an Add
     # (-window_transport, payload-size auto rule), the window exchange
@@ -231,6 +260,30 @@ class WorkerTable:
         #: the SAME msg_id (the server dedup window's retry identity)
         self._inflight: Dict[int, tuple] = {}
         self._tele: Optional[Dict[str, Any]] = None
+        # -- write combining (round 7; -mv_write_combine) -----------------
+        #: buffered fire-and-forget Add payloads awaiting one combined
+        #: mailbox hop, plus their shared option and the worker whose
+        #: run this is (an option/worker change flushes first)
+        self._wc_buf: list = []
+        self._wc_option: Optional[AddOption] = None
+        self._wc_src: int = 0
+        self._wc_ctx = None      # first buffered member's trace context
+        # -- staleness-bounded Get cache (round 7; -mv_get_staleness) -----
+        #: request key -> (engine window_epoch at fill, table write
+        #: epoch at fill, pristine result); insertion-ordered for a
+        #: cheap oldest-entry eviction
+        self._gc_cache: Dict[Any, tuple] = {}
+        #: results parked for cache-served pseudo handles (negative ids)
+        self._gc_results: Dict[int, Any] = {}
+        self._gc_next_hit = -1
+        #: msg_id -> request key for in-flight Gets whose reply should
+        #: (re)fill the cache
+        self._gc_fill: Dict[int, Any] = {}
+        #: bumped by every Add THIS worker process issues to this table
+        #: (tracked, fire-and-forget, or buffered): read-your-writes —
+        #: a cached read never survives the owner's own write
+        self._write_epoch = 0
+        self._gc_enabled: Optional[bool] = None   # fixed per world
 
     def _tele_verbs(self) -> Dict[str, Any]:
         """Per-table per-verb count/byte instruments, fetched lazily —
@@ -257,6 +310,12 @@ class WorkerTable:
         training run) don't leak bookkeeping; server-side failures are still
         logged by the engine. Per-table FIFO ordering at the server mailbox
         guarantees a later tracked Get observes the push."""
+        if track:
+            # a tracked verb is a global ordering point: every table's
+            # combined-write buffer flushes first so the reply implies
+            # at least as much progress as the serial message stream
+            # would have shown (cheap no-op when nothing is buffered)
+            self._zoo.flush_combined_adds()
         msg_id = next_msg_id()
         src = self._zoo.current_worker_id() if worker_id is None else worker_id
         if track:
@@ -314,6 +373,11 @@ class WorkerTable:
         up to ``-mv_max_retries`` times with exponential backoff +
         jitter — safe because retries reuse the msg_id and the server
         dedup window never double-applies an Add."""
+        if msg_id < 0:
+            # staleness-bounded cache hit (GetAsync): the parked copy IS
+            # the result — no waiter, no mailbox round trip
+            with self._lock:
+                return self._gc_results.pop(msg_id)
         with self._lock:
             waiter = self._waiters.get(msg_id)
         CHECK(waiter is not None, f"unknown msg_id {msg_id}")
@@ -334,6 +398,7 @@ class WorkerTable:
                         self._waiters.pop(msg_id, None)
                         self._inflight.pop(msg_id, None)
                         self._results.pop(msg_id, None)
+                        self._gc_fill.pop(msg_id, None)
             with self._lock:
                 result = self._results.pop(msg_id, None)
             if isinstance(result, TransientError) and attempt < max_retries:
@@ -351,8 +416,11 @@ class WorkerTable:
         with self._lock:
             self._waiters.pop(msg_id, None)
             self._inflight.pop(msg_id, None)
+            fill = self._gc_fill.pop(msg_id, None)
         if isinstance(result, Exception):
             raise result
+        if fill is not None:
+            self._gc_store(fill[0], result, fill[1])
         return result
 
     # -- public verbs (concrete tables wrap these with typed signatures) ----
@@ -366,10 +434,26 @@ class WorkerTable:
             tele = self._tele_verbs()
             tele["get_n"].inc()
             tele["get_b"].inc(payload_nbytes(payload))
+            hit, key = self._gc_probe(payload)
+            if hit is not None:
+                return hit
             with ttrace.span("worker.get", cat="worker",
                              args={"table_id": self.table_id}):
-                return self._submit(MsgType.Request_Get, payload,
-                                    worker_id=opt.worker_id)
+                handle = self._submit(MsgType.Request_Get, payload,
+                                      worker_id=opt.worker_id)
+            if key is not None:
+                # miss under an active staleness bound: the reply
+                # (re)fills this request's cache entry (Wait). The fill
+                # epoch is captured NOW — the engine serves the Get at
+                # some window >= this one, so dating the entry from the
+                # submit keeps "at most N windows since the fill"
+                # honest however late the caller Waits (dating it at
+                # Wait time would let a long async gap launder
+                # arbitrarily stale data as fresh).
+                eng = self._zoo.server_engine
+                with self._lock:
+                    self._gc_fill[handle] = (key, eng.window_epoch)
+            return handle
 
     def AddAsync(self, payload: Dict[str, Any],
                  option: Optional[AddOption] = None,
@@ -381,10 +465,166 @@ class WorkerTable:
             tele = self._tele_verbs()
             tele["add_n"].inc()
             tele["add_b"].inc(payload_nbytes(payload))
+            # read-your-writes: any Add this process issues (tracked,
+            # fire-and-forget, or buffered below) invalidates the
+            # table's cached Gets
+            self._write_epoch += 1
             with ttrace.span("worker.add", cat="worker",
                              args={"table_id": self.table_id}):
+                if not track:
+                    if self._wc_try_buffer(payload, opt):
+                        return 0
+                    # non-combinable fire-and-forget push: earlier
+                    # buffered Adds must still precede it (per-table
+                    # FIFO)
+                    self.FlushCombined()
                 return self._submit(MsgType.Request_Add, payload,
                                     worker_id=opt.worker_id, track=track)
+
+    # -- write combining (round 7; -mv_write_combine) -----------------------
+
+    def _combinable_fire_forget(self, payload: Dict[str, Any]) -> bool:
+        """True when ``payload`` (an Add's, option included) may join
+        this table's combined-write buffer. Default False — a table
+        opts in by overriding this plus _combine_fire_forget with a
+        merge whose ONE combined apply is observationally identical to
+        applying the members in order (concatenated row/key batches
+        are; whole-table float sums are only for linear updaters, which
+        the worker half can't see, so those stay out)."""
+        return False
+
+    def _combine_fire_forget(self, payloads: list) -> Dict[str, Any]:
+        """Merge buffered payloads (each accepted by
+        _combinable_fire_forget, sharing one option) into ONE payload.
+        Member order must be preserved wherever order is observable
+        (key first-sight order, duplicate-row pre-combine order)."""
+        raise NotImplementedError
+
+    def _wc_try_buffer(self, payload: Dict[str, Any],
+                       opt: AddOption) -> bool:
+        """Buffer one fire-and-forget Add for combining; False when the
+        payload (or config) wants the normal per-message path. The cap
+        counts MEMBERS, not bytes — call sequences are program-
+        structural and therefore lockstep across SPMD ranks, while
+        payload bytes can skew per rank and would diverge the
+        multi-process verb streams (sync/server.py flag help)."""
+        cap = _write_combine_flag()
+        if cap <= 0 or not self._combinable_fire_forget(payload):
+            return False
+        eng = self._zoo.server_engine
+        if eng is None or not getattr(eng, "WRITE_COMBINE_OK", False):
+            return False    # BSP counts Add MESSAGES into its clocks
+        with self._lock:
+            if self._wc_buf and self._wc_option != opt:
+                self._flush_wc_locked()
+            if self._wc_buf:
+                tmetrics.counter("worker.write_combine_hits").inc()
+            else:
+                # the combined message belongs to the ADDs' trace, not
+                # whichever later verb happens to trigger the flush:
+                # carry the first member's span context
+                self._wc_ctx = ttrace.current_ctx()
+            self._wc_buf.append(payload)
+            self._wc_option = opt
+            self._wc_src = opt.worker_id
+            if len(self._wc_buf) >= cap:
+                self._flush_wc_locked()
+        return True
+
+    def FlushCombined(self) -> None:
+        """Ship this table's combined-write buffer (no-op when empty).
+        Flush points: a tracked verb on ANY table (_submit), a
+        non-combinable push to THIS table, the member-count cap, and
+        the Zoo's barrier/drain/shutdown paths."""
+        with self._lock:
+            self._flush_wc_locked()
+
+    def _flush_wc_locked(self) -> None:
+        if not self._wc_buf:
+            return
+        bufs, opt, src = self._wc_buf, self._wc_option, self._wc_src
+        ctx = getattr(self, "_wc_ctx", None)
+        self._wc_buf, self._wc_option, self._wc_ctx = [], None, None
+        payload = bufs[0] if len(bufs) == 1 else \
+            self._combine_fire_forget(bufs)
+        payload["option"] = opt
+        msg = Message(msg_type=MsgType.Request_Add, table_id=self.table_id,
+                      msg_id=next_msg_id(), src=src, payload=payload)
+        msg.trace_ctx = ctx
+        ttrace.flow_start(msg.trace_ctx)
+        self._zoo.SendToServer(msg)
+
+    # -- staleness-bounded Get cache (round 7; -mv_get_staleness) -----------
+
+    def _gc_ok(self) -> bool:
+        """Cache eligibility, fixed per world: flag aside, the engine
+        must be the async Server (BSP round accounting counts Get
+        messages) and the world SINGLE-process — a cache hit removes a
+        verb from the stream, which the multi-process SPMD collective
+        contract cannot tolerate (rank A hitting while rank B misses
+        would diverge the lockstep verb sequences)."""
+        ok = self._gc_enabled
+        if ok is None:
+            from multiverso_tpu.parallel import multihost
+            eng = self._zoo.server_engine
+            ok = (eng is not None
+                  and getattr(eng, "GET_CACHE_OK", False)
+                  and multihost.process_count() <= 1)
+            self._gc_enabled = ok
+        return ok
+
+    def _gc_key(self, payload: Dict[str, Any]):
+        """Hashable request identity (option included), or None when a
+        part can't be keyed — those Gets never cache."""
+        parts = [self.table_id]
+        for k in sorted(payload):
+            v = payload[k]
+            if isinstance(v, np.ndarray):
+                parts.append((k, v.dtype.str, v.shape, v.tobytes()))
+            elif v is None or isinstance(v, (bool, int, float, str, bytes)):
+                parts.append((k, v))
+            elif isinstance(v, (GetOption, AddOption)):
+                parts.append((k, repr(v)))
+            else:
+                return None
+        return tuple(parts)
+
+    def _gc_probe(self, payload: Dict[str, Any]):
+        """Serve a repeated Get from the cache when within the
+        staleness bound. Returns ``(pseudo_handle, None)`` on a hit
+        (negative id — Wait pops the parked copy), ``(None, key)`` on a
+        cacheable miss (the caller registers the key so the reply
+        refills the entry), or ``(None, None)`` when caching is off /
+        the request can't be keyed."""
+        staleness = _get_staleness_flag()
+        if staleness <= 0 or not self._gc_ok():
+            return None, None
+        key = self._gc_key(payload)
+        if key is None:
+            return None, None
+        eng = self._zoo.server_engine
+        with self._lock:
+            ent = self._gc_cache.get(key)
+            if ent is not None:
+                fill_epoch, fill_wep, result = ent
+                if (fill_wep == self._write_epoch
+                        and eng.window_epoch - fill_epoch <= staleness):
+                    tmetrics.counter("worker.get_cache_hits").inc()
+                    self._gc_next_hit -= 1
+                    hid = self._gc_next_hit
+                    self._gc_results[hid] = copy_result(result)
+                    return hid, None
+                del self._gc_cache[key]   # expired: drop, refill below
+        return None, key
+
+    def _gc_store(self, key, result, fill_epoch: int) -> None:
+        """File one fetched result under its request key, dated at the
+        SUBMIT-time window epoch (GetAsync captured it — see there)."""
+        with self._lock:
+            if len(self._gc_cache) >= _GET_CACHE_ENTRIES:
+                self._gc_cache.pop(next(iter(self._gc_cache)))
+            self._gc_cache[key] = (fill_epoch, self._write_epoch,
+                                   copy_result(result))
 
 
 def CreateTable(option: TableOption):

@@ -564,6 +564,36 @@ class MatrixServerTable(ServerTable):
             self._nat_store = store
         return self._nat_store
 
+    def mh_apply_is_local(self) -> bool:
+        """Pipelined-engine overlap gate (tables/base.py contract): with
+        the replicated native mirror LIVE, every exchanged-parts apply
+        and serve path above runs numpy/C++ on the host — no device
+        collectives, so window N's apply may overlap window N+1's host
+        exchange. Rank-agreed: mirror ELIGIBILITY is creation-time
+        config and mirror CREATION happens at the first host verb's
+        lockstep position on every rank. Before creation (or after a
+        device-path write drops the mirror) the conservative answer is
+        False — the engine fences that window, whose apply then
+        (re)creates the mirror at its lockstep position, and later
+        windows overlap. Deliberately does NOT force creation here:
+        ``_host_store()`` loads ``raw()``, a collective read, which
+        must never run from the exchange thread."""
+        return self._native_host_ok and self._nat_store is not None
+
+    def _read_rows_union(self, union_ids: np.ndarray) -> np.ndarray:
+        """Rows for an already-validated (and, multi-process, already
+        cross-rank-agreed) id vector in ONE read: the native mirror
+        when live, else one padded gather — the merged read that batched
+        window Gets (SparseMatrixTable.ProcessGetWindowParts) slice."""
+        nat = self._host_store()
+        if nat is not None:
+            return nat.get_rows(np.asarray(union_ids, np.int32))
+        padded = _pad_id_batch(jnp.asarray(np.asarray(union_ids, np.int32)),
+                               next_bucket(len(union_ids)))
+        rows = self._gather_rows(self.state["data"], self.state["aux"],
+                                 padded)
+        return np.asarray(self._zoo.mesh_ctx.fetch(rows[: len(union_ids)]))
+
     # -- helpers ------------------------------------------------------------
 
     def _pad_ids(self, ids: np.ndarray) -> np.ndarray:
@@ -1575,6 +1605,36 @@ class MatrixWorkerTable(WorkerTable):
             {"row_ids": ids, "values": np.asarray(deltas, self.dtype)},
             option, track=False)
 
+    # -- write combining (round 7; tables/base.py contract) -----------------
+
+    def _combinable_fire_forget(self, payload) -> bool:
+        """Row-set Adds with a plain dense delta combine: concatenated
+        (ids, deltas) batches apply as ONE Add whose duplicate-row
+        pre-combine (server _combine_duplicates, np.add.at) sums in
+        concatenation = submission order — exactly the engine's own
+        merged-run semantics for a fire-and-forget burst. Whole-table
+        payloads decline (combining would SUM them, sound only for
+        linear updaters the worker half can't see). COMPRESSED TABLES
+        decline entirely — not just compressed payloads: the sparse
+        filter's compress-or-dense decision is data-dependent PER RANK
+        (>50%-zeros rule), so buffering only the dense fallbacks would
+        make the combining decision itself data-dependent and diverge
+        the SPMD verb streams across ranks. ``self._compress`` is
+        creation-time rank-agreed config, so gating on it keeps the
+        stream lockstep."""
+        return (self._compress is None
+                and payload.get("row_ids") is not None
+                and payload.get("compressed") is None
+                and isinstance(payload.get("values"), np.ndarray))
+
+    def _combine_fire_forget(self, payloads) -> dict:
+        ids = np.concatenate([np.asarray(p["row_ids"], np.int32).ravel()
+                              for p in payloads])
+        vals = np.concatenate(
+            [np.asarray(p["values"], self.dtype).reshape(-1, self.num_cols)
+             for p in payloads])
+        return {"row_ids": ids, "values": vals}
+
     def server(self) -> MatrixServerTable:
         """The co-located server half — device-plane access (TPU workers
         share the mesh with the store, so the 'network' is ICI)."""
@@ -1587,11 +1647,16 @@ class MatrixWorkerTable(WorkerTable):
 
         Uses the storage ownership actually in effect (ceil blocks, see
         parallel/mesh.py); matches the reference floor math whenever
-        num_servers divides num_rows."""
+        num_servers divides num_rows. Vectorized (round 7): the old
+        per-row python loop over storage_partition_server cost ~1us/row
+        — a 100k-id batch paid 100ms of interpreter time for pure
+        integer math."""
         if num_servers is None:
             num_servers = self._zoo.num_servers
+        ids = np.asarray(row_ids, np.int64).ravel()
+        block = ceil_block_rows(self.num_rows, num_servers)
+        owners = np.minimum(ids // block, num_servers - 1)
         out: Dict[int, list] = {}
-        for r in np.asarray(row_ids).ravel():
-            s = storage_partition_server(int(r), self.num_rows, num_servers)
-            out.setdefault(s, []).append(int(r))
+        for s in np.unique(owners):
+            out[int(s)] = [int(r) for r in ids[owners == s]]
         return out

@@ -203,16 +203,58 @@ class SparseMatrixServerTable(MatrixServerTable):
                                _parts=decoded)
 
     def ProcessGetWindowParts(self, positions, my_rank: int):
-        """Sparse Gets MUTATE the freshness bits, so a window segment's
-        Gets serve strictly in position order (each from its exchanged
-        parts — still zero host collectives; the data gathers are the
-        replicated-out row programs)."""
-        out = []
+        """Sparse Gets MUTATE the freshness bits, so the protocol
+        transitions still run strictly in position order — but they are
+        pure numpy bit ops, and since no Add applies between a
+        segment's Get positions (the engine's before/after-run
+        grouping), every position reads the SAME row data. Round 7
+        therefore BATCHES the data movement: all positions' stale sets
+        (numpy-segment work, in order) first, then ONE merged row read
+        over their union, sliced per position. The old per-position
+        serve paid one gather dispatch each — on a remote accelerator
+        one dispatch RTT per Get, the '137x below dense' wall in
+        BENCH_r05's sparse_matrix_host_Melem_s."""
+        per_pos: list = []    # this rank's out_ids, or Exception
+        unions: list = []     # per ok position: all ranks' stale union
         for parts in positions:
             try:
-                out.append(self.ProcessGetParts(parts, my_rank))
+                decoded = []
+                for q in parts:
+                    qopt = q.get("option")
+                    qids = q.get("row_ids")
+                    decoded.append(
+                        (qopt.worker_id if qopt is not None else -1,
+                         None if qids is None
+                         else np.asarray(qids, np.int64)))
+                part_outs = []
+                out_ids = None
+                for rank, (wid, part_ids) in enumerate(decoded):
+                    gwid = self._gwid(rank, wid)
+                    po = self._update_get_state(
+                        -1 if gwid is None else gwid, part_ids)
+                    part_outs.append(po)
+                    if rank == my_rank:
+                        out_ids = po
+                per_pos.append(out_ids)
+                unions.append(np.concatenate(part_outs))
             except Exception as exc:
-                out.append(exc)
+                # _update_get_state validates BEFORE touching bits, so a
+                # failed position left no partial transitions behind
+                per_pos.append(exc)
+        if not unions:
+            return per_pos      # every position failed validation
+        # one merged read over the cross-position cross-rank union —
+        # identical on every rank (computed from exchanged parts), so
+        # the non-mirror gather traces one identical program everywhere
+        union = np.unique(np.concatenate(unions)).astype(np.int32)
+        rows_u = self._read_rows_union(union)
+        out: list = []
+        for o in per_pos:
+            if isinstance(o, Exception):
+                out.append(o)
+            else:
+                # fancy indexing copies: each position owns its rows
+                out.append((o, rows_u[np.searchsorted(union, o)]))
         return out
 
 

@@ -152,6 +152,7 @@ class Zoo:
         (with the diagnostic bundle) instead of hanging the drain."""
         if self.server_engine is None:
             return
+        self.flush_combined_adds()
         waiters = []
         for wid in range(self.num_workers):
             w = Waiter(1)
@@ -224,7 +225,25 @@ class Zoo:
 
     def SendToServer(self, msg: Message) -> None:
         CHECK(self.server_engine is not None, "no server engine (ma mode?)")
+        if msg.msg_type not in (MsgType.Request_Get, MsgType.Request_Add):
+            # non-verb messages (StoreLoad, barrier pings, FinishTrain)
+            # are ordering points: a checkpoint snapshot must include
+            # every fire-and-forget Add issued before it, so the
+            # combined-write buffers flush ahead of the message
+            self.flush_combined_adds()
         self.server_engine.Receive(msg)
+
+    def flush_combined_adds(self) -> None:
+        """Ship every table's combined-write buffer (round 7 worker-side
+        write combining, tables/base.py). Called at every global
+        ordering point — tracked verbs, barriers, engine drains,
+        shutdown — so a buffered fire-and-forget Add can never be
+        observed as missing where the serial message stream would have
+        shown it. Cheap when nothing is buffered."""
+        for t in self.worker_tables:
+            flush = getattr(t, "FlushCombined", None)
+            if flush is not None:
+                flush()
 
     # -- collectives --------------------------------------------------------
 
@@ -235,6 +254,7 @@ class Zoo:
         kRequestBarrier parity). No-op when no engine runs (-ma mode)."""
         if self.server_engine is None:
             return
+        self.flush_combined_adds()
         waiter = Waiter(1)
         msg = Message(msg_type=MsgType.Request_Barrier, waiter=waiter)
         self.server_engine.Receive(msg)
@@ -270,6 +290,11 @@ class Zoo:
         never reaches the barrier) raises DeadlineExceeded within the
         deadline instead of hanging in the collective."""
         CHECK(self._barrier is not None, "Zoo not started")
+        if self.server_engine is not None:
+            # combined-write flush BEFORE the rendezvous: after a
+            # barrier every worker's earlier pushes must be in the
+            # engine stream (the serial-message-stream contract)
+            self.flush_combined_adds()
         _t0 = time.perf_counter()
         idx = self._barrier_wait("enter")
         if self._multihost:

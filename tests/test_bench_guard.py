@@ -1,0 +1,73 @@
+"""Bench regression guard (round 7 CI satellite).
+
+Tier-1 check that the LATEST bench artifact (docs/BENCH_FULL_latest.json,
+rewritten by every ``python bench.py`` run) has not regressed more than
+20% against the COMMITTED guard baseline (docs/BENCH_GUARD.json, frozen
+from the last accepted run via ``python bench.py --update-guard``) on
+the two headline protocol metrics:
+
+* ``logreg_train_samples_per_sec`` — the repo's headline number;
+* ``matrix_table_2proc_host_per_proc_Melem_s`` — the windowed-engine
+  scale-out number the round-7 pipeline targets.
+
+Skipped honestly whenever the comparison would be meaningless: no bench
+artifact in the checkout (a test-only environment never ran bench), no
+committed guard yet, or the two runs measured different platforms /
+hosts (a cpu-backend laptop number against a TPU guard says nothing
+about the code).
+"""
+
+import json
+import os
+
+import pytest
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LATEST = os.path.join(_HERE, "docs", "BENCH_FULL_latest.json")
+GUARD = os.path.join(_HERE, "docs", "BENCH_GUARD.json")
+
+#: metric -> worst acceptable fraction of the guard value
+GUARDED = {
+    "logreg_train_samples_per_sec": 0.8,
+    "matrix_table_2proc_host_per_proc_Melem_s": 0.8,
+}
+
+
+def _load(path):
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("metric") in GUARDED and "value" in data:
+        # the headline metric rides the artifact as metric/value
+        data.setdefault(data["metric"], data["value"])
+    return data
+
+
+def test_bench_no_regression_vs_guard():
+    if not os.path.exists(LATEST):
+        pytest.skip("no bench artifact (bench.py never ran here)")
+    if not os.path.exists(GUARD):
+        pytest.skip("no committed guard baseline "
+                    "(python bench.py --update-guard)")
+    latest, guard = _load(LATEST), _load(GUARD)
+    if latest.get("platform") != guard.get("platform"):
+        pytest.skip(f"platform mismatch: latest "
+                    f"{latest.get('platform')!r} vs guard "
+                    f"{guard.get('platform')!r}")
+    if (guard.get("host_cores") is not None
+            and latest.get("host_cores") != guard.get("host_cores")):
+        pytest.skip(f"different host shape: {latest.get('host_cores')} "
+                    f"vs {guard.get('host_cores')} cores")
+    failures = []
+    for metric, floor in GUARDED.items():
+        base = guard.get(metric)
+        cur = latest.get(metric)
+        if not base or cur is None:   # metric absent / zeroed by a
+            continue                  # section error: not a regression
+        if cur < floor * base:
+            failures.append(f"{metric}: {cur} < {floor:.0%} of the "
+                            f"guard's {base}")
+    assert not failures, (
+        "bench regression vs committed guard (docs/BENCH_GUARD.json):\n"
+        + "\n".join(failures)
+        + "\nIf the new number is a deliberate trade, refresh the guard "
+          "with `python bench.py --update-guard` and commit it.")

@@ -90,6 +90,14 @@ for step in range(STEPS):
     ids = np.sort(rng.choice(R, 6, replace=False)).astype(np.int32)
     deltas = rng.standard_normal((6, C)).astype(np.float32)
     mat.AddRows(ids, deltas)          # tracked: chaos can fault + retry
+    # round 7: a fire-and-forget burst per step rides the PIPELINED
+    # engine (worker-combined, exchange/apply overlapped) under the
+    # same chaos schedule — the soak must stay exact through both
+    # stages, not just the blocking path
+    burst = np.sort(rng.choice(R, 4, replace=False)).astype(np.int32)
+    bdeltas = rng.standard_normal((4, C)).astype(np.float32)
+    for j in range(3):
+        mat.AddFireForget(bdeltas + j, row_ids=burst)
 # quiesce chaos before the read-out so no delayed delivery is in flight
 chaos.quiesce()
 mv.MV_SetFlag("chaos_spec", "")
@@ -104,6 +112,10 @@ for r in range(2):
         oids = np.sort(orng.choice(R, 6, replace=False)).astype(np.int32)
         od = orng.standard_normal((6, C)).astype(np.float32)
         np.add.at(oracle, oids, od)
+        ob = np.sort(orng.choice(R, 4, replace=False)).astype(np.int32)
+        obd = orng.standard_normal((4, C)).astype(np.float32)
+        for j in range(3):
+            np.add.at(oracle, ob, obd + j)
 np.testing.assert_allclose(got, oracle, rtol=2e-4, atol=2e-4)
 
 mv.MV_Barrier()
@@ -184,6 +196,84 @@ else:
     mv.MV_ShutDown()
     print(f"child {rank} RESTORE OK", flush=True)
 '''
+
+
+_PIPELINE_DEADLINE_CHILD = _HDR + r'''
+import time
+from multiverso_tpu.failsafe.errors import ActorDied, DeadlineExceeded
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.zoo import Zoo
+
+sentinel = os.path.join(sys.argv[3], "rank0_pipeline_deadline")
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=3"])
+R, C = 32, 4
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+ids = np.arange(8, dtype=np.int32)
+d = np.ones((8, C), np.float32)
+mat.AddRows(ids, d)            # warm lockstep round (both ranks)
+mv.MV_Barrier()
+if rank == 0:
+    # rank 1 has stopped issuing verbs: this burst fills BOTH pipeline
+    # stages (fire-and-forget adds queue into the exchange stage, the
+    # tracked add waits) and the exchange deadline must fail EVERY
+    # drained waiter, then poison the engine.
+    t0 = time.monotonic()
+    for _ in range(4):
+        mat.AddFireForget(d, row_ids=ids)
+    try:
+        mat.AddRows(ids, d)
+        print("child 0 NO-RAISE", flush=True)
+    except (DeadlineExceeded, ActorDied) as e:
+        dt = time.monotonic() - t0
+        assert dt < 12, f"pipeline deadline fired late: {dt}"
+        assert "diagnostic bundle" in str(e), str(e)[:400]
+        # both stages drained + the actor poisoned: the NEXT verb fails
+        # fast and typed instead of feeding a dead pipeline. The waiter
+        # is failed BEFORE the actor loop finishes unwinding into its
+        # poisoned state, so give the poison a moment to land.
+        eng = Zoo.Get().server_engine
+        t1 = time.monotonic()
+        while eng._poison is None and time.monotonic() - t1 < 10:
+            time.sleep(0.05)
+        assert eng._poison is not None, "actor never poisoned"
+        t1 = time.monotonic()
+        try:
+            mat.GetRows(ids)
+            raise AssertionError("poisoned engine served a Get")
+        except ActorDied:
+            pass
+        assert time.monotonic() - t1 < 1, "poisoned engine not fail-fast"
+        stage = eng._ex_stage
+        assert stage is None or stage.dead is not None \
+            or stage.pending_verbs() == 0, "exchange stage left verbs queued"
+        print("child 0 PIPE-DEADLINE OK", flush=True)
+    mv.MV_ShutDown()           # bounded teardown, must not hang
+    with open(sentinel, "w") as f:
+        f.write("done")
+    time.sleep(2.5)            # coordinator outlives rank 1's exit
+else:
+    # the divergence: rank 1 never issues the burst's verbs; it stays
+    # alive (genuinely blocking rank 0's exchange) until rank 0 reports
+    t0 = time.monotonic()
+    while not os.path.exists(sentinel) and time.monotonic() - t0 < 60:
+        time.sleep(0.1)
+    assert os.path.exists(sentinel), "rank 0 never hit its deadline"
+    print("child 1 PIPE-DEADLINE OK", flush=True)
+os._exit(0)
+'''
+
+
+class TestPipelineDeadline:
+    def test_mid_pipeline_deadline_drains_and_poisons(self, tmp_path):
+        """Acceptance (round 7): a DeadlineExceeded raised mid-pipeline
+        (peer stops exchanging) fails every waiter in BOTH stages
+        within the deadline, poisons the engine (next verb raises
+        ActorDied immediately), and MV_ShutDown still completes."""
+        outs = run_two_process(_PIPELINE_DEADLINE_CHILD, tmp_path,
+                               str(tmp_path),
+                               expect="PIPE-DEADLINE OK")
+        assert "NO-RAISE" not in outs[0]
 
 
 class TestDivergedBarrierDeadline:

@@ -1,0 +1,468 @@
+"""Round 7 — pipelined window engine + worker-side fast paths.
+
+Single-process halves first (write combining, the staleness-bounded Get
+cache, the KV merged run / pipelined Get), then 2-process acceptance:
+the pipelined engine's burst workload must converge exactly to the
+serial (-mv_pipeline=false) engine's result, with the overlap telemetry
+registering and the SPMD divergence CHECKs still armed.
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_multihost import run_two_process
+
+
+def _snap(name):
+    from multiverso_tpu.telemetry import metrics as tmetrics
+    return tmetrics.snapshot().get(name, {}).get("value", 0)
+
+
+class TestWriteCombining:
+    def test_burst_combines_and_tracked_get_flushes(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_write_combine=8"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            d = np.ones((8, 4), np.float32)
+            h0 = _snap("worker.write_combine_hits")
+            for _ in range(5):
+                table.AddFireForget(d, row_ids=ids)
+            # the burst sits (combined) in the worker buffer; the
+            # tracked Get is a global ordering point — it must flush
+            # first and therefore observe every push
+            got = table.GetRows(ids)
+            np.testing.assert_allclose(got, 5.0)
+            assert _snap("worker.write_combine_hits") - h0 == 4
+        finally:
+            mv.MV_ShutDown()
+
+    def test_count_cap_flushes(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+
+        mv.MV_Init(["-mv_write_combine=3"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            d = np.ones((4, 4), np.float32)
+            for _ in range(3):       # hits the member cap exactly
+                table.AddFireForget(d, row_ids=ids)
+            assert not table._wc_buf          # cap flushed the run
+            Zoo.Get().DrainServer()
+            got = table.GetRows(ids)
+            np.testing.assert_allclose(got, 3.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_option_change_flushes_between_runs(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.updaters.base import AddOption
+
+        mv.MV_Init(["-mv_write_combine=16"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            d = np.ones((4, 4), np.float32)
+            table.AddFireForget(d, row_ids=ids, option=AddOption(worker_id=0))
+            # a different option cannot share the combined message
+            table.AddFireForget(d, row_ids=ids,
+                                option=AddOption(worker_id=0, momentum=0.5))
+            got = table.GetRows(ids)     # flush + read
+            np.testing.assert_allclose(got, 2.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_off_is_message_identical(self):
+        """-mv_write_combine=0: every fire-and-forget Add is its own
+        message (nothing ever buffered)."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_write_combine=0"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            h0 = _snap("worker.write_combine_hits")
+            for _ in range(4):
+                table.AddFireForget(np.ones((4, 4), np.float32),
+                                    row_ids=ids)
+                assert not table._wc_buf
+            got = table.GetRows(ids)
+            np.testing.assert_allclose(got, 4.0)
+            assert _snap("worker.write_combine_hits") == h0
+        finally:
+            mv.MV_ShutDown()
+
+    def test_kv_combines_and_drain_flushes(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import KVTableOption
+        from multiverso_tpu.zoo import Zoo
+
+        mv.MV_Init(["-mv_write_combine=8"])
+        try:
+            kv = mv.MV_CreateTable(KVTableOption())
+            keys = np.arange(16, dtype=np.int64)
+            for _ in range(4):
+                kv.AddFireForget(keys, np.ones(16, np.float32))
+            assert kv._wc_buf                  # buffered, not sent yet
+            Zoo.Get().DrainServer()            # drain = flush point
+            assert not kv._wc_buf
+            np.testing.assert_allclose(kv.Get(keys), 4.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_compressed_tables_never_combine(self):
+        """compress="sparse" tables must not buffer ANY fire-and-forget
+        Add: the sparse filter's compress-or-dense choice is
+        data-dependent per rank, so buffering only the dense fallbacks
+        would make the combining decision data-dependent and diverge
+        the multi-process SPMD verb streams."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_write_combine=8"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(
+                num_rows=64, num_cols=8, compress="sparse"))
+            ids = np.arange(8, dtype=np.int32)
+            dense = np.ones((8, 8), np.float32)        # dense fallback
+            sparse = np.zeros((8, 8), np.float32)      # compresses
+            sparse[:, 0] = 1.0
+            table.AddFireForget(dense, row_ids=ids)
+            assert not table._wc_buf, "dense fallback was buffered"
+            table.AddFireForget(sparse, row_ids=ids)
+            assert not table._wc_buf
+            got = table.GetRows(ids)
+            np.testing.assert_allclose(got[:, 0], 2.0)
+            np.testing.assert_allclose(got[:, 1], 1.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_bsp_never_combines(self):
+        """SyncServer counts Add MESSAGES into its vector clocks —
+        combining is disabled under -sync=true."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-sync=true", "-mv_write_combine=8"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                        num_cols=4))
+            ids = np.arange(4, dtype=np.int32)
+            table.AddFireForget(np.ones((4, 4), np.float32), row_ids=ids)
+            assert not table._wc_buf
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestGetCache:
+    def test_hit_within_staleness_and_result_isolated(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_get_staleness=4"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            h0 = _snap("worker.get_cache_hits")
+            a = table.GetRows(ids)            # fill
+            b = table.GetRows(ids)            # hit
+            assert _snap("worker.get_cache_hits") - h0 == 1
+            np.testing.assert_allclose(a, b)
+            # the caller owns its arrays: mutating a hit's result must
+            # not corrupt the cached original
+            b[:] = 99.0
+            c = table.GetRows(ids)
+            np.testing.assert_allclose(c, 1.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_own_write_invalidates(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_get_staleness=100"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            np.testing.assert_allclose(table.GetRows(ids), 1.0)
+            # read-your-writes: even a buffered fire-and-forget push
+            # kills the cached entry
+            table.AddFireForget(np.ones((8, 4), np.float32), row_ids=ids)
+            np.testing.assert_allclose(table.GetRows(ids), 2.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_window_advance_expires(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_get_staleness=1"])
+        try:
+            t1 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                     num_cols=4))
+            t2 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                     num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            t1.AddRows(ids, np.ones((8, 4), np.float32))
+            t1.GetRows(ids)                    # fill at epoch E
+            # OTHER-table writes advance the engine's window epoch past
+            # the staleness bound without touching t1's write epoch
+            for _ in range(3):
+                t2.AddRows(ids, np.ones((8, 4), np.float32))
+            h0 = _snap("worker.get_cache_hits")
+            t1.GetRows(ids)                    # expired -> real Get
+            assert _snap("worker.get_cache_hits") == h0
+        finally:
+            mv.MV_ShutDown()
+
+    def test_staleness_zero_never_caches(self):
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init([])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            h0 = _snap("worker.get_cache_hits")
+            table.GetRows(ids)
+            table.GetRows(ids)
+            assert _snap("worker.get_cache_hits") == h0
+            assert not table._gc_cache         # fills skipped too
+        finally:
+            mv.MV_ShutDown()
+
+    def test_sparse_get_tuple_results_cache(self):
+        """Sparse Gets return (ids, rows) — the copy-on-hit must deep-
+        copy the tuple members."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import SparseMatrixTableOption
+        from multiverso_tpu.updaters.base import AddOption, GetOption
+
+        mv.MV_Init(["-num_workers=2", "-mv_get_staleness=4"])
+        try:
+            table = mv.MV_CreateTable(SparseMatrixTableOption(
+                num_rows=32, num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32),
+                          AddOption(worker_id=0))
+            g1, r1 = table.Get(GetOption(worker_id=1))   # fill
+            h0 = _snap("worker.get_cache_hits")
+            g2, r2 = table.Get(GetOption(worker_id=1))   # hit (bounded
+            # staleness: the dirty-bit transition is skipped — g2 re-
+            # serves the FILL's stale set instead of the row-0 fallback)
+            assert _snap("worker.get_cache_hits") - h0 == 1
+            np.testing.assert_array_equal(g1, g2)
+            np.testing.assert_allclose(r1, r2)
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestKVMergedDispatch:
+    def test_burst_merges_into_one_dispatch(self):
+        """A window of fire-and-forget KV Adds applies as ONE merged
+        scatter-add (KVServerTable.ProcessAddRun reusing the
+        ProcessAddRunParts machinery). Write combining is disabled so
+        the ENGINE machinery is what's exercised."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import KVTableOption
+        from multiverso_tpu.zoo import Zoo
+
+        import time
+
+        from multiverso_tpu.message import Message, MsgType
+
+        mv.MV_Init(["-mv_write_combine=0"])
+        try:
+            kv = mv.MV_CreateTable(KVTableOption())
+            keys = np.arange(64, dtype=np.int64)
+            kv.Add(keys, np.ones(64, np.float32))   # warm (slot create)
+            d0 = _snap("server.add.dispatches")
+            m0 = _snap("server.add.run_merged")
+            # jam the engine so the whole burst queues into ONE window
+            Zoo.Get().SendToServer(Message(
+                msg_type=MsgType.Request_StoreLoad,
+                payload={"fn": lambda: time.sleep(0.3)}))
+            for _ in range(6):
+                kv.AddFireForget(keys, np.ones(64, np.float32))
+            Zoo.Get().DrainServer()
+            used = _snap("server.add.dispatches") - d0
+            merged = _snap("server.add.run_merged") - m0
+            assert used == 1, (used, merged)
+            assert merged == 1, (used, merged)
+            np.testing.assert_allclose(kv.Get(keys), 7.0)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_first_sight_slot_order_with_duplicates(self):
+        """The vectorized slot creation must mint slots in FIRST-SIGHT
+        order with duplicates sharing one slot — the lockstep contract
+        multi-process index replicas rely on."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import KVTableOption
+
+        mv.MV_Init([])
+        try:
+            kv = mv.MV_CreateTable(KVTableOption())
+            srv = kv.server()
+            if srv._nat_index is not None:
+                pytest.skip("native index owns slot assignment")
+            keys = np.array([90, 10, 90, 50, 10, 7], np.int64)
+            slots = srv._slots_for(keys, create=True)
+            # first-sight order: 90 -> 0, 10 -> 1, 50 -> 2, 7 -> 3
+            np.testing.assert_array_equal(slots, [0, 1, 0, 2, 1, 3])
+        finally:
+            mv.MV_ShutDown()
+
+    def test_kv_get_async_window_parity(self):
+        """Pipelined KV Gets (ProcessGetAsync) serve the same values as
+        blocking Gets, absent keys included."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import KVTableOption
+
+        mv.MV_Init([])
+        try:
+            kv = mv.MV_CreateTable(KVTableOption())
+            keys = np.arange(32, dtype=np.int64)
+            kv.Add(keys, np.arange(32, dtype=np.float32))
+            probe = np.array([3, 31, 1000, 7], np.int64)   # 1000 absent
+            handles = [kv.GetAsync({"keys": probe}) for _ in range(4)]
+            for h in handles:
+                got = kv.Wait(h)
+                np.testing.assert_allclose(got, [3.0, 31.0, 0.0, 7.0])
+        finally:
+            mv.MV_ShutDown()
+
+
+_PIPE_PARITY_CHILD = r'''
+import os, sys
+rank, port, pipeline = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+from multiverso_tpu.updaters.base import AddOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", f"-mv_pipeline={pipeline}"])
+R, C, STEPS = 200, 4, 30
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+kv = mv.MV_CreateTable(KVTableOption())
+
+def stream(r):
+    orng = np.random.default_rng(7 + r)
+    for step in range(STEPS):
+        ids = np.sort(orng.choice(R, 8, replace=False)).astype(np.int32)
+        yield ids, orng.standard_normal((8, C)).astype(np.float32)
+
+# bursty mixed workload: fire-and-forget adds (worker-combined), KV
+# pushes, tracked gets — exactly the shape the pipeline overlaps
+for step, (ids, deltas) in enumerate(stream(rank)):
+    mat.AddFireForget(deltas, row_ids=ids)
+    kv.AddFireForget(np.arange(32, dtype=np.int64),
+                     np.ones(32, np.float32))
+    if step % 5 == 4:
+        mat.GetRows(np.arange(10, dtype=np.int32))
+mv.MV_Barrier()
+got = mat.GetRows(np.arange(R, dtype=np.int32))
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    for ids, deltas in stream(r):
+        np.add.at(oracle, ids, deltas)
+np.testing.assert_allclose(got, oracle, rtol=2e-4, atol=2e-4)
+np.testing.assert_allclose(kv.Get(np.arange(32, dtype=np.int64)),
+                           2.0 * STEPS)
+snap = mv.MV_MetricsSnapshot()
+if pipeline == "true":
+    assert "engine.overlap_pct" in snap, sorted(snap)[:40]
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} PARITY-{pipeline} OK", flush=True)
+'''
+
+
+_SPARSE_WINDOW_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.tables import SparseMatrixTableOption
+from multiverso_tpu.updaters.base import AddOption, GetOption
+
+# -num_workers=2 gives the freshness protocol a second worker id; the
+# cross-rank sync points below use host_barrier (process-level) since
+# only ONE worker thread runs here (MV_Barrier would wait for both)
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-num_workers=2"])
+R, C = 64, 4
+sp = mv.MV_CreateTable(SparseMatrixTableOption(num_rows=R, num_cols=C))
+ids = (np.arange(12, dtype=np.int32) + 6 * rank)
+sp.AddRows(ids, np.full((12, C), 1.0 + rank, np.float32),
+           AddOption(worker_id=0))
+multihost.host_barrier()
+# a WINDOW of sparse gets (async burst): the batched
+# ProcessGetWindowParts serves them all from ONE merged read while the
+# freshness protocol still transitions strictly in position order —
+# the SECOND get for the same worker must see the row-0 fallback
+h1 = sp.GetAsync({"row_ids": None}, GetOption(worker_id=1))
+h2 = sp.GetAsync({"row_ids": None}, GetOption(worker_id=1))
+g1, r1 = sp.Wait(h1)
+g2, r2 = sp.Wait(h2)
+union = np.union1d(np.arange(12) + 0, np.arange(12) + 6)
+np.testing.assert_array_equal(np.sort(g1), union)
+# rank 0 pushed 1.0 into [0,12), rank 1 pushed 2.0 into [6,18): the
+# overlap rows hold 3.0 on every rank (lockstep merge)
+expect = np.zeros(R, np.float32)
+expect[0:12] += 1.0
+expect[6:18] += 2.0
+np.testing.assert_allclose(r1[np.argsort(g1)][:, 0], expect[union])
+assert list(g2) == [0], g2      # all fresh -> row-0 fallback
+multihost.host_barrier()
+mv.MV_ShutDown()
+print(f"child {rank} SPARSEWIN OK", flush=True)
+'''
+
+
+class TestPipelinedTwoProc:
+    def test_pipelined_matches_oracle(self, tmp_path):
+        """Acceptance: the pipelined engine's bursty 2-proc workload
+        converges exactly to the add-stream oracle and exports the
+        overlap gauge."""
+        run_two_process(_PIPE_PARITY_CHILD, tmp_path, "true",
+                        expect="PARITY-true OK")
+
+    def test_serial_engine_still_available(self, tmp_path):
+        """-mv_pipeline=false restores the serial engine (same
+        result, no stage thread required)."""
+        run_two_process(_PIPE_PARITY_CHILD, tmp_path, "false",
+                        expect="PARITY-false OK")
+
+    def test_sparse_window_batched_gets(self, tmp_path):
+        """Sparse window Gets serve from one merged read with the
+        dirty-row protocol's position-order semantics intact."""
+        run_two_process(_SPARSE_WINDOW_CHILD, tmp_path,
+                        expect="SPARSEWIN OK")

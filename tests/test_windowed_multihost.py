@@ -400,7 +400,9 @@ from multiverso_tpu.tables import KVTableOption
 from multiverso_tpu.zoo import Zoo
 
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
-            "-dist_size=2"])
+            "-dist_size=2", "-mv_write_combine=0"])  # the ENGINE's merge
+# machinery is under test: worker-side combining would collapse the
+# burst before the window ever sees it
 N = 16
 kv = mv.MV_CreateTable(KVTableOption())
 kv.Add(np.array([7], np.int64), np.array([1.0], np.float32))   # warm
@@ -504,7 +506,10 @@ from multiverso_tpu.zoo import Zoo
 
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2", "-window_transport=auto",
-            "-window_device_min_bytes=1024"])
+            "-window_device_min_bytes=1024", "-mv_write_combine=0"])
+# (combining off: per-POSITION transport selection is under test —
+# worker-side concat would merge small host payloads into big deferred
+# ones before the engine picks a wire)
 R, C, ROUNDS, SMALL = 256, 16, 6, 6
 mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
 mat.AddRows(np.array([0], np.int32), np.zeros((1, C), np.float32))  # warm
@@ -567,7 +572,9 @@ from multiverso_tpu.zoo import Zoo
 
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2", "-window_transport=auto",
-            "-window_device_min_bytes=512"])
+            "-window_device_min_bytes=512", "-mv_write_combine=0"])
+# (combining off: the per-position device-wire deferral + merged
+# device rounds are under test)
 R, C, N = 256, 16, 8
 mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
 arr = mv.MV_CreateTable(ArrayTableOption(size=512))
