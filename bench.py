@@ -881,6 +881,171 @@ def bench_host_scaling(np, rng):
     return out
 
 
+# Serving-plane concurrent-reader harness (round 8): N reader threads
+# hammer (a) the blocking per-Get ENGINE path and (b) the snapshot
+# serving path (MV_ServingLookup), fixed work per reader; QPS is
+# aggregate completed lookups / wall. The serving path must not touch
+# the engine verb stream, so its QPS is what the read tier can sustain
+# WHILE training owns the engine.
+SERV_ROWS = 20_000
+SERV_COLS = 32
+SERV_READERS = 8
+SERV_BATCH = 64
+SERV_BLOCKING_GETS = 40     # per reader on the engine path
+SERV_LOOKUPS = 400          # per reader on the serving path
+
+
+def _serving_reader_run(np, fn, readers: int, n: int):
+    """(aggregate qps, p99 ms) of ``readers`` threads each calling
+    ``fn(ids)`` ``n`` times."""
+    import threading
+
+    lat = [[] for _ in range(readers)]
+
+    def worker(i):
+        r = np.random.default_rng(1000 + i)
+        for _ in range(n):
+            sel = r.integers(0, SERV_ROWS, SERV_BATCH).astype(np.int32)
+            t0 = time.perf_counter()
+            fn(sel)
+            lat[i].append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(readers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    secs = time.perf_counter() - t0
+    all_lat = np.concatenate([np.asarray(l) for l in lat])
+    return readers * n / secs, float(np.percentile(all_lat, 99) * 1e3)
+
+
+def bench_serving(np, rng):
+    """-> dict of serving-plane read metrics (single-process)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import MatrixTableOption
+
+    mv.MV_Init([])
+    try:
+        mat = mv.MV_CreateTable(MatrixTableOption(num_rows=SERV_ROWS,
+                                                  num_cols=SERV_COLS))
+        chunk = 5000
+        for lo in range(0, SERV_ROWS, chunk):
+            ids = np.arange(lo, lo + chunk, dtype=np.int32)
+            mat.AddRows(ids, rng.standard_normal(
+                (chunk, SERV_COLS)).astype(np.float32))
+        v = mv.MV_PublishSnapshot()
+        mv.MV_PinVersion(v)
+        warm = np.arange(SERV_BATCH, dtype=np.int32)
+        mat.GetRows(warm)
+        mv.MV_ServingLookup(mat, warm, version=v)
+        blk_qps, blk_p99 = _serving_reader_run(
+            np, lambda sel: mat.GetRows(sel),
+            SERV_READERS, SERV_BLOCKING_GETS)
+        srv_qps, srv_p99 = _serving_reader_run(
+            np, lambda sel: mv.MV_ServingLookup(mat, sel, version=v),
+            SERV_READERS, SERV_LOOKUPS)
+        return {
+            "serving_lookup_qps": round(srv_qps),
+            "serving_lookup_p99_ms": round(srv_p99, 3),
+            "serving_blocking_get_qps": round(blk_qps),
+            "serving_vs_blocking_get_x": round(srv_qps / blk_qps, 1),
+            "serving_config": (
+                f"{SERV_READERS} concurrent readers x {SERV_BATCH}-row "
+                f"batches over a {SERV_ROWS}x{SERV_COLS} f32 matrix "
+                f"snapshot (pinned version) vs the same readers on the "
+                f"blocking engine GetRows path"),
+        }
+    finally:
+        mv.MV_ShutDown()
+
+
+_NPROC_SERVING_CHILD = r'''
+import json, os, sys, threading, time
+rank, port, nproc = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            f"-dist_size={nproc}"])
+R, C, READERS, BATCH = 20000, 32, 4, 64
+BLK_N, SRV_N = 30, 400     # per reader; FIXED so the collective Get
+                           # verb counts stay lockstep across ranks
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+# collective Adds: same id chunks at the same call position every rank
+for lo in range(0, R, 5000):
+    ids = np.arange(lo, lo + 5000, dtype=np.int32)
+    mat.AddRows(ids, np.random.default_rng(100 + rank)
+                .standard_normal((5000, C)).astype(np.float32))
+mv.MV_Barrier()
+v = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v)
+warm = np.arange(BATCH, dtype=np.int32)
+mat.GetRows(warm)
+mv.MV_ServingLookup(mat, warm, version=v)
+
+def run(fn, n):
+    lat = [[] for _ in range(READERS)]
+    def worker(i):
+        r = np.random.default_rng(1000 + i)
+        for _ in range(n):
+            sel = r.integers(0, R, BATCH).astype(np.int32)
+            t0 = time.perf_counter()
+            fn(sel)
+            lat[i].append(time.perf_counter() - t0)
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(READERS)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    secs = time.perf_counter() - t0
+    all_lat = np.concatenate([np.asarray(l) for l in lat])
+    return READERS * n / secs, float(np.percentile(all_lat, 99) * 1e3)
+
+blk_qps, blk_p99 = run(lambda sel: mat.GetRows(sel), BLK_N)
+mv.MV_Barrier()
+srv_qps, srv_p99 = run(lambda sel: mv.MV_ServingLookup(mat, sel,
+                                                       version=v), SRV_N)
+mv.MV_Barrier()
+agg = multihost.host_allgather_objects((blk_qps, srv_qps))
+mv.MV_Barrier()
+mv.MV_ShutDown()
+if rank == 0:
+    blk_a = sum(a[0] for a in agg)
+    srv_a = sum(a[1] for a in agg)
+    print("NPROC_RESULT " + json.dumps({
+        "lookup_qps_aggregate": round(srv_a),
+        "lookup_p99_ms": round(srv_p99, 3),
+        "blocking_qps_aggregate": round(blk_a),
+        "vs_blocking_x": round(srv_a / blk_a, 1),
+    }), flush=True)
+print(f"child {rank} SERVING BENCH OK", flush=True)
+'''
+
+
+def serving_two_proc_numbers() -> dict:
+    """2-proc serving-plane read metrics (concurrent-reader harness):
+    the blocking baseline pays one window exchange per Get round while
+    the serving path never leaves the process — this is where the
+    acceptance >=5x separation lives."""
+    res = _launch_nproc(_NPROC_SERVING_CHILD, 2)
+    return {
+        "serving_lookup_2proc_qps": res["lookup_qps_aggregate"],
+        "serving_lookup_2proc_p99_ms": res["lookup_p99_ms"],
+        "serving_2proc_blocking_get_qps": res["blocking_qps_aggregate"],
+        "serving_2proc_vs_blocking_get_x": res["vs_blocking_x"],
+    }
+
+
 def main() -> int:
     jax, platform = _init_jax_guarded()
     import numpy as np
@@ -1044,7 +1209,11 @@ def main() -> int:
         out["host_cores"] = os.cpu_count()
         out["host_scaling_note"] = _HOST_SCALING_NOTE
 
+    def fill_serving(d):
+        out.update(d)
+
     section(bench_wordembedding, fill_we)
+    section(bench_serving, fill_serving)
     section(bench_we_app, fill_we_app)
     section(bench_lr_app, fill_lr_app)
     section(bench_lr_app_ftrl, fill_lr_app_ftrl)
@@ -1103,6 +1272,8 @@ _COMPACT_PRIORITY = [
     "metric", "value", "unit", "vs_baseline", "platform",
     "lr_app_samples_per_sec", "lr_app_vs_reference_x",
     "lr_app_cpu_samples_per_sec", "lr_app_ftrl_samples_per_sec",
+    "serving_lookup_qps", "serving_lookup_p99_ms",
+    "serving_lookup_2proc_qps", "serving_2proc_vs_blocking_get_x",
     "we_app_words_per_sec", "we_pairs_per_sec", "we_pairs_pct_bound",
     "kv_device_Melem_s", "kv_device_pct_scalar_bound",
     "matrix_table_host_cpu_Melem_s",
@@ -1705,6 +1876,9 @@ def two_proc_numbers() -> dict:
     # KV fire-and-forget bursts (round 6: merged add-runs on EVERY table
     # family — the dispatches_per_add field shows the cross-position
     # coalescing, the collectives field the amortized exchange cost)
+    # serving plane (round 8): snapshot lookups vs blocking Gets under
+    # concurrent readers — the read tier's scale-out headline
+    out.update(serving_two_proc_numbers())
     res = _launch_nproc(_NPROC_KV_CHILD, 2)
     out["kv_burst_2proc_per_proc_Melem_s"] = res["burst_per_proc_Melem_s"]
     out["kv_burst_2proc_collectives_per_op"] = res[
@@ -1838,7 +2012,9 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
         data = json.load(f)
     keep = ("platform", "host_cores", "logreg_train_samples_per_sec",
             "matrix_table_2proc_host_per_proc_Melem_s",
-            "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec")
+            "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec",
+            "serving_lookup_qps", "serving_lookup_p99_ms",
+            "serving_lookup_2proc_qps", "serving_lookup_2proc_p99_ms")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key
@@ -1850,9 +2026,50 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
     return 0
 
 
+def serving_section_main() -> int:
+    """--serving: run ONLY the serving-plane sections (single-proc +
+    2-proc) and merge the metrics into docs/BENCH_FULL_latest.json when
+    the platform matches — refreshes the serving numbers without the
+    multi-hour full run."""
+    jax, platform = _init_jax_guarded()
+    import numpy as np
+    res = {}
+    res.update(bench_serving(np, np.random.default_rng(0)))
+    res.update(serving_two_proc_numbers())
+    # merge ONLY into an existing, parsable artifact from the same
+    # platform/host: a missing or corrupt artifact must never be
+    # replaced by a serving-only file stamped with this host's identity
+    # (the guard test would then compare a partial artifact against the
+    # committed full-run guard instead of skipping) — the FULL run owns
+    # artifact creation.
+    try:
+        with open(FULL_JSON_PATH) as f:
+            data = json.load(f)
+    except Exception as exc:
+        data = None
+        print(f"NOT merged: no readable full-run artifact at "
+              f"{FULL_JSON_PATH} ({exc!r}) — run `python bench.py` first")
+    if data is not None:
+        if (data.get("platform") == platform
+                and data.get("host_cores") == os.cpu_count()):
+            data.update(res)
+            with open(FULL_JSON_PATH, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"merged serving metrics into {FULL_JSON_PATH}")
+        else:
+            print(f"NOT merged: artifact platform/host "
+                  f"{data.get('platform')}/{data.get('host_cores')} != "
+                  f"{platform}/{os.cpu_count()}")
+    print(json.dumps(res, indent=1, sort_keys=True))
+    return 0
+
+
 if __name__ == "__main__":
     if sys.argv[1:2] == ["--update-guard"]:
         sys.exit(update_guard(*sys.argv[2:3]))
+    if sys.argv[1:2] == ["--serving"]:
+        sys.exit(serving_section_main())
     if sys.argv[1:2] == ["--update-doc"]:
         if len(sys.argv) < 3:
             print("usage: bench.py --update-doc <bench-json>",

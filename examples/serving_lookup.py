@@ -1,0 +1,88 @@
+"""Serving-plane quickstart: publish consistent snapshots while training
+and serve versioned lookups at high QPS off the engine's critical path.
+
+Run (CPU is fine):
+
+    JAX_PLATFORMS=cpu python examples/serving_lookup.py
+
+What it shows:
+
+* ``MV_PublishSnapshot()`` cuts an immutable, versioned,
+  cross-table-consistent snapshot INSIDE the engine stream — every Add
+  issued before the call is in, none after;
+* ``MV_ServingLookup(table, ids, version=...)`` serves reads from the
+  snapshot without touching the engine verb stream, micro-batching
+  concurrent callers into one fused gather per table;
+* ``MV_PinVersion`` holds a version past the ``-mv_serving_keep``
+  retention window (read-your-version: a pinned cut never changes);
+* overload and deadline failures are TYPED (``ServingOverloaded``,
+  ``DeadlineExceeded``) — callers get backpressure, not hangs.
+"""
+
+import threading
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            ServingOverloaded)
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.utils.log import Log
+
+
+def main():
+    mv.MV_Init([])
+    rows, cols = 1024, 16
+    table = mv.MV_CreateTable(MatrixTableOption(num_rows=rows,
+                                                num_cols=cols))
+    rng = np.random.default_rng(0)
+
+    # --- train a little, then cut version 1 -----------------------------
+    ids = np.arange(rows, dtype=np.int32)
+    table.AddRows(ids, rng.standard_normal((rows, cols)).astype(np.float32))
+    v1 = mv.MV_PublishSnapshot()
+    mv.MV_PinVersion(v1)            # hold it for the serving tier
+    baseline = mv.MV_ServingLookup(table, ids, version=v1)
+
+    # --- keep training WHILE readers hammer the pinned version ----------
+    stop = threading.Event()
+    served = [0]
+
+    def reader(seed):
+        r = np.random.default_rng(seed)
+        while not stop.is_set():
+            sel = r.integers(0, rows, 64).astype(np.int32)
+            try:
+                got = mv.MV_ServingLookup(table, sel, version=v1,
+                                          deadline=5.0)
+            except (ServingOverloaded, DeadlineExceeded) as exc:
+                Log.Info("backpressure: %r", exc)
+                continue
+            assert np.array_equal(got, baseline[sel]), \
+                "a pinned version must never change"
+            served[0] += 1
+
+    readers = [threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in readers:
+        t.start()
+    for _ in range(50):             # the training burst
+        sel = rng.integers(0, rows, 32).astype(np.int32)
+        table.AddRows(np.unique(sel).astype(np.int32),
+                      rng.standard_normal(
+                          (len(np.unique(sel)), cols)).astype(np.float32))
+    v2 = mv.MV_PublishSnapshot()    # new traffic can move to v2
+    stop.set()
+    for t in readers:
+        t.join(10)
+
+    fresh = mv.MV_ServingLookup(table, ids, version=v2)
+    Log.Info("served %d pinned-version lookups during training; "
+             "v1 vs v2 max delta = %.3f", served[0],
+             float(np.abs(fresh - baseline).max()))
+    mv.MV_UnpinVersion(v1)
+    mv.MV_ShutDown()
+
+
+if __name__ == "__main__":
+    main()

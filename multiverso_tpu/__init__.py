@@ -42,6 +42,10 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_NetFinalize,
     MV_SaveCheckpoint,
     MV_LoadCheckpoint,
+    MV_PublishSnapshot,
+    MV_ServingLookup,
+    MV_PinVersion,
+    MV_UnpinVersion,
     MV_StartProfiler,
     MV_StopProfiler,
     MV_MetricsSnapshot,

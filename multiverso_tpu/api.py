@@ -144,6 +144,56 @@ def MV_LoadCheckpoint(uri: str) -> int:
     return load_checkpoint(uri)
 
 
+def MV_PublishSnapshot() -> int:
+    """Publish an immutable, versioned, cross-table-consistent snapshot
+    of every live table for the serving plane (multiverso_tpu/serving/);
+    returns the new version number. The cut rides the engine window
+    stream as a barrier, so all Adds admitted before the call are in and
+    none after — COLLECTIVE in a multi-process world (every process
+    calls it at the same verb-stream position, like MV_Barrier; the
+    version numbers then agree on every rank). Retention:
+    ``-mv_serving_keep`` newest versions stay live; pin older ones with
+    :func:`MV_PinVersion`. Not available in ``-ma`` mode (CHECK-fails):
+    model-average worlds run no engine AND can create no tables, so
+    there is nothing to cut."""
+    from multiverso_tpu.serving import publish
+    return publish()
+
+
+def MV_ServingLookup(table, ids=None, version: Optional[int] = None,
+                     deadline: Optional[float] = None) -> np.ndarray:
+    """Serve ``ids`` of ``table`` (a worker-table handle or table id)
+    from the published snapshot ``version`` (None = latest) WITHOUT
+    touching the engine verb stream. ``ids=None`` reads the whole
+    table; KV tables take int64 keys (absent keys read as 0). Thread-
+    safe and micro-batched: concurrent callers of one table coalesce
+    into one fused gather. ``deadline`` (seconds, default
+    ``-mv_deadline_s``) bounds the wait with ``DeadlineExceeded``;
+    admission past ``-mv_serving_max_inflight`` raises a typed
+    ``ServingOverloaded`` instead of queueing unboundedly."""
+    from multiverso_tpu.serving import get_plane
+    table_id = getattr(table, "table_id", table)
+    CHECK(isinstance(table_id, int) and table_id >= 0,
+          f"MV_ServingLookup: bad table {table!r}")
+    return get_plane().frontend.lookup(table_id, ids, version=version,
+                                       deadline=deadline)
+
+
+def MV_PinVersion(version: int) -> int:
+    """Hold snapshot ``version`` live past the ``-mv_serving_keep``
+    retention window (pins nest); returns the version. Release with
+    :func:`MV_UnpinVersion`."""
+    from multiverso_tpu.serving import get_plane
+    return get_plane().store.pin(version)
+
+
+def MV_UnpinVersion(version: int) -> None:
+    """Release one :func:`MV_PinVersion` pin; a fully-unpinned version
+    outside the retention window is evicted immediately."""
+    from multiverso_tpu.serving import get_plane
+    get_plane().store.unpin(version)
+
+
 def MV_WorkerContext(worker_id: int):
     """Bind the calling thread to a worker id for the ``with`` block —
     in-process worker threads stand in for the reference's MPI rank

@@ -219,30 +219,23 @@ class NativeBridge:
 
     def _store_load(self, table, uri: bytes, store: bool) -> int:
         import io as _io
-        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.message import MsgType
         from multiverso_tpu.utils.io import Stream, StreamFactory
-        from multiverso_tpu.utils.waiter import Waiter
         from multiverso_tpu.zoo import Zoo
         entry = self._tables[table]
         name = uri.decode()
 
-        # The snapshot/restore rides the engine mailbox (native
-        # kStoreTable/kLoadTable parity) so it is ordered against every
-        # applied Add — a drain + caller-thread access could race Adds
-        # pushed after the drain. But the URI IO itself (possibly slow
-        # remote storage) stays on THIS thread: only the in-memory
+        # The snapshot/restore rides the engine mailbox through the one
+        # shared cut helper (Zoo.CallOnEngine — native kStoreTable/
+        # kLoadTable parity) so it is ordered against every applied Add;
+        # a drain + caller-thread access could race Adds pushed after
+        # the drain. But the URI IO itself (possibly slow remote
+        # storage) stays on THIS thread: only the in-memory
         # serialize/deserialize occupies the engine.
         def submit(fn):
-            from multiverso_tpu.failsafe import deadline as fdeadline
-            waiter = Waiter(1)
-            msg = Message(msg_type=MsgType.Request_StoreLoad,
-                          payload={"fn": fn}, waiter=waiter)
-            Zoo.Get().SendToServer(msg)
-            if not waiter.Wait(fdeadline.timeout_or_none()):
-                fdeadline.raise_deadline(
-                    f"native store/load of table {table}")
-            if isinstance(msg.result, Exception):
-                raise msg.result
+            Zoo.Get().CallOnEngine(
+                MsgType.Request_StoreLoad, fn,
+                f"native store/load of table {table}")
 
         if store:
             buf = _io.BytesIO()

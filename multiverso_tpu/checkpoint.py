@@ -149,32 +149,83 @@ def _write_all(stream: Stream, tables) -> None:
         _write_table(stream, table_id, table)
 
 
-def save_checkpoint(uri: str, zoo=None) -> int:
-    """Store every registered server table (+ updater aux) to ``uri``.
-    Returns the number of tables written.
-
-    Collective in a multi-process job: every process serializes (the
-    device->host fetches of sharded stores are collective), but only
-    process 0 streams to the file — the reference's rank-0-saves
-    convention (distributed_wordembedding.cpp:263-306) — and a barrier
-    makes the file complete before anyone proceeds. ``uri`` must name
-    shared storage for a later multi-process load."""
+def _serialize_to_uri(uri: str, tables) -> int:
+    """Serialize every table: rank 0 streams to storage, other ranks
+    into a throwaway sink purely to drive their half of the collective
+    fetches (the reference's rank-0-saves convention,
+    distributed_wordembedding.cpp:263-306)."""
     from multiverso_tpu.parallel import multihost
-    from multiverso_tpu.zoo import Zoo
-    zoo = zoo or Zoo.Get()
-    tables = zoo.server_tables
-    _quiesce(zoo)
     if multihost.process_index() == 0:
         # stream straight to storage: O(largest frame) host memory
         with StreamFactory.GetStream(uri, "w") as stream:
             _write_all(stream, tables)
     else:
-        # non-zero ranks serialize into a throwaway sink purely to drive
-        # their half of the collective fetches
         _write_all(Stream(_io.BytesIO(), uri), tables)
-    multihost.host_barrier("mv_checkpoint_save")
-    Log.Info("checkpoint: saved %d tables to %s", len(tables), uri)
     return len(tables)
+
+
+def _serialize_to_bytes(uri: str, tables) -> bytes:
+    """In-memory serialization for the engine-thread cut: the engine
+    must never run the URI IO (possibly slow remote storage) — only the
+    in-memory serialize occupies it, exactly the native bridge's
+    Store/Load rule (binding/native_bridge.py). Rank 0 returns the
+    bytes (the caller streams them out); other ranks return b"" after
+    driving their half of the collective fetches. Costs O(total
+    checkpoint bytes) of host memory on rank 0 — the price of keeping
+    slow storage off the verb stream."""
+    from multiverso_tpu.parallel import multihost
+    buf = _io.BytesIO()
+    _write_all(Stream(buf, uri), tables)
+    return buf.getvalue() if multihost.process_index() == 0 else b""
+
+
+def save_checkpoint(uri: str, zoo=None) -> int:
+    """Store every registered server table (+ updater aux) to ``uri``.
+    Returns the number of tables written.
+
+    CONSISTENT CUT (round 8): the serialization runs ON the engine
+    thread as a window-stream barrier message — the SAME mechanism a
+    serving ``MV_PublishSnapshot`` cuts with (serving/snapshot.py), so
+    the two cut paths cannot drift: a checkpoint taken back-to-back
+    with a publish at one stream position serializes bit-identical
+    values (tests/test_serving.py parity test). This replaces the old
+    bespoke DrainServer+host_barrier quiesce for the save cut: every
+    Add admitted before this message is applied first (engine FIFO /
+    lockstep barrier position), none after, and in a multi-process
+    world the head-marker exchange proves every rank cuts at the same
+    position — so the serialization's collective fetches are matched
+    by construction instead of by a separate quiesce round.
+
+    Collective in a multi-process job: every process calls it at the
+    same verb-stream position; only process 0 streams to the file, and
+    a barrier makes the file complete before anyone proceeds. ``uri``
+    must name shared storage for a later multi-process load."""
+    from multiverso_tpu.message import MsgType
+    from multiverso_tpu.parallel import multihost
+    from multiverso_tpu.zoo import Zoo
+    zoo = zoo or Zoo.Get()
+    tables = zoo.server_tables
+    if zoo.server_engine is None:
+        # -ma mode / no engine: nothing is in flight — serialize on the
+        # caller thread behind a plain alignment barrier
+        multihost.host_barrier("mv_checkpoint_quiesce")
+        n = _serialize_to_uri(uri, tables)
+    else:
+        # the CUT (in-memory serialize, collective fetches included)
+        # runs on the engine thread; the URI IO stays on THIS thread so
+        # slow remote storage never blocks the verb stream behind the
+        # barrier (and never turns -mv_deadline_s into spurious worker
+        # deadline failures during an upload)
+        payload = zoo.CallOnEngine(MsgType.Request_StoreLoad,
+                                   lambda: _serialize_to_bytes(uri, tables),
+                                   "checkpoint save cut")
+        if multihost.process_index() == 0:
+            with StreamFactory.GetStream(uri, "w") as stream:
+                stream.Write(payload)
+        n = len(tables)
+    multihost.host_barrier("mv_checkpoint_save")
+    Log.Info("checkpoint: saved %d tables to %s", n, uri)
+    return n
 
 
 def load_checkpoint(uri: str, zoo=None) -> int:

@@ -31,10 +31,21 @@ Spec grammar (comma-separated)::
     verb.failack:P             engine APPLIES the Add, then fails the
                                ack with TransientError — the retry must
                                hit the dedup window, not re-apply
+    serving.overload:P         serving front-end sheds the lookup at
+                               admission with ServingOverloaded
+                               (rehearses the backpressure path)
+    serving.delay:P[@delay_s]  serving dispatcher stalls a micro-batch
+                               by delay_s before serving it (drives the
+                               per-request deadline path)
 
-Faults target table verbs only (Get/Add): control messages (barrier
-pings, StoreLoad, FinishTrain) stay reliable, matching real transports
-where control planes ride retried RPCs.
+    (serving.* draws come from concurrent reader threads: the outcome
+    sequence per site stays seeded-deterministic, but which caller
+    observes which draw is scheduler-assigned — see serving_admission)
+
+Faults target table verbs only (Get/Add) plus the serving read plane
+(serving.*): control messages (barrier pings, StoreLoad, Publish,
+FinishTrain) stay reliable, matching real transports where control
+planes ride retried RPCs.
 """
 
 from __future__ import annotations
@@ -57,7 +68,8 @@ MV_DEFINE_int("chaos_seed", 0, "fault-schedule seed (chaos_spec)")
 
 _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "wire.bitflip", "wire.truncate",
-          "verb.transient", "verb.failack")
+          "verb.transient", "verb.failack",
+          "serving.overload", "serving.delay")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -132,6 +144,30 @@ class ChaosInjector:
             if self._fire(site) and action is None and tracked:
                 action = site.split(".", 1)[1]
         return action
+
+    def serving_admission(self) -> bool:
+        """Consulted once per serving-lookup admission: True = shed the
+        request with ServingOverloaded. DETERMINISM CAVEAT (weaker than
+        the verb sites'): serving draws come from CONCURRENT reader
+        threads, so while the per-site OUTCOME SEQUENCE is still a pure
+        function of (seed, site, index) — each draw is one atomic
+        ``Random.random()`` under the GIL — WHICH caller observes draw
+        i is scheduler-assigned. Serving faults are rehearsal probes of
+        the typed shed/deadline paths, not lockstep SPMD events; chaos
+        tests must assert aggregates (counters, typed-error handling),
+        never per-caller schedules. The verb/mailbox/wire sites keep
+        their strict reproducibility: they draw from single-threaded
+        admission/exchange paths."""
+        return self._fire("serving.overload")
+
+    def serving_delay(self) -> float:
+        """Consulted once per serving micro-batch: seconds to stall it
+        (0.0 = no fault). Rehearses the per-request deadline path.
+        Same determinism caveat as serving_admission — batches form
+        from scheduler-dependent caller interleaving."""
+        if self._fire("serving.delay"):
+            return self.param("serving.delay")
+        return 0.0
 
     def corrupt_blob(self, blob: bytes) -> Optional[bytes]:
         """Consulted once per outgoing window exchange blob: a

@@ -53,6 +53,17 @@ class TransientError(FailsafeError):
     exponential backoff + jitter up to ``-mv_max_retries``."""
 
 
+class ServingOverloaded(FailsafeError):
+    """The serving plane shed this lookup: the front-end's admission
+    queue already holds ``-mv_serving_max_inflight`` requests (or the
+    ``serving.overload`` chaos site rehearsed the shed path). The
+    request was NOT enqueued — retrying later is safe and is the
+    caller's backpressure signal. Load shedding is deliberate: an
+    unbounded admission queue would convert overload into unbounded
+    tail latency for every caller instead of a typed, immediate error
+    for the marginal one."""
+
+
 class ActorDied(FailsafeError):
     """An actor's loop thread died; its mailbox is poisoned. Raised
     immediately by ``Receive``/pending ``Wait``s instead of enqueueing

@@ -36,6 +36,13 @@ class MsgType(enum.IntEnum):
     # 34 is taken here by Request_Register, so one shared type carries
     # both directions in its payload)
     Request_StoreLoad = 35
+    # serving-plane snapshot publish (serving/snapshot.py): rides the
+    # server mailbox/window stream as a BARRIER, exactly like
+    # Request_StoreLoad — every SPMD rank dispatches it at the same
+    # stream position, which is what makes the published version a
+    # cross-table-consistent cut (no reference equivalent; the value
+    # extends the reference's table-persistence range)
+    Request_Publish = 36
     Reply_Get = -1
     Reply_Add = -2
     Reply_Barrier = -33

@@ -1,0 +1,314 @@
+"""Serving front-end: micro-batched, deadline-bounded, load-shedding
+lookups against published snapshots.
+
+Design (classic PS serving split — Li et al. OSDI'14 separate the
+high-QPS read tier from the update tier for exactly this contention
+reason): lookups NEVER touch the engine verb stream. Concurrent callers
+enqueue into one admission queue; a dedicated dispatcher thread drains
+it each tick, groups requests by (version, table), and serves each group
+from the snapshot with ONE fused union gather — N concurrent callers of
+one table cost one dispatch, not N (the snapshot's ``dispatches``
+counter is the test oracle). Results slice out of the union per caller
+(fresh arrays — callers own what they get).
+
+Failsafe posture, riding the PR 3 machinery:
+
+* **deadline** — ``Lookup(..., deadline=s)`` bounds the wait per
+  request (falling back to ``-mv_deadline_s``); expiry raises
+  ``DeadlineExceeded`` with the diagnostic bundle via
+  ``failsafe.deadline.raise_deadline``.
+* **load shedding** — admission past ``-mv_serving_max_inflight``
+  queued requests raises a typed ``ServingOverloaded`` IMMEDIATELY
+  instead of queueing unboundedly: overload becomes a precise
+  backpressure signal for the marginal caller, not unbounded tail
+  latency for every caller.
+* **chaos** — the ``serving.overload`` site rehearses the shed path at
+  admission and ``serving.delay`` stalls a micro-batch to drive the
+  deadline path (failsafe/chaos.py).
+
+Telemetry: ``serving.lookups`` (the QPS counter), ``serving.shed``,
+``serving.dispatches``, ``serving.batch_size`` + ``serving.latency_s``
+histograms (p50/p99 via the log-bucket ladder), and the
+``serving.snapshot_age_s`` / ``serving.live_versions`` gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.failsafe import chaos
+from multiverso_tpu.failsafe import deadline as fdeadline
+from multiverso_tpu.failsafe.errors import ServingOverloaded
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.configure import (cached_float_flag,
+                                            cached_int_flag)
+from multiverso_tpu.utils.log import Log
+from multiverso_tpu.utils.mt_queue import MtQueue
+from multiverso_tpu.utils.waiter import Waiter
+
+#: flags defined in serving/__init__.py (the eagerly-imported flag home)
+_max_inflight_flag = cached_int_flag("mv_serving_max_inflight", 4096)
+_batch_window_flag = cached_float_flag("mv_serving_batch_window_s", 0.0)
+
+#: dispatcher idle poll: bounded Pop so shutdown never waits on a quiet
+#: queue longer than this (the queue's Exit wakes it immediately anyway)
+_IDLE_POLL_S = 0.2
+
+
+class LookupTicket:
+    """Future for one admitted lookup. ``Wait`` is the only blocking
+    point of the read path and it is deadline-bounded."""
+
+    __slots__ = ("_waiter", "_result", "_done", "enq_t")
+
+    def __init__(self):
+        self._waiter = Waiter(1)
+        self._result: Any = None
+        self._done = False
+        self.enq_t = time.perf_counter()
+
+    def _fill(self, result: Any) -> None:
+        # first fill wins: a per-group error path may sweep tickets the
+        # same serve already filled — re-filling would swap a delivered
+        # result for an exception and over-notify the waiter. Same-
+        # thread idempotence suffices: each queue item is popped (and
+        # therefore filled) by exactly one server.
+        if self._done:
+            return
+        self._done = True
+        self._result = result
+        self._waiter.Notify()
+
+    def Wait(self, deadline: Optional[float] = None) -> np.ndarray:
+        timeout = (float(deadline) if deadline is not None
+                   else fdeadline.timeout_or_none())
+        if not self._waiter.Wait(timeout):
+            fdeadline.raise_deadline("serving lookup", seconds=timeout)
+        if isinstance(self._result, Exception):
+            raise self._result
+        return self._result
+
+
+class ServingFrontend:
+    def __init__(self, store):
+        self._store = store
+        self._q: MtQueue = MtQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        #: inline-combiner gate (sync lookup fast path): whoever holds
+        #: it may drain + serve the queued batch on ITS thread
+        self._combine_lock = threading.Lock()
+        self._stopped = False
+        #: test hook: while set, the dispatcher parks after its blocking
+        #: pop — admissions pile up and then coalesce into ONE batch
+        self._hold_for_tests: Optional[threading.Event] = None
+        self._t_lookups = tmetrics.counter("serving.lookups")
+        self._t_shed = tmetrics.counter("serving.shed")
+        self._t_dispatch = tmetrics.counter("serving.dispatches")
+        self._t_batch = tmetrics.histogram("serving.batch_size")
+        self._t_latency = tmetrics.histogram("serving.latency_s")
+        self._t_age = tmetrics.gauge("serving.snapshot_age_s")
+
+    # -- caller side --------------------------------------------------------
+
+    def lookup_async(self, table_id: int, ids, *,
+                     version: Optional[int] = None) -> LookupTicket:
+        """Admit one lookup; returns its ticket. ``ids=None`` reads the
+        whole table. Raises ``ServingOverloaded`` when the admission
+        queue is full (the request was NOT enqueued) and propagates id
+        validation / missing-version errors immediately."""
+        if self._stopped:
+            raise ServingOverloaded("serving plane is shut down")
+        cz = chaos.get()
+        if cz is not None and cz.serving_admission():
+            self._t_shed.inc()
+            raise ServingOverloaded("chaos: serving admission shed")
+        if self._q.Size() >= max(1, _max_inflight_flag()):
+            self._t_shed.inc()
+            raise ServingOverloaded(
+                f"serving admission queue full "
+                f"({_max_inflight_flag()} in flight) — shed; retry with "
+                f"backpressure or raise -mv_serving_max_inflight")
+        # resolve + validate BEFORE admission: a bad request must fail
+        # its caller only, never the micro-batch it would have joined
+        snap = self._store.get(version)
+        ts = snap.tables.get(table_id)
+        if ts is None:
+            raise KeyError(
+                f"table {table_id} has no serving snapshot in version "
+                f"{snap.version} (family without serving_export?)")
+        if ids is not None:
+            ids = np.asarray(ids).ravel()
+            if not np.issubdtype(ids.dtype, np.integer):
+                # a float id vector would either poison the shared union
+                # gather (host fancy-index rejects it) or silently
+                # truncate (device pad path) — reject at admission
+                raise ValueError(
+                    f"serving lookup ids must be integers, got dtype "
+                    f"{ids.dtype}")
+            ts.validate_ids(ids)
+        ticket = LookupTicket()
+        self._t_lookups.inc()
+        self._q.Push((snap, table_id, ids, ticket))
+        if self._stopped:
+            # lost the race with stop(): its queue drain may have run
+            # before this Push landed — fail the stragglers ourselves
+            # (idempotent fills make the double-drain harmless)
+            self._fail_queued(ServingOverloaded(
+                "serving plane shut down while this lookup was queued"))
+        self._ensure_thread()
+        return ticket
+
+    def lookup(self, table_id: int, ids, *, version: Optional[int] = None,
+               deadline: Optional[float] = None) -> np.ndarray:
+        ticket = self.lookup_async(table_id, ids, version=version)
+        # Inline COMBINER fast path: a synchronous caller that wins the
+        # combine lock drains whatever has queued (its own request
+        # included) and serves the batch on ITS thread — saving the two
+        # thread handoffs the dispatcher hop costs at low concurrency,
+        # while under load most callers lose the lock and their requests
+        # coalesce into the winner's (or the dispatcher's) fused gather.
+        # Every queue item is popped exactly once, so the dispatcher
+        # racing a combiner is safe by construction. ONLY taken when no
+        # deadline applies: serving the batch inline would run the
+        # gather (and any chaos serving.delay stall) on the caller's
+        # thread BEFORE ticket.Wait starts timing, silently unbounding a
+        # request whose contract is "Wait is deadline-bounded" — a
+        # bounded caller therefore always rides the dispatcher, whose
+        # wait the deadline genuinely covers. Also disabled while the
+        # test hold is parked (the hold's whole point is forcing the
+        # queue to pile up).
+        bounded = (deadline is not None
+                   or fdeadline.timeout_or_none() is not None)
+        if (not bounded and self._hold_for_tests is None
+                and self._combine_lock.acquire(blocking=False)):
+            try:
+                batch = []
+                while True:
+                    ok, item = self._q.TryPop()
+                    if not ok:
+                        break
+                    batch.append(item)
+                if batch:
+                    try:
+                        self._serve_batch(batch)
+                    except Exception as exc:    # defensive (see _loop)
+                        Log.Error("serving combiner batch failed: %r",
+                                  exc)
+                        for _, _, _, tk in batch:
+                            tk._fill(exc)
+            finally:
+                self._combine_lock.release()
+        return ticket.Wait(deadline)
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None:
+            return
+        with self._thread_lock:
+            if self._thread is None and not self._stopped:
+                t = threading.Thread(target=self._loop,
+                                     name="mv-serving-frontend",
+                                     daemon=True)
+                self._thread = t
+                t.start()
+
+    def stop(self) -> None:
+        with self._thread_lock:
+            self._stopped = True
+            t = self._thread
+        self._q.Exit()
+        if t is not None:
+            t.join(fdeadline.deadline_s() or 5.0)
+            if t.is_alive():
+                Log.Error("serving front-end dispatcher stuck at "
+                          "shutdown (queue depth %d) — abandoning its "
+                          "daemon thread", self._q.Size())
+        # fail whatever is still queued: a lookup admitted concurrently
+        # with shutdown must raise typed, never block a caller forever
+        # (the default -mv_deadline_s=0 waits unbounded)
+        self._fail_queued(ServingOverloaded(
+            "serving plane shut down while this lookup was queued"))
+
+    def _fail_queued(self, exc: Exception) -> None:
+        while True:
+            ok, item = self._q.TryPop()
+            if not ok:
+                return
+            item[3]._fill(exc)
+
+    def _loop(self) -> None:
+        while True:
+            hold = self._hold_for_tests
+            if hold is not None:
+                # test hook: park BEFORE popping (bounded) until the
+                # test releases — held admissions stay in the queue, so
+                # overload sheds deterministically and concurrent
+                # admissions provably coalesce into ONE batch
+                hold.wait(5.0)
+            ok, first = self._q.Pop(timeout=_IDLE_POLL_S)
+            if not ok:
+                if not self._q.alive:
+                    return
+                continue
+            window = _batch_window_flag()
+            if window > 0:
+                time.sleep(window)   # coalesce concurrent callers
+            batch = [first]
+            while True:
+                ok, nxt = self._q.TryPop()
+                if not ok:
+                    break
+                batch.append(nxt)
+            try:
+                self._serve_batch(batch)
+            except Exception as exc:      # defensive: fail the batch,
+                Log.Error("serving dispatcher batch failed: %r", exc)
+                for _, _, _, ticket in batch:
+                    ticket._fill(exc)
+
+    def _serve_batch(self, batch: List[tuple]) -> None:
+        cz = chaos.get()
+        if cz is not None:
+            delay = cz.serving_delay()
+            if delay > 0:
+                time.sleep(delay)
+        self._t_batch.observe(len(batch))
+        groups: Dict[Tuple[int, int], List[tuple]] = {}
+        for item in batch:
+            snap, table_id, _, _ = item
+            groups.setdefault((snap.version, table_id), []).append(item)
+        for (_, table_id), items in groups.items():
+            snap = items[0][0]
+            ts = snap.tables[table_id]
+            id_items = [it for it in items if it[2] is not None]
+            try:
+                if id_items:
+                    union = np.unique(
+                        np.concatenate([it[2] for it in id_items]))
+                    rows_u = ts.lookup_union(union)   # ONE fused gather
+                    self._t_dispatch.inc()
+                for _, _, ids, ticket in items:
+                    if ids is None:
+                        ticket._fill(ts.full())
+                        self._t_dispatch.inc()   # a full read IS a gather
+                    else:
+                        # fancy indexing copies: each caller owns its rows
+                        ticket._fill(rows_u[np.searchsorted(union, ids)])
+            except Exception as exc:
+                # fills are first-wins, so already-served tickets of the
+                # group keep their results — only unserved ones fail
+                for _, _, _, ticket in items:
+                    ticket._fill(exc)
+        now = time.perf_counter()
+        for _, _, _, ticket in batch:
+            self._t_latency.observe(now - ticket.enq_t)
+        latest = self._store.get(None) if self._store.live_versions() \
+            else None
+        if latest is not None:
+            self._t_age.set(latest.age_s())

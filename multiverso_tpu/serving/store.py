@@ -1,0 +1,142 @@
+"""SnapshotStore — retention, pinning, and read-your-version semantics.
+
+The store owns every published :class:`~multiverso_tpu.serving.snapshot.
+Snapshot` of this process. Versions are small monotonically increasing
+ints allocated at publish time ON the engine thread — in a multi-process
+world every rank publishes at the same window-stream position
+(sync/server.py barrier dispatch), so the per-rank counters march in
+lockstep and "version 3" names the same cut on every rank without any
+version-agreement collective.
+
+Contracts:
+
+* **read-your-version** — ``get(v)`` returns exactly the snapshot
+  published as ``v`` for as long as ``v`` is live (retained or pinned);
+  a snapshot is immutable after install, so two lookups of the same
+  version can never observe different data however much training
+  advances.
+* **retention** — the newest ``-mv_serving_keep`` versions are always
+  live; older UNPINNED versions are evicted at the next install (their
+  arrays drop with the last reference). A pin (``MV_PinVersion``) holds
+  a version live past retention until the matching unpin.
+* **monotonic latest** — ``get(None)`` serves the newest installed
+  version; it never goes backward.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.configure import cached_int_flag
+from multiverso_tpu.utils.log import CHECK, Log
+
+#: flag defined in serving/__init__.py (the eagerly-imported flag home)
+_keep_flag = cached_int_flag("mv_serving_keep", 2)
+
+
+class SnapshotStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: version -> Snapshot, insertion (= version) ordered
+        self._versions: "collections.OrderedDict" = collections.OrderedDict()
+        self._pins: Dict[int, int] = {}
+        self._next_version = 1
+        self._t_live = tmetrics.gauge("serving.live_versions")
+        self._t_published = tmetrics.counter("serving.publishes")
+        self._t_evicted = tmetrics.counter("serving.evictions")
+
+    # -- publish side (engine thread) ---------------------------------------
+
+    def alloc_version(self) -> int:
+        """Next version number. Called only from the publish cut (engine
+        thread, lockstep stream position), so the sequence 1,2,3,... is
+        identical on every SPMD rank."""
+        with self._lock:
+            v = self._next_version
+            self._next_version += 1
+            return v
+
+    def install(self, snap) -> None:
+        """File one published snapshot and apply retention: every
+        version older than the newest ``-mv_serving_keep`` is evicted
+        unless pinned."""
+        keep = max(1, _keep_flag())
+        with self._lock:
+            CHECK(snap.version not in self._versions,
+                  f"snapshot version {snap.version} published twice")
+            self._versions[snap.version] = snap
+            live = list(self._versions)
+            for v in live[:-keep]:
+                if self._pins.get(v, 0) > 0:
+                    continue
+                del self._versions[v]
+                self._t_evicted.inc()
+            self._t_published.inc()
+            self._t_live.set(len(self._versions))
+
+    # -- read side (any thread) ---------------------------------------------
+
+    def get(self, version: Optional[int] = None):
+        """The snapshot for ``version`` (None = latest). Raises KeyError
+        when nothing is published yet or the version was evicted — pin
+        a version (MV_PinVersion) to hold it past retention."""
+        with self._lock:
+            if not self._versions:
+                raise KeyError(
+                    "no snapshot published yet — call MV_PublishSnapshot() "
+                    "before serving lookups")
+            if version is None:
+                return next(reversed(self._versions.values()))
+            snap = self._versions.get(version)
+            if snap is None:
+                raise KeyError(
+                    f"snapshot version {version} is not live (evicted by "
+                    f"retention, or never published) — live: "
+                    f"{list(self._versions)}; pin versions you serve from "
+                    f"(MV_PinVersion) to hold them past -mv_serving_keep")
+            return snap
+
+    def latest_version(self) -> Optional[int]:
+        with self._lock:
+            if not self._versions:
+                return None
+            return next(reversed(self._versions))
+
+    def live_versions(self) -> List[int]:
+        with self._lock:
+            return list(self._versions)
+
+    def pin(self, version: int) -> int:
+        """Hold ``version`` live past retention (counted — pins nest).
+        Returns the version. KeyError when it is not live any more."""
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(
+                    f"cannot pin snapshot version {version}: not live "
+                    f"(live: {list(self._versions)})")
+            self._pins[version] = self._pins.get(version, 0) + 1
+            return version
+
+    def unpin(self, version: int) -> None:
+        """Release one pin; a fully-unpinned version older than the
+        retention window is evicted immediately."""
+        keep = max(1, _keep_flag())
+        with self._lock:
+            n = self._pins.get(version, 0)
+            if n <= 0:
+                Log.Error("unpin of snapshot version %d without a pin — "
+                          "no-op", version)
+                return
+            if n == 1:
+                self._pins.pop(version, None)
+            else:
+                self._pins[version] = n - 1
+            if (self._pins.get(version, 0) == 0
+                    and version in self._versions
+                    and version in list(self._versions)[:-keep]):
+                del self._versions[version]
+                self._t_evicted.inc()
+                self._t_live.set(len(self._versions))

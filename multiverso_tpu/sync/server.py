@@ -481,6 +481,17 @@ class Server(Actor):
         # payload["fn"] cannot race applied Adds (native kStoreTable/
         # kLoadTable parity, native/src/store.cc HandleStoreLoad)
         self.RegisterHandler(MsgType.Request_StoreLoad, self._store_load_entry)
+        # serving-plane snapshot publish (round 8, serving/snapshot.py):
+        # a non-verb message, so the window machinery above makes it a
+        # BARRIER — windows split around it and the multi-process
+        # head-marker exchange proves every rank dispatches it at the
+        # same stream position. payload["fn"] captures every table at
+        # that position: the consistent cut costs nothing beyond the
+        # ordering the engine already enforces. SAME handler as
+        # StoreLoad on purpose: checkpoint saves and publishes are one
+        # cut mechanism (Zoo.CallOnEngine), so they cannot drift.
+        self.RegisterHandler(MsgType.Request_Publish,
+                             self._store_load_entry)
 
     #: worker-side fast paths gate on the engine's consistency mode:
     #: the async engine's contract (a Get may observe more progress,
@@ -1447,10 +1458,13 @@ class Server(Actor):
         msg.reply(None)
 
     def _store_load_entry(self, msg: Message) -> None:
+        """Engine-cut payload runner (StoreLoad AND Publish): run the
+        message's fn at this stream position, reply its result."""
         try:
             msg.reply(msg.payload["fn"]())
         except Exception as exc:
-            Log.Error("table store/load failed: %r", exc)
+            Log.Error("engine-cut payload fn (%s) failed: %r",
+                      msg.msg_type.name, exc)
             msg.reply(exc)
 
     @staticmethod

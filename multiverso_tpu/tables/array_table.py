@@ -340,6 +340,18 @@ class ArrayServer(ServerTable):
         delta = parts_delta.reshape(nproc, self.padded).sum(axis=0)
         return self.device_update(state, delta, opt)
 
+    # -- serving-plane export (tables/base.py contract) ---------------------
+
+    def serving_export(self):
+        """Whole-vector copy-on-publish snapshot. Arrays are the small
+        whole-table family — device residence would buy nothing over
+        one fetch, and ProcessGet already IS the training view (access()
+        applied, replicated read in multi-process worlds, which is a
+        matched collective inside the Publish barrier dispatch)."""
+        from multiverso_tpu.serving import snapshot as ssnap
+        return ssnap.VectorSnapshot(
+            np.asarray(self.ProcessGet(GetOption())))
+
     # -- checkpoint (reference array_table.cpp:145-154) ---------------------
 
     def Store(self, stream) -> None:

@@ -232,6 +232,22 @@ class ServerTable:
         accept/decline decision from the exchanged metadata."""
         return False
 
+    # -- serving-plane export (round 8; multiverso_tpu/serving/). Runs
+    # ON the engine thread inside a Publish barrier dispatch — ordered
+    # against every applied Add, at a lockstep window-stream position in
+    # multi-process worlds (collectives issued inside are matched, like
+    # Request_StoreLoad's fn). CONTRACT: the returned TableSnapshot must
+    # be IMMUTABLE and self-contained — it outlives arbitrary later
+    # training, so it must not alias buffers a later donated update can
+    # invalidate — and its values must equal what a training Get at this
+    # stream position would return (apply the updater's access()
+    # transform). None = this family opts out of serving.
+
+    def serving_export(self):
+        """A serving.snapshot.TableSnapshot of this table's state at the
+        current stream position, or None (family not servable)."""
+        return None
+
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:
         raise NotImplementedError

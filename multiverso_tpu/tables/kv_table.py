@@ -647,6 +647,29 @@ class KVServerTable(ServerTable):
             return len(self._nat_index)
         return len(self._index)
 
+    # -- serving-plane export (tables/base.py contract) ---------------------
+
+    def serving_export(self):
+        """Key-addressed copy-on-publish snapshot: (keys, values) pairs
+        captured exactly like Store()'s checkpoint cut — fancy indexing
+        of the host snapshot copies, so the result aliases nothing the
+        live table later mutates. Absent keys keep reading as 0 (the
+        live Get contract)."""
+        from multiverso_tpu.serving import snapshot as ssnap
+        if self._nat_index is not None:
+            keys, slots = self._nat_index.items()
+            slots = slots.astype(np.int64)
+        else:
+            keys = np.fromiter(self._index.keys(), np.int64,
+                               len(self._index))
+            slots = np.fromiter(self._index.values(), np.int64,
+                                len(self._index))
+        if len(keys):
+            vals = self._host_snapshot()[slots]
+        else:
+            vals = np.empty(0, self.dtype)
+        return ssnap.KVSnapshot(keys, vals)
+
     # -- checkpoint (improvement over reference kv_table.h:106-112) ---------
 
     def Store(self, stream) -> None:

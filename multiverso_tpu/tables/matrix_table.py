@@ -1467,6 +1467,46 @@ class MatrixServerTable(ServerTable):
         """Logical-view snapshot (host numpy)."""
         return self._from_storage(self._zoo.mesh_ctx.fetch(self.state["data"]))
 
+    # -- serving-plane export (tables/base.py contract) ---------------------
+
+    def serving_export(self):
+        """Immutable row snapshot for the serving plane. Residence per
+        ``-mv_serving_residence``:
+
+        * mirror live -> copy-on-publish of the native host store (one
+          memcpy; the mirror exists only for linear aux-free updaters,
+          whose access() is identity, so the copy IS the training view);
+        * device (single-process, aux-free) -> ONE on-device jnp.copy of
+          the padded storage — a bare reference would dangle after the
+          next donated update (donate_argnums) — served through the
+          table's own jit'd row gather (ops.rows/pallas_rows), so only
+          requested rows ever cross to the host;
+        * otherwise -> the logical host materialization ``_full_logical``
+          (applies access(); in multi-process worlds its replicated read
+          is a matched collective because the Publish dispatch runs at a
+          lockstep stream position — and host residence is MANDATORY
+          there, since serving threads must never issue device
+          collectives that could interleave with engine ones)."""
+        from multiverso_tpu.serving import snapshot as ssnap
+        mode = ssnap.residence_mode()
+        nat = self._host_store()
+        if nat is not None and mode != "device":
+            # get_all() fills a FRESH buffer — it IS the copy-on-publish
+            return ssnap.MatrixSnapshot.host(nat.get_all())
+        device_legal = (multihost.process_count() <= 1
+                        and not jax.tree.leaves(self.state["aux"]))
+        want_device = mode == "device" or (
+            mode == "auto" and jax.default_backend() != "cpu")
+        if want_device and device_legal:
+            def _pad(ids):
+                return _pad_id_batch(
+                    jnp.asarray(np.asarray(ids, np.int32)),
+                    next_bucket(len(ids)))
+            return ssnap.MatrixSnapshot.device(
+                jnp.copy(self.state["data"]), self.state["aux"],
+                self._gather_rows, _pad, self.num_rows, self.num_cols)
+        return ssnap.MatrixSnapshot.host(self._full_logical())
+
     # -- aux (updater state) <-> logical layout, for the checkpoint driver --
 
     def aux_to_logical(self, leaf) -> np.ndarray:

@@ -258,6 +258,17 @@ class SparseMatrixServerTable(MatrixServerTable):
         return out
 
 
+    def serving_export(self):
+        """Row snapshot via the parent hook. Serving reads are
+        VERSION-addressed, not freshness-addressed: they bypass the
+        ``up_to_date`` protocol entirely (the bits answer "what changed
+        since worker w's last training Get", a training-side delta
+        question; a serving caller asks "rows R at version V") and
+        therefore never mutate the bits — a read plane must not perturb
+        the training plane's state."""
+        return super().serving_export()
+
+
 class SparseMatrixWorkerTable(MatrixWorkerTable):
     """Worker half: Get returns (row_ids, rows) since the server picks the
     rows (reference sparse ProcessReplyGet fills only returned rows)."""

@@ -160,10 +160,19 @@ class Dashboard:
 
     @classmethod
     def DisplayAll(cls) -> str:
-        """Print the cross-host aggregate (Display's job-wide sibling)."""
+        """Print the cross-host aggregate (Display's job-wide sibling),
+        plus this process's serving-plane stats (lookup count/shed,
+        latency p99, snapshot age, live versions) when the serving
+        front-end has run — serving is per-process state, so its lines
+        are local, not part of the collective monitor reduce."""
         lines = [format_monitor_line(name, rec["count"], rec["elapse_ms"],
                                      " (all hosts)")
                  for name, rec in cls.AggregateAcrossHosts().items()]
+        try:
+            from multiverso_tpu import serving
+            lines += serving.status_lines()
+        except Exception:       # pragma: no cover - serving torn down
+            pass
         out = "\n".join(lines)
         for line in lines:
             Log.Info("%s", line)

@@ -38,6 +38,7 @@ from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # Start()'s ParseCMDFlags runs, or a first-call "-sync=true" would be
 # silently dropped.
 import multiverso_tpu.failsafe  # noqa: F401
+import multiverso_tpu.serving  # noqa: F401
 import multiverso_tpu.sync.server  # noqa: F401
 import multiverso_tpu.telemetry  # noqa: F401
 import multiverso_tpu.updaters.base  # noqa: F401
@@ -140,6 +141,11 @@ class Zoo:
                           "continuing shutdown", exc)
             self.server_engine.Stop()
             self.server_engine = None
+        # serving plane down AFTER the engine (no more publishes can
+        # arrive) — drops every snapshot and stops the dispatcher so a
+        # later MV_Init world starts from a fresh plane
+        from multiverso_tpu.serving import shutdown_plane
+        shutdown_plane()
         self.worker_tables.clear()
         self.server_tables.clear()
         self.started = False
@@ -232,6 +238,27 @@ class Zoo:
             # combined-write buffers flush ahead of the message
             self.flush_combined_adds()
         self.server_engine.Receive(msg)
+
+    def CallOnEngine(self, msg_type: MsgType, fn, what: str):
+        """Run ``fn()`` on the engine thread at the current stream
+        position — the ONE consistent-cut mechanism (round 8): the
+        engine treats any non-verb message as a window barrier, so every
+        Add admitted before this call is applied first and none after,
+        at a lockstep position in multi-process worlds. Checkpoint
+        saves (Request_StoreLoad) and serving publishes (Request_Publish)
+        both ride this helper, so their cut semantics cannot drift.
+        Bounded by ``-mv_deadline_s`` when set; engine-side failures
+        re-raise here."""
+        CHECK(self.server_engine is not None,
+              f"{what} needs a server engine (not -ma mode)")
+        waiter = Waiter(1)
+        msg = Message(msg_type=msg_type, payload={"fn": fn}, waiter=waiter)
+        self.SendToServer(msg)   # flushes combined-write buffers first
+        if not waiter.Wait(fdeadline.timeout_or_none()):
+            fdeadline.raise_deadline(what)
+        if isinstance(msg.result, Exception):
+            raise msg.result
+        return msg.result
 
     def flush_combined_adds(self) -> None:
         """Ship every table's combined-write buffer (round 7 worker-side

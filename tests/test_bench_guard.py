@@ -8,7 +8,11 @@ the two headline protocol metrics:
 
 * ``logreg_train_samples_per_sec`` — the repo's headline number;
 * ``matrix_table_2proc_host_per_proc_Melem_s`` — the windowed-engine
-  scale-out number the round-7 pipeline targets.
+  scale-out number the round-7 pipeline targets;
+* ``serving_lookup_qps`` / ``serving_lookup_2proc_qps`` — the round-8
+  serving read plane's concurrent-reader throughput (and its p99
+  latency ceilings, guarded in the other direction: latency regresses
+  UP).
 
 Skipped honestly whenever the comparison would be meaningless: no bench
 artifact in the checkout (a test-only environment never ran bench), no
@@ -26,10 +30,24 @@ _HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LATEST = os.path.join(_HERE, "docs", "BENCH_FULL_latest.json")
 GUARD = os.path.join(_HERE, "docs", "BENCH_GUARD.json")
 
-#: metric -> worst acceptable fraction of the guard value
+#: metric -> worst acceptable fraction of the guard value (throughput:
+#: lower is a regression)
 GUARDED = {
     "logreg_train_samples_per_sec": 0.8,
     "matrix_table_2proc_host_per_proc_Melem_s": 0.8,
+    # concurrent-reader serving QPS swings ~±10% run to run on a busy
+    # host (GIL-bound reader threads), so the floor sits lower than the
+    # single-threaded metrics'
+    "serving_lookup_qps": 0.6,
+    "serving_lookup_2proc_qps": 0.6,
+}
+
+#: metric -> worst acceptable multiple of the guard value (latency:
+#: HIGHER is a regression; generous x because p99 of a log-bucket-wide
+#: distribution is noisy)
+GUARDED_CEIL = {
+    "serving_lookup_p99_ms": 2.0,
+    "serving_lookup_2proc_p99_ms": 2.0,
 }
 
 
@@ -66,6 +84,14 @@ def test_bench_no_regression_vs_guard():
         if cur < floor * base:
             failures.append(f"{metric}: {cur} < {floor:.0%} of the "
                             f"guard's {base}")
+    for metric, ceil in GUARDED_CEIL.items():
+        base = guard.get(metric)
+        cur = latest.get(metric)
+        if not base or cur is None:
+            continue
+        if cur > ceil * base:
+            failures.append(f"{metric}: {cur} > {ceil}x the guard's "
+                            f"{base} (latency regression)")
     assert not failures, (
         "bench regression vs committed guard (docs/BENCH_GUARD.json):\n"
         + "\n".join(failures)

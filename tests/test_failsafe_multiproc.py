@@ -75,18 +75,23 @@ os._exit(0)
 
 
 _SOAK_CHILD = _HDR + r'''
+import threading, time
 from multiverso_tpu.failsafe import chaos
+from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            ServingOverloaded)
 from multiverso_tpu.tables import MatrixTableOption
 
 SPEC = ("mailbox.drop:0.06,mailbox.dup:0.08,mailbox.delay:0.08@0.002,"
-        "verb.transient:0.06,verb.failack:0.06,wire.bitflip:0.05")
+        "verb.transient:0.06,verb.failack:0.06,wire.bitflip:0.05,"
+        "serving.overload:0.12,serving.delay:0.12@0.003")
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2", "-mv_deadline_s=120", "-mv_max_retries=12",
             f"-chaos_spec={SPEC}", "-chaos_seed=1234"])
-R, C, STEPS = 48, 4, 30
+R, C, STEPS, SERVE_STEPS = 48, 4, 30, 8
 mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
 rng = np.random.default_rng(100 + rank)
-for step in range(STEPS):
+
+def train_step():
     ids = np.sort(rng.choice(R, 6, replace=False)).astype(np.int32)
     deltas = rng.standard_normal((6, C)).astype(np.float32)
     mat.AddRows(ids, deltas)          # tracked: chaos can fault + retry
@@ -98,6 +103,58 @@ for step in range(STEPS):
     bdeltas = rng.standard_normal((4, C)).astype(np.float32)
     for j in range(3):
         mat.AddFireForget(bdeltas + j, row_ids=burst)
+
+for step in range(STEPS):
+    train_step()
+
+# round 8: SERVING-READ PHASE. Publish+pin a version (after a chaos
+# quiesce: a delayed redelivery landing on one rank mid-barrier would
+# genuinely diverge the verb streams — publish is a stream barrier and
+# demands the same call discipline as MV_SaveCheckpoint), then hammer
+# concurrent lookups of the PINNED version while chaos-faulted training
+# continues: every read must be bit-exact vs the first read of that
+# version (immutable — never torn, never cross-version) or raise typed
+# (ServingOverloaded from the shed/chaos site, DeadlineExceeded from
+# serving.delay + the per-request deadline).
+chaos.quiesce()
+v = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v)
+serve_oracle = None
+for _ in range(200):
+    try:
+        serve_oracle = mv.MV_ServingLookup(
+            mat, np.arange(R, dtype=np.int32), version=v, deadline=60)
+        break
+    except (ServingOverloaded, DeadlineExceeded):
+        time.sleep(0.005)
+assert serve_oracle is not None, "pinned-version oracle read never won"
+serve_errors = []
+reads = [0]
+stop = threading.Event()
+def reader(seed):
+    r = np.random.default_rng(seed)
+    while not stop.is_set():
+        sel = np.sort(r.choice(R, 12, replace=False)).astype(np.int32)
+        try:
+            got = mv.MV_ServingLookup(mat, sel, version=v, deadline=60)
+        except (ServingOverloaded, DeadlineExceeded):
+            continue
+        if not np.array_equal(got, serve_oracle[sel]):
+            serve_errors.append(sel)
+            return
+        reads[0] += 1
+readers = [threading.Thread(target=reader, args=(rank * 17 + i,),
+                            daemon=True) for i in range(3)]
+for t in readers:
+    t.start()
+for step in range(SERVE_STEPS):
+    train_step()
+stop.set()
+for t in readers:
+    t.join(60)
+assert not serve_errors, f"torn/cross-version serving read: {serve_errors[0]}"
+assert reads[0] > 0, "no serving read completed under chaos"
+
 # quiesce chaos before the read-out so no delayed delivery is in flight
 chaos.quiesce()
 mv.MV_SetFlag("chaos_spec", "")
@@ -108,7 +165,7 @@ got = mat.GetRows(np.arange(R, dtype=np.int32))
 oracle = np.zeros((R, C), np.float32)
 for r in range(2):
     orng = np.random.default_rng(100 + r)
-    for step in range(STEPS):
+    for step in range(STEPS + SERVE_STEPS):
         oids = np.sort(orng.choice(R, 6, replace=False)).astype(np.int32)
         od = orng.standard_normal((6, C)).astype(np.float32)
         np.add.at(oracle, oids, od)
@@ -125,7 +182,8 @@ def val(name):
 # every chaos kind actually fired somewhere in the job...
 for kind in ("chaos.mailbox.drop", "chaos.mailbox.dup",
              "chaos.mailbox.delay", "chaos.verb.transient",
-             "chaos.verb.failack", "chaos.wire.bitflip"):
+             "chaos.verb.failack", "chaos.wire.bitflip",
+             "chaos.serving.overload", "chaos.serving.delay"):
     assert val(kind) >= 1, (kind, {k: v for k, v in snap.items()
                                    if k.startswith(("chaos", "fail",
                                                     "wire"))})

@@ -1,0 +1,469 @@
+"""Serving plane (round 8, multiverso_tpu/serving/).
+
+* publish cut — every Add admitted before MV_PublishSnapshot is in the
+  version, none after; served values bit-match training Gets (access()
+  applied, every table family);
+* store — retention/eviction under -mv_serving_keep, pin/unpin
+  lifecycle, read-your-version immutability;
+* front-end — micro-batch coalescing (N concurrent callers -> ONE
+  fused gather), typed ServingOverloaded load shedding, per-request
+  DeadlineExceeded, chaos serving.* sites;
+* checkpoint/snapshot cut unification — a checkpoint saved back-to-back
+  with a publish mid-fire-and-forget-burst restores BIT-IDENTICAL
+  values to the published version (the two cuts ride one mechanism and
+  cannot drift);
+* 2-proc acceptance — lookups served concurrently with a training
+  burst return bit-exact pinned-version values, and the lookup path
+  issues ZERO host collectives.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_multihost import run_two_process
+
+
+def _hold_frontend():
+    """Park the dispatcher BEFORE it pops (fresh-world safe: set before
+    the first lookup and the thread parks first thing; otherwise give
+    it one idle poll to reach the hold point)."""
+    from multiverso_tpu.serving import get_plane
+    fe = get_plane().frontend
+    fe._hold_for_tests = threading.Event()
+    if fe._thread is not None:
+        time.sleep(0.35)    # > _IDLE_POLL_S: the loop re-reads the hold
+    return fe
+
+
+def _release_frontend(fe):
+    hold, fe._hold_for_tests = fe._hold_for_tests, None
+    if hold is not None:
+        hold.set()
+
+
+class TestPublishCut:
+    def test_cut_includes_prior_excludes_later_adds(self, mv_env):
+        from multiverso_tpu.tables import MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                      num_cols=4))
+        ids = np.arange(8, dtype=np.int32)
+        mat.AddRows(ids, np.full((8, 4), 2.0, np.float32))
+        # fire-and-forget pushes BEFORE the cut must be in (the publish
+        # message flushes combined-write buffers and rides the FIFO)
+        mat.AddFireForget(np.full((8, 4), 0.5, np.float32), row_ids=ids)
+        v = mv_env.MV_PublishSnapshot()
+        mat.AddRows(ids, np.full((8, 4), 100.0, np.float32))  # after
+        out = mv_env.MV_ServingLookup(mat, ids, version=v)
+        np.testing.assert_array_equal(
+            out, np.full((8, 4), 2.5, np.float32))
+        # untouched rows serve as zeros
+        rest = mv_env.MV_ServingLookup(mat, np.arange(8, 16,
+                                                      dtype=np.int32),
+                                       version=v)
+        np.testing.assert_array_equal(rest, np.zeros((8, 4), np.float32))
+
+    def test_served_values_match_training_get(self, mv_env):
+        """Non-trivial updater (adagrad: aux state, option-dependent):
+        a served row must equal what GetRows returned at the cut."""
+        from multiverso_tpu.tables import MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(
+            num_rows=12, num_cols=4, updater_type="adagrad"))
+        ids = np.arange(6, dtype=np.int32)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            mat.AddRows(ids, rng.standard_normal((6, 4)).astype(np.float32))
+        train_view = mat.GetRows(ids)
+        v = mv_env.MV_PublishSnapshot()
+        out = mv_env.MV_ServingLookup(mat, ids, version=v)
+        np.testing.assert_array_equal(out, train_view)
+
+    def test_all_families_cut_consistently(self, mv_env):
+        """One publish = one cross-table cut: matrix, array and kv all
+        reflect exactly the pre-cut state in one version."""
+        from multiverso_tpu.tables import (ArrayTableOption, KVTableOption,
+                                           MatrixTableOption)
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                      num_cols=2))
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=6))
+        kv = mv_env.MV_CreateTable(KVTableOption())
+        mat.AddRows(np.array([1], np.int32), np.ones((1, 2), np.float32))
+        arr.Add(np.arange(6, dtype=np.float32))
+        kv.Add(np.array([7, 1 << 40], np.int64),
+               np.array([3.0, 4.0], np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        mat.AddRows(np.array([1], np.int32), np.ones((1, 2), np.float32))
+        arr.Add(np.ones(6, np.float32))
+        kv.Add(np.array([7], np.int64), np.array([9.0], np.float32))
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(mat, np.array([1], np.int32),
+                                    version=v),
+            np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(arr, None, version=v),
+            np.arange(6, dtype=np.float32))
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(kv, np.array([1 << 40, 7, 99],
+                                                 np.int64), version=v),
+            np.array([4.0, 3.0, 0.0], np.float32))
+
+    def test_device_residence_survives_donated_updates(self, mv_env):
+        """-mv_serving_residence=device: the snapshot holds ONE on-device
+        copy; later donated engine updates must not invalidate it."""
+        from multiverso_tpu.serving import get_plane
+        from multiverso_tpu.tables import MatrixTableOption
+        mv_env.MV_SetFlag("mv_serving_residence", "device")
+        try:
+            mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                          num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            mat.AddRows(ids, np.full((8, 4), 5.0, np.float32))
+            v = mv_env.MV_PublishSnapshot()
+            snap = get_plane().store.get(v)
+            assert snap.tables[mat.table_id]._dev is not None
+            for _ in range(4):
+                mat.AddRows(ids, np.ones((8, 4), np.float32))  # donates
+            out = mv_env.MV_ServingLookup(mat, ids, version=v)
+            np.testing.assert_array_equal(
+                out, np.full((8, 4), 5.0, np.float32))
+        finally:
+            mv_env.MV_SetFlag("mv_serving_residence", "auto")
+
+    def test_sparse_serving_reads_leave_freshness_bits_alone(self, mv_env):
+        from multiverso_tpu.tables import SparseMatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        t = mv_env.MV_CreateTable(SparseMatrixTableOption(num_rows=8,
+                                                          num_cols=2))
+        srv = Zoo.Get().server_tables[t.table_id]
+        t.AddRows(np.array([2, 3], np.int32), np.ones((2, 2), np.float32))
+        Zoo.Get().DrainServer()
+        v = mv_env.MV_PublishSnapshot()
+        bits_before = srv.up_to_date.copy()
+        out = mv_env.MV_ServingLookup(t, np.array([2, 3], np.int32),
+                                      version=v)
+        np.testing.assert_array_equal(out, np.ones((2, 2), np.float32))
+        np.testing.assert_array_equal(srv.up_to_date, bits_before)
+
+
+class TestSnapshotStore:
+    def test_retention_evicts_unpinned(self, mv_env):
+        from multiverso_tpu.serving import get_plane
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        arr.Add(np.ones(4, np.float32))
+        v1 = mv_env.MV_PublishSnapshot()
+        v2 = mv_env.MV_PublishSnapshot()
+        v3 = mv_env.MV_PublishSnapshot()   # keep=2: v1 evicted
+        store = get_plane().store
+        assert store.live_versions() == [v2, v3]
+        with pytest.raises(KeyError):
+            mv_env.MV_ServingLookup(arr, None, version=v1)
+
+    def test_pin_holds_past_retention_unpin_releases(self, mv_env):
+        from multiverso_tpu.serving import get_plane
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        arr.Add(np.full(4, 7.0, np.float32))
+        v1 = mv_env.MV_PublishSnapshot()
+        mv_env.MV_PinVersion(v1)
+        arr.Add(np.ones(4, np.float32))
+        for _ in range(3):
+            mv_env.MV_PublishSnapshot()
+        store = get_plane().store
+        assert v1 in store.live_versions()
+        # read-your-version: the pinned cut is immutable
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(arr, None, version=v1),
+            np.full(4, 7.0, np.float32))
+        mv_env.MV_UnpinVersion(v1)
+        assert v1 not in store.live_versions()
+
+    def test_lookup_without_publish_is_typed(self, mv_env):
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        with pytest.raises(KeyError):
+            mv_env.MV_ServingLookup(arr, None)
+
+
+class TestFrontend:
+    def test_concurrent_lookups_coalesce_into_one_dispatch(self, mv_env):
+        """N concurrent callers of one (table, version) ride ONE fused
+        gather — the snapshot's dispatch counter is the oracle."""
+        from multiverso_tpu.serving import get_plane
+        from multiverso_tpu.tables import MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                      num_cols=4))
+        all_ids = np.arange(64, dtype=np.int32)
+        mat.AddRows(all_ids,
+                    np.arange(64 * 4, dtype=np.float32).reshape(64, 4))
+        v = mv_env.MV_PublishSnapshot()
+        fe = _hold_frontend()        # park BEFORE the first lookup
+        tickets = []
+        for i in range(8):
+            ids = np.arange(i * 8, i * 8 + 8, dtype=np.int32)
+            tickets.append((ids, fe.lookup_async(mat.table_id, ids,
+                                                 version=v)))
+        _release_frontend(fe)
+        for ids, ticket in tickets:
+            out = ticket.Wait(10.0)
+            np.testing.assert_array_equal(
+                out, np.arange(64 * 4,
+                               dtype=np.float32).reshape(64, 4)[ids])
+        snap = get_plane().store.get(v)
+        assert snap.tables[mat.table_id].dispatches == 1, \
+            "8 concurrent lookups must share ONE fused gather"
+
+    def test_overload_sheds_typed(self, mv_env):
+        from multiverso_tpu.failsafe.errors import ServingOverloaded
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        arr.Add(np.ones(4, np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        mv_env.MV_SetFlag("mv_serving_max_inflight", 2)
+        try:
+            fe = _hold_frontend()
+            t1 = fe.lookup_async(arr.table_id, None, version=v)
+            t2 = fe.lookup_async(arr.table_id, None, version=v)
+            with pytest.raises(ServingOverloaded):
+                fe.lookup_async(arr.table_id, None, version=v)
+            _release_frontend(fe)
+            np.testing.assert_array_equal(t1.Wait(10.0),
+                                          np.ones(4, np.float32))
+            np.testing.assert_array_equal(t2.Wait(10.0),
+                                          np.ones(4, np.float32))
+        finally:
+            mv_env.MV_SetFlag("mv_serving_max_inflight", 4096)
+
+    def test_per_request_deadline_raises_typed(self, mv_env):
+        from multiverso_tpu.failsafe.errors import DeadlineExceeded
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        v = mv_env.MV_PublishSnapshot()
+        fe = _hold_frontend()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                fe.lookup(arr.table_id, None, version=v, deadline=0.2)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            _release_frontend(fe)
+
+    def test_bad_ids_fail_their_caller_only(self, mv_env):
+        from multiverso_tpu.tables import MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                      num_cols=2))
+        mat.AddRows(np.array([0], np.int32), np.ones((1, 2), np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        with pytest.raises(ValueError):
+            mv_env.MV_ServingLookup(mat, np.array([99], np.int32),
+                                    version=v)
+        # the good caller still serves
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(mat, np.array([0], np.int32),
+                                    version=v),
+            np.ones((1, 2), np.float32))
+
+    def test_float_ids_rejected_at_admission(self, mv_env):
+        """Non-integer ids would poison the shared union gather (host)
+        or silently truncate (device pad) — typed rejection at
+        admission, before the request can join a micro-batch."""
+        from multiverso_tpu.tables import MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                      num_cols=2))
+        mat.AddRows(np.array([1], np.int32), np.ones((1, 2), np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        with pytest.raises(ValueError):
+            mv_env.MV_ServingLookup(mat, np.array([1.5]), version=v)
+
+    def test_stop_fails_queued_and_rejects_new_lookups(self, mv_env):
+        """A lookup still queued when the plane shuts down must raise
+        typed (the default -mv_deadline_s=0 would otherwise block its
+        caller forever), and post-stop admissions are shed."""
+        from multiverso_tpu.failsafe.errors import ServingOverloaded
+        from multiverso_tpu.serving import get_plane
+        from multiverso_tpu.serving.frontend import LookupTicket
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        v = mv_env.MV_PublishSnapshot()
+        fe = get_plane().frontend
+        snap = get_plane().store.get(v)
+        ticket = LookupTicket()
+        fe._q.Push((snap, arr.table_id, None, ticket))  # never dispatched
+        fe.stop()
+        with pytest.raises(ServingOverloaded):
+            ticket.Wait(5.0)
+        with pytest.raises(ServingOverloaded):
+            fe.lookup_async(arr.table_id, None, version=v)
+
+    def test_chaos_serving_sites(self, mv_env):
+        from multiverso_tpu.failsafe.errors import ServingOverloaded
+        from multiverso_tpu.tables import ArrayTableOption
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        arr.Add(np.ones(4, np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        mv_env.MV_SetFlag("chaos_spec", "serving.overload:1.0")
+        try:
+            with pytest.raises(ServingOverloaded):
+                mv_env.MV_ServingLookup(arr, None, version=v)
+            from multiverso_tpu.telemetry import metrics
+            assert metrics.counter("chaos.serving.overload").value >= 1
+        finally:
+            mv_env.MV_SetFlag("chaos_spec", "")
+        # healthy again once the injector is disarmed
+        np.testing.assert_array_equal(
+            mv_env.MV_ServingLookup(arr, None, version=v),
+            np.ones(4, np.float32))
+
+    def test_dashboard_displayall_surfaces_serving(self, mv_env):
+        from multiverso_tpu.tables import ArrayTableOption
+        from multiverso_tpu.utils.dashboard import Dashboard
+        arr = mv_env.MV_CreateTable(ArrayTableOption(size=4))
+        v = mv_env.MV_PublishSnapshot()
+        mv_env.MV_ServingLookup(arr, None, version=v)
+        out = Dashboard.DisplayAll()
+        assert "[Serving]" in out and "lookups" in out
+        assert "live_versions" in out
+
+
+class TestCheckpointPublishParity:
+    def test_checkpoint_equals_snapshot_at_same_cut(self, mv_env,
+                                                    tmp_path):
+        """The unification regression: MV_SaveCheckpoint rides the SAME
+        engine-stream barrier cut as MV_PublishSnapshot, so a publish
+        and a save issued back-to-back mid-fire-and-forget-burst (one
+        producer thread -> adjacent stream positions, nothing between)
+        name the same state: restoring the checkpoint reproduces the
+        published version BIT-EXACTLY."""
+        from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+        mat = mv_env.MV_CreateTable(MatrixTableOption(num_rows=24,
+                                                      num_cols=4))
+        kv = mv_env.MV_CreateTable(KVTableOption())
+        rng = np.random.default_rng(7)
+        ids = np.arange(24, dtype=np.int32)
+        uri = f"file://{tmp_path}/parity.mvt"
+        # mid-burst: untracked pushes immediately before AND after the
+        # two cuts — the cuts sit between specific burst positions
+        for j in range(6):
+            mat.AddFireForget(
+                rng.standard_normal((4, 4)).astype(np.float32),
+                row_ids=np.sort(rng.choice(24, 4, replace=False))
+                .astype(np.int32))
+            kv.AddFireForget(rng.integers(0, 50, 8).astype(np.int64),
+                             rng.standard_normal(8).astype(np.float32))
+        v = mv_env.MV_PublishSnapshot()
+        mv_env.MV_SaveCheckpoint(uri)     # adjacent stream position
+        for j in range(6):                # the burst keeps going
+            mat.AddFireForget(np.ones((4, 4), np.float32),
+                              row_ids=np.arange(4, dtype=np.int32))
+        mv_env.MV_PinVersion(v)
+        snap_rows = mv_env.MV_ServingLookup(mat, ids, version=v)
+        keys = np.arange(50, dtype=np.int64)
+        snap_kv = mv_env.MV_ServingLookup(kv, keys, version=v)
+        # the live table has drifted past the cut...
+        assert not np.array_equal(mat.GetRows(ids), snap_rows)
+        # ...and restoring the checkpoint returns it to the cut exactly
+        mv_env.MV_LoadCheckpoint(uri)
+        np.testing.assert_array_equal(mat.GetRows(ids), snap_rows)
+        np.testing.assert_array_equal(kv.Get(keys), snap_kv)
+
+
+_SERVING_2PROC_CHILD = r'''
+import os, sys, threading, time
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            ServingOverloaded)
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=60"])
+R, C = 64, 4
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+ids_all = np.arange(R, dtype=np.int32)
+rng = np.random.default_rng(10 + rank)
+
+# phase 1: train, then cut a version at a lockstep position
+for step in range(5):
+    sel = np.sort(rng.choice(R, 8, replace=False)).astype(np.int32)
+    mat.AddRows(sel, rng.standard_normal((8, C)).astype(np.float32))
+mv.MV_Barrier()
+v = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v)
+oracle = mv.MV_ServingLookup(mat, ids_all, version=v)
+
+# phase 2: concurrent readers hammer the pinned version WHILE a
+# training burst runs — every read must be bit-exact vs the oracle
+# (never torn, never cross-version), or typed
+errors = []
+reads = [0]
+stop = threading.Event()
+def reader():
+    r = np.random.default_rng(rank * 31 + 1)
+    while not stop.is_set():
+        sel = np.sort(r.choice(R, 16, replace=False)).astype(np.int32)
+        try:
+            got = mv.MV_ServingLookup(mat, sel, version=v, deadline=30)
+        except (DeadlineExceeded, ServingOverloaded):
+            continue
+        if not np.array_equal(got, oracle[sel]):
+            errors.append((sel, got))
+            return
+        reads[0] += 1
+threads = [threading.Thread(target=reader, daemon=True)
+           for _ in range(4)]
+for t in threads:
+    t.start()
+for step in range(8):
+    sel = np.sort(rng.choice(R, 8, replace=False)).astype(np.int32)
+    deltas = rng.standard_normal((8, C)).astype(np.float32)
+    mat.AddRows(sel, deltas)
+    for j in range(3):
+        mat.AddFireForget(deltas + j, row_ids=sel)
+stop.set()
+for t in threads:
+    t.join(30)
+assert not errors, f"torn/cross-version read: {errors[0][0]}"
+assert reads[0] > 0, "readers never completed a lookup"
+
+# phase 3: the lookup path must issue ZERO host collectives — publish
+# cuts inside the engine stream, lookups never leave the process. Drain
+# the engine first so no in-flight training window is still exchanging.
+from multiverso_tpu.zoo import Zoo
+Zoo.Get().DrainServer()
+mv.MV_Barrier()
+before = multihost.STATS["host_collective_rounds"]
+for _ in range(50):
+    sel = np.sort(rng.choice(R, 16, replace=False)).astype(np.int32)
+    got = mv.MV_ServingLookup(mat, sel, version=v)
+    assert np.array_equal(got, oracle[sel])
+assert multihost.STATS["host_collective_rounds"] == before, (
+    f"serving lookups issued host collectives: {before} -> "
+    f"{multihost.STATS}")
+
+# versions agreed across ranks (lockstep allocation)
+vs = multihost.host_allgather_objects(int(v))
+assert vs[0] == vs[1] == v, vs
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} SERVING-2PROC OK", flush=True)
+'''
+
+
+class TestServingTwoProc:
+    def test_concurrent_lookups_bit_exact_and_collective_free(
+            self, tmp_path):
+        """Acceptance: 2-proc world — lookups served concurrently with
+        a training burst return bit-exact pinned-version values, the
+        publish's version numbers agree across ranks without any
+        version collective, and the lookup path adds NO host
+        collectives."""
+        run_two_process(_SERVING_2PROC_CHILD, tmp_path,
+                        expect="SERVING-2PROC OK")

@@ -287,6 +287,7 @@ class TestNoBarePrintLint:
             os.path.abspath(__file__))), "multiverso_tpu")
         pat = re.compile(r"(?<![\w.])print\s*\(")
         offenders = []
+        scanned = set()
         for dirpath, dirnames, filenames in os.walk(pkg):
             dirnames[:] = [d for d in dirnames if d != "__pycache__"]
             for fn in filenames:
@@ -296,6 +297,7 @@ class TestNoBarePrintLint:
                 rel = os.path.relpath(path, pkg)
                 if rel in self.ALLOW:
                     continue
+                scanned.add(rel)
                 with open(path) as f:
                     for lineno, line in enumerate(f, 1):
                         if line.lstrip().startswith("#"):
@@ -303,6 +305,10 @@ class TestNoBarePrintLint:
                         if pat.search(line):
                             offenders.append(f"{rel}:{lineno}: "
                                              f"{line.strip()}")
+        # pin the serving subpackage (round 8) — its output must ride
+        # the logger like everything else
+        assert any(rel.startswith("serving") for rel in scanned), \
+            sorted(scanned)
         assert not offenders, (
             "bare print() in the package — route output through "
             "utils/log.py or the telemetry exporters:\n"
