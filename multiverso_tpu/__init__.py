@@ -50,6 +50,8 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_StopProfiler,
     MV_MetricsSnapshot,
     MV_DumpTrace,
+    MV_DumpFlightRecorder,
+    MV_DumpDiagnostics,
     MV_WorkerContext,
 )
 

@@ -27,7 +27,7 @@ from multiverso_tpu.failsafe.deadline import (DEFAULT_SHUTDOWN_JOIN_S,
                                               deadline_s)
 from multiverso_tpu.failsafe.errors import ActorDied
 from multiverso_tpu.message import Message, MsgType
-from multiverso_tpu.telemetry import metrics, trace
+from multiverso_tpu.telemetry import flight, metrics, trace
 from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.utils.mt_queue import MtQueue
 
@@ -207,6 +207,8 @@ class Actor:
             # immediately instead of feeding a dead thread
             self._poison = exc
             metrics.counter(f"actor.{self.name}.deaths").inc()
+            flight.record("actor.poison",
+                          detail=f"{self.name}: {type(exc).__name__}")
             Log.Error("actor %s: loop thread died, poisoning mailbox:\n%s",
                       self.name, traceback.format_exc())
             self.mailbox.Exit()

@@ -263,3 +263,29 @@ def MV_DumpTrace(path: str) -> str:
     jobs (each rank dumps its own spans). Returns ``path``."""
     from multiverso_tpu.telemetry import trace
     return trace.dump(path)
+
+
+def MV_DumpFlightRecorder(path: str) -> str:
+    """Write the always-on flight recorder's event ring
+    (``-mv_flight_events``; telemetry/flight.py) as JSONL to ``path``:
+    a header line (rank, pid, recorded/dropped counts), then one event
+    per line — window admitted/exchanged/applied with exchange SEQ,
+    fence causes, barriers, CRC retries, dedup hits, snapshot
+    publish/evict, serving dispatch/shed, actor poison. Per-rank and
+    never collective; align several ranks' dumps with ``python -m
+    multiverso_tpu.telemetry.forensics``. Returns ``path``."""
+    from multiverso_tpu.telemetry import flight
+    return flight.dump(path)
+
+
+def MV_DumpDiagnostics(dir_path: Optional[str] = None) -> Optional[str]:
+    """Write the complete postmortem artifact set — flight ring
+    (``flight_rank<R>.jsonl``), local telemetry snapshot
+    (``telemetry_rank<R>.json``) and span trace
+    (``trace_rank<R>.json``) — under ``dir_path`` (default: the
+    ``-mv_diag_dir`` flag). With the flag set, failure paths and
+    ``Zoo.Stop`` produce the same layout automatically, so one flag
+    captures everything a postmortem needs. Returns the directory, or
+    None when no directory is configured."""
+    from multiverso_tpu.telemetry.ops import dump_diagnostics
+    return dump_diagnostics(dir_path)

@@ -102,13 +102,22 @@ def _telemetry() -> str:
     return json.dumps(_compact(snap), sort_keys=True)
 
 
+def _flight() -> str:
+    from multiverso_tpu.telemetry import flight
+    if not flight.enabled():
+        return "flight recorder off (-mv_flight_events=0)"
+    recorded, dropped = flight.stats()
+    return (f"recorded {recorded}, dropped {dropped}; tail:\n"
+            + flight.tail_text(40))
+
+
 def bundle(what: str) -> str:
     """Render the full diagnostic bundle for a failure named ``what``.
     LOCAL only — never issues collectives (a diagnostic path that needs
     a healthy world to describe an unhealthy one is useless)."""
     sections = [("threads", _thread_stacks), ("engine", _engine_state),
                 ("in-flight requests", _inflight),
-                ("telemetry", _telemetry)]
+                ("telemetry", _telemetry), ("flight", _flight)]
     lines = [f"== failsafe diagnostic bundle: {what} =="]
     for title, fn in sections:
         lines.append(f"-- {title} --")

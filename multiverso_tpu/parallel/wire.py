@@ -143,7 +143,7 @@ def payload_has_deferred(payload: dict) -> bool:
     """True when any value of a decoded verb payload is a DeferredArray
     placeholder — its bytes ride the DEVICE wire, so applying the verb
     is a collective device program. The pipelined engine's overlap gate
-    (sync/server.py _mh_overlap_ok) fences such windows: a device
+    (sync/server.py _mh_fence_cause) fences such windows: a device
     collective on the apply thread must never run concurrently with the
     exchange thread's host allgather (rank-divergent interleavings
     deadlock the world). Deferral only ever replaces a payload's

@@ -43,6 +43,7 @@ import numpy as np
 from multiverso_tpu.failsafe import chaos
 from multiverso_tpu.failsafe import deadline as fdeadline
 from multiverso_tpu.failsafe.errors import ServingOverloaded
+from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.configure import (cached_float_flag,
                                             cached_int_flag)
@@ -126,9 +127,11 @@ class ServingFrontend:
         cz = chaos.get()
         if cz is not None and cz.serving_admission():
             self._t_shed.inc()
+            tflight.record("serving.shed", detail="chaos")
             raise ServingOverloaded("chaos: serving admission shed")
         if self._q.Size() >= max(1, _max_inflight_flag()):
             self._t_shed.inc()
+            tflight.record("serving.shed", detail="overload")
             raise ServingOverloaded(
                 f"serving admission queue full "
                 f"({_max_inflight_flag()} in flight) — shed; retry with "
@@ -279,6 +282,7 @@ class ServingFrontend:
             if delay > 0:
                 time.sleep(delay)
         self._t_batch.observe(len(batch))
+        tflight.record("serving.dispatch", detail=f"{len(batch)}req")
         groups: Dict[Tuple[int, int], List[tuple]] = {}
         for item in batch:
             snap, table_id, _, _ = item

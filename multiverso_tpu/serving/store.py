@@ -29,6 +29,7 @@ import collections
 import threading
 from typing import Dict, List, Optional
 
+from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.configure import cached_int_flag
 from multiverso_tpu.utils.log import CHECK, Log
@@ -74,8 +75,12 @@ class SnapshotStore:
                     continue
                 del self._versions[v]
                 self._t_evicted.inc()
+                tflight.record("snapshot.evict", detail=f"v{v}")
             self._t_published.inc()
             self._t_live.set(len(self._versions))
+        tflight.record("snapshot.publish",
+                       epoch=getattr(snap, "window_epoch", -1),
+                       detail=f"v{snap.version}")
 
     # -- read side (any thread) ---------------------------------------------
 
@@ -139,4 +144,5 @@ class SnapshotStore:
                     and version in list(self._versions)[:-keep]):
                 del self._versions[version]
                 self._t_evicted.inc()
+                tflight.record("snapshot.evict", detail=f"v{version}")
                 self._t_live.set(len(self._versions))

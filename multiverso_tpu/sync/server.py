@@ -37,6 +37,7 @@ from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
                                             TransientError, WireCorruption)
 from multiverso_tpu.message import Message, MsgType, copy_result
 from multiverso_tpu.parallel import wire
+from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
@@ -124,6 +125,28 @@ MV_DEFINE_int("mv_get_staleness", 0,
 _PL_POLL_S = 0.002
 
 _INF = float("inf")
+
+#: fence-cause taxonomy (round 9 — the observability plane's answer to
+#: "overlap_pct sits at ~36%: WHAT fences?"). Every stall of the
+#: pipelined exchange stage is classified into exactly one cause and
+#: counted in ``engine.fence.<cause>``, with the stall seconds observed
+#: into the ``engine.fence.stall_s`` histogram:
+#:
+#: * ``barrier``        — a non-verb window head (StoreLoad / Publish /
+#:                        barrier ping / FinishTrain): its dispatch may
+#:                        itself run collectives, so the stage fences
+#:                        until the actor reports it done;
+#: * ``nonlocal_table`` — a touched table's apply is not host-local
+#:                        (mh_apply_is_local() False): the apply runs
+#:                        device collectives that must not race the
+#:                        exchange thread's allgather;
+#: * ``device_wire``    — a window position's values rode the device
+#:                        wire (DeferredArray): same collective-apply
+#:                        reasoning;
+#: * ``depth``          — the DEPTH cap: the apply stage simply hasn't
+#:                        kept up (the only cause raising the cap or
+#:                        speeding the apply would remove).
+FENCE_CAUSES = ("barrier", "nonlocal_table", "device_wire", "depth")
 
 
 class _StageKilled(Exception):
@@ -233,6 +256,9 @@ class _ExchangeStage:
         self._emitted = 0
         self._applied = 0
         self._fence_at = 0
+        #: why _fence_at was last raised (fence-cause profiling); the
+        #: depth-cap stall is classified separately in _gate
+        self._fence_cause = "barrier"
         self._cv = threading.Condition()
         self._killed = False
         self.dead: Optional[BaseException] = None
@@ -296,13 +322,29 @@ class _ExchangeStage:
         if not ok:
             fdeadline.raise_deadline(what, fatal=True)
 
+    _GATE_WHAT = "pipelined engine apply fence (apply stage did not drain)"
+
     def _gate(self) -> None:
         """Before ANY new collective: honour the fence (a non-local
         apply or barrier dispatch may be running device collectives on
-        the actor thread) and the pipeline depth bound."""
-        self._wait_applied(
-            max(self._fence_at, self._emitted - self.DEPTH + 1),
-            "pipelined engine apply fence (apply stage did not drain)")
+        the actor thread) and the pipeline depth bound.
+
+        Fence-cause profiling (round 9): when the gate actually stalls,
+        the stall is classified (the explicit fence's recorded cause,
+        or ``depth`` when only the DEPTH cap holds it) and its seconds
+        observed — this is the dataset behind raising overlap_pct."""
+        depth_target = self._emitted - self.DEPTH + 1
+        target = max(self._fence_at, depth_target)
+        # advisory read (GIL-atomic int): only classifies; correctness
+        # stays with the cv wait below
+        if self._applied >= target:
+            self._wait_applied(target, self._GATE_WHAT)
+            return
+        cause = (self._fence_cause if self._fence_at >= depth_target
+                 else "depth")
+        t0 = _time.perf_counter()
+        self._wait_applied(target, self._GATE_WHAT)
+        self._srv._note_fence(cause, _time.perf_counter() - t0)
 
     def _main(self) -> None:
         try:
@@ -348,6 +390,7 @@ class _ExchangeStage:
             self._srv._mh_check_barrier_head(payload)
             self._emitted += 1
             self._fence_at = self._emitted
+            self._fence_cause = "barrier"
             self.out.Push(("barrier", payload))
 
     def _exchange_one(self) -> None:
@@ -379,6 +422,7 @@ class _ExchangeStage:
                 srv._note_overlap(max(0.0, now - max(a0, t0)))
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
+        srv._flight_exchanged(descs, self._my_rank)
         CHECK(all(d == descs[0] for d in descs),
               f"multi-process verb streams diverge inside a window: "
               f"{descs} — every process must issue the same table-verb "
@@ -386,8 +430,10 @@ class _ExchangeStage:
         for _ in range(prefix):
             self._pending.popleft()
         self._emitted += 1
-        if not srv._mh_overlap_ok(descs[0], windows, prefix):
+        fence_cause = srv._mh_fence_cause(descs[0], windows, prefix)
+        if fence_cause is not None:
             self._fence_at = self._emitted
+            self._fence_cause = fence_cause
         self.out.Push(("window", used[:prefix], windows, prefix, descs[0],
                        t0, win_ctx))
 
@@ -466,6 +512,16 @@ class Server(Actor):
         self._t_overlap_pct = tmetrics.gauge("engine.overlap_pct")
         tmetrics.counter("worker.write_combine_hits")   # eager (see above)
         tmetrics.counter("worker.get_cache_hits")
+        # round 9 — fence-cause profiling: every pipelined-stage stall
+        # classified (FENCE_CAUSES above) + its seconds. Registered
+        # eagerly so the -stats_interval_s reporter and /metrics show
+        # the whole breakdown at zero from the first scrape — the
+        # dataset the ROADMAP's overlap attack reads.
+        for _cause in FENCE_CAUSES:
+            tmetrics.counter(f"engine.fence.{_cause}")
+        self._t_fence_stall_s = tmetrics.histogram("engine.fence.stall_s")
+        #: last classified fence cause (dashboard [Ops] line probe)
+        self.last_fence_cause = ""
         self._ex_stage: Optional[_ExchangeStage] = None
         self._apply_since = 0.0   # apply interval start (overlap calc)
         self._overlap_s = 0.0
@@ -511,6 +567,31 @@ class Server(Actor):
         if self._ex_stage is not None:
             self._ex_stage.stop()
         super().Stop()
+
+    def _flight_exchanged(self, descs, my_rank: int) -> None:
+        """Flight event for one completed exchange: THIS rank's verbs
+        over the AGREED prefix, recorded BEFORE the cross-rank
+        divergence CHECK — so a diverging window is in the ring when
+        the CHECK aborts it, which is what forensics.correlate aligns.
+        The prefix (not the full local pack) is deliberate: ragged
+        drains legally pack different window LENGTHS per rank, and a
+        full-pack descriptor would read as a false divergence on a
+        healthy stream."""
+        if tflight.enabled():
+            tflight.record("window.exchanged", seq=self._mh_seq - 1,
+                           epoch=self.window_epoch,
+                           detail=",".join(f"{k}{t}"
+                                           for k, t in descs[my_rank]))
+
+    def _note_fence(self, cause: str, stall_s: float) -> None:
+        """Account one pipelined-stage stall: ``engine.fence.<cause>``
+        counter + the stall-seconds histogram + a flight event. Called
+        from the exchange stage thread only."""
+        tmetrics.counter(f"engine.fence.{cause}").inc()
+        self._t_fence_stall_s.observe(stall_s)
+        self.last_fence_cause = cause
+        tflight.record("fence", seq=self._mh_seq,
+                       epoch=self.window_epoch, detail=cause)
 
     def _note_overlap(self, s: float) -> None:
         """Record ``s`` seconds of exchange/apply concurrency (called by
@@ -558,12 +639,16 @@ class Server(Actor):
             # duplicate would double-tick the BSP get clock and desync
             # the SyncServer's round accounting.
             self._t_dedup_hits.inc()
+            tflight.record("dedup.hit", epoch=self.window_epoch,
+                           detail=f"obj src{msg.src}")
             return False
         if msg.msg_type is MsgType.Request_Add and msg.msg_id:
             key = (msg.src, msg.msg_id)
             tracked = msg.waiter is not None
             if tracked and self._dedup.seen(key):
                 self._t_dedup_hits.inc()
+                tflight.record("dedup.hit", epoch=self.window_epoch,
+                               detail=f"retry src{msg.src}")
                 ready, outcome = self._dedup.outcome(key)
                 msg.reply(outcome if ready else TransientError(
                     "duplicate Add while the original is in flight"))
@@ -671,6 +756,8 @@ class Server(Actor):
                          args={"verbs": len(batch)}):
             self._local_window(batch)
         self.window_epoch += 1     # worker get-cache staleness clock
+        tflight.record("window.applied", epoch=self.window_epoch,
+                       detail=f"{len(batch)}v")
         self._t_window_s.observe(_time.perf_counter() - _t0)
         # count Add/Get verbs only, like the mh path's prefix count —
         # the counter must mean the same thing in every topology
@@ -700,6 +787,8 @@ class Server(Actor):
                 # standard error routing; no dedup survives it
                 self.window_barrier_splits += 1
                 self._t_splits.inc()
+                tflight.record("barrier", epoch=self.window_epoch,
+                               detail=MsgType(seg.msg_type).name)
                 self._dispatch(seg)
                 seen.clear()
                 continue
@@ -839,6 +928,17 @@ class Server(Actor):
             # collectives from the desynced stream.
             if self._ex_stage is not None:
                 self._ex_stage.poison()
+            # forensics: the abort itself becomes a ring event, then
+            # the whole ring hits disk (when -mv_diag_dir is set) so a
+            # diverged 2-proc world leaves per-rank dumps that
+            # telemetry/forensics.py can align — BEFORE waiters are
+            # failed, so a fast-exiting worker can't beat the dump
+            tflight.record("engine.fatal", seq=self._mh_seq,
+                           epoch=self.window_epoch,
+                           detail=f"{type(exc).__name__}: "
+                                  f"{exc}"[:200])
+            tflight.dump_failure(
+                f"engine window stream abort ({type(exc).__name__})")
             for m in pending:
                 m.reply(exc)
             exc.mv_fatal = True
@@ -941,6 +1041,9 @@ class Server(Actor):
                 # the symmetric case when its exchange ends first)
                 self._note_overlap(max(0.0, now - max(b0, t0)))
             self.window_epoch += 1
+            tflight.record("window.applied", seq=self._mh_seq,
+                           epoch=self.window_epoch,
+                           detail=f"{prefix}v")
 
     def _mh_windows_inner(self, pending: "Deque[Message]") -> None:
         while pending:
@@ -997,6 +1100,12 @@ class Server(Actor):
             lambda: multihost.capped_exchange(marker, self._mh_caps,
                                               "HEAD_B"),
             "window head-marker exchange")
+        # seq of the NEXT exchange: barriers do not advance the SEQ
+        # counter, so forensics aligns a barrier against the verbs a
+        # diverged peer exchanged at that same seq
+        tflight.record("barrier", seq=self._mh_seq,
+                       epoch=self.window_epoch,
+                       detail=MsgType(head.msg_type).name)
         kinds = [wire.decode_head_kind(b) for b in blobs]
         CHECK(all(k == kinds[0] for k in kinds),
               f"multi-process window heads diverge: {kinds} — every "
@@ -1127,6 +1236,9 @@ class Server(Actor):
                     windows.append(decoded)
             except WireCorruption as exc:
                 last_exc = exc
+                tflight.record("wire.crc_retry", seq=self._mh_seq,
+                               epoch=self.window_epoch,
+                               detail=f"attempt{attempt + 1}")
                 Log.Error("window exchange frame corrupt (attempt "
                           "%d/%d): %r — re-exchanging", attempt + 1,
                           1 + self.MH_WIRE_RETRIES, exc)
@@ -1174,16 +1286,21 @@ class Server(Actor):
             local.append((kind, m.table_id, payload))
             used.append(m)
         self._t_budget.set(packed)
+        tflight.record("window.admitted", seq=self._mh_seq,
+                       epoch=self.window_epoch,
+                       detail=f"{len(used)}v/{packed}B")
         return local, used
 
-    def _mh_overlap_ok(self, descs0, windows, prefix) -> bool:
-        """True when THIS window's apply runs entirely on the host —
-        the pipelined engine's overlap gate. Decided from EXCHANGED
-        data (every rank holds identical windows) plus table state that
-        evolves at lockstep verb positions (tables/base.py
-        mh_apply_is_local contract), so every rank gates identically:
-        overlap never pairs an apply-side device collective on one rank
-        with an exchange-thread allgather on another."""
+    def _mh_fence_cause(self, descs0, windows, prefix) -> Optional[str]:
+        """None when THIS window's apply runs entirely on the host —
+        the pipelined engine's overlap gate — else the FENCE_CAUSES
+        entry naming why it must fence (fence-cause profiling). Decided
+        from EXCHANGED data (every rank holds identical windows) plus
+        table state that evolves at lockstep verb positions
+        (tables/base.py mh_apply_is_local contract), so every rank
+        gates identically: overlap never pairs an apply-side device
+        collective on one rank with an exchange-thread allgather on
+        another."""
         tables_ok: Dict[int, bool] = {}
         for kind, tid in descs0:
             ok = tables_ok.get(tid)
@@ -1194,12 +1311,12 @@ class Server(Actor):
                     ok = False   # bad table id: per-position error path
                 tables_ok[tid] = ok
             if not ok:
-                return False
+                return "nonlocal_table"
         for w in windows:
             for _, _, payload in w[:prefix]:
                 if wire.payload_has_deferred(payload):
-                    return False   # device-wire values: collective apply
-        return True
+                    return "device_wire"   # device values: collective
+        return None
 
     def _mh_collective_window_inner(self, verbs) -> int:
         from multiverso_tpu.parallel import multihost
@@ -1208,12 +1325,15 @@ class Server(Actor):
         windows = self._mh_exchange_decode(local, my_rank)
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
+        self._flight_exchanged(descs, my_rank)
         CHECK(all(d == descs[0] for d in descs),
               f"multi-process verb streams diverge inside a window: "
               f"{descs} — every process must issue the same table-verb "
               f"sequence (the SPMD collective contract)")
         self._mh_apply_window(used[:prefix], windows, prefix, descs[0])
         self.window_epoch += 1
+        tflight.record("window.applied", seq=self._mh_seq,
+                       epoch=self.window_epoch, detail=f"{prefix}v")
         return prefix
 
     def _mh_apply_window(self, verbs, windows, prefix, descs0) -> None:

@@ -20,9 +20,28 @@ verb across the actor mailboxes. This package provides the three layers
   snapshot/dump helpers behind ``MV_MetricsSnapshot`` /
   ``MV_DumpTrace``.
 
+The ops plane (round 9) adds three more:
+
+* ``flight`` — the always-on flight recorder: a bounded,
+  allocation-cheap ring of structured events (windows with exchange
+  SEQ, fence causes, barriers, CRC retries, dedup hits, snapshot
+  publish/evict, serving dispatch/shed, actor poison), dumped as JSONL
+  by ``MV_DumpFlightRecorder`` and automatically on failure paths
+  under ``-mv_diag_dir``.
+* ``forensics`` — aligns several ranks' flight dumps by exchange SEQ
+  and pinpoints the first diverging stream position (``python -m
+  multiverso_tpu.telemetry.forensics``). An offline tool with no
+  flags, so it is NOT eagerly imported — import it when correlating.
+* ``ops`` — the ``-mv_ops_port`` HTTP endpoint: ``/metrics``
+  (Prometheus text), ``/healthz`` (poison-aware liveness),
+  ``/flight`` (recent events). Local snapshots only — the handler
+  never issues collectives.
+
 Importing this package registers every telemetry flag (``-telemetry``,
-``-trace``, ``-stats_interval_s``) so ``MV_Init`` argv parsing claims
+``-trace``, ``-stats_interval_s``, ``-mv_flight_events``,
+``-mv_diag_dir``, ``-mv_ops_port``) so ``MV_Init`` argv parsing claims
 them.
 """
 
-from multiverso_tpu.telemetry import export, metrics, trace  # noqa: F401
+from multiverso_tpu.telemetry import (export, flight,  # noqa: F401
+                                      metrics, ops, trace)

@@ -55,7 +55,22 @@ class StatsReporter:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5)
+        # join through failsafe.deadline.bounded (lazy import: this
+        # module loads before the failsafe package on the zoo import
+        # chain): with -mv_deadline_s armed a wedged reporter raises a
+        # typed DeadlineExceeded we log instead of stalling Zoo.Stop;
+        # the inner join timeout bounds the flag-unset path
+        from multiverso_tpu.failsafe import deadline as fdeadline
+        from multiverso_tpu.failsafe.errors import DeadlineExceeded
+        try:
+            fdeadline.bounded(lambda: self._thread.join(timeout=5),
+                              "stats reporter join", fatal=False)
+        except DeadlineExceeded as exc:
+            Log.Error("stats reporter stop timed out (%r) — abandoning "
+                      "its daemon thread", exc)
+        if self._thread.is_alive():
+            Log.Error("stats reporter thread still alive after bounded "
+                      "join — daemon thread abandoned")
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):

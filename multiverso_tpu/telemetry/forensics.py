@@ -1,0 +1,182 @@
+"""Cross-rank divergence forensics over flight-recorder dumps.
+
+A multi-process world's correctness rests on the SPMD collective
+contract: every rank issues the same table-verb sequence at the same
+stream positions. When that breaks, the engine's divergence CHECK (or
+SEQ-mismatch CHECK) fires — loud, but the message only shows the
+mismatched window, not WHERE the streams first came apart. With
+``-mv_diag_dir`` set, every rank dumps its flight ring on those
+failures (telemetry/flight.py); :func:`correlate` aligns the dumps by
+**exchange SEQ** and reports the first diverging stream position with
+each rank's verbs at it.
+
+Alignment algorithm:
+
+* every successful window exchange records a ``window.exchanged`` event
+  stamped with the engine's exchange SEQ and a compact descriptor of
+  the recording rank's verbs over the AGREED prefix (``"A0,G1"`` = Add
+  table 0, Get table 1; the prefix rather than the full local pack —
+  ragged drains legally pack different window lengths per rank) —
+  recorded BEFORE the cross-rank descriptor CHECK, so the diverging
+  window is in the ring even though the CHECK aborted it;
+* barrier head-markers record a ``barrier`` event stamped with the seq
+  of the NEXT exchange (barriers do not advance the SEQ counter), so a
+  rank at a barrier while a peer exchanges verbs shows up as a kind
+  mismatch at that seq;
+* per rank, events sharing a seq keep their ring order. Ranks are
+  compared seq by seq over the union: the first seq whose per-rank
+  event lists differ (kind or verbs) — or that some rank never reached
+  while a peer with later activity did — is the divergence point.
+
+Events *applied* (``window.applied``) carry the window epoch instead;
+they corroborate how far each rank's APPLY stage got but alignment
+rides the exchange SEQ, which is the collective clock.
+
+CLI::
+
+    python -m multiverso_tpu.telemetry.forensics diag/flight_rank*.jsonl
+
+prints the report and exits 1 when a divergence was found (0 when the
+streams agree — useful in drills).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+#: event kinds that are stream positions (collective-clock events)
+_STREAM_KINDS = ("window.exchanged", "barrier")
+
+
+def load(path: str) -> dict:
+    """Read one flight JSONL dump -> {"rank": r, "header": {...},
+    "events": [...]} (events oldest first)."""
+    header: dict = {}
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("flight_header"):
+                header = rec
+            else:
+                events.append(rec)
+    return {"rank": int(header.get("rank", -1)), "header": header,
+            "events": events, "path": path}
+
+
+def _stream(events: List[dict]) -> Dict[int, List[dict]]:
+    """seq -> ordered stream events at that seq (see module doc)."""
+    out: Dict[int, List[dict]] = {}
+    for e in events:
+        if e.get("kind") in _STREAM_KINDS and e.get("seq", -1) >= 0:
+            out.setdefault(int(e["seq"]), []).append(e)
+    return out
+
+
+def _desc(evs: Optional[List[dict]]) -> Optional[str]:
+    if not evs:
+        return None
+    return ";".join(f"{e['kind']}:{e.get('detail', '')}" for e in evs)
+
+
+def correlate(paths: List[str]) -> dict:
+    """Align the rings in ``paths`` by exchange SEQ; return a report:
+
+    ``{"diverged": bool, "seq": first diverging seq or None,
+    "per_rank": {rank: verbs-at-that-seq or None}, "ranks": [...],
+    "agreed_through": last seq every rank agreed at (or None),
+    "note": str}``
+
+    A rank whose dump merely covers a SHORTER seq range than its
+    peers' does not count as diverged at the uncovered seqs: a dump
+    can end earlier (the rank died or dumped first) and it can START
+    later (the bounded ring evicted the oldest events — a long-running
+    rank with extra serving/snapshot events ages out early exchanges
+    its peers still hold). Divergence needs either differing events at
+    a seq, or a HOLE: a seq missing on a rank that recorded activity
+    on both sides of it — or ahead of it while its header says it
+    dropped nothing (a front-missing seq then cannot be eviction).
+    """
+    dumps = [load(p) for p in paths]
+    streams = {}
+    dropped = {}
+    for d in dumps:
+        rank = d["rank"] if d["rank"] >= 0 else len(streams)
+        streams[rank] = _stream(d["events"])
+        dropped[rank] = int(d["header"].get("dropped", 0))
+    ranks = sorted(streams)
+    all_seqs = sorted(set().union(*[set(s) for s in streams.values()])
+                      if streams else set())
+    agreed_through: Optional[int] = None
+    for seq in all_seqs:
+        descs = {r: _desc(streams[r].get(seq)) for r in ranks}
+        present = {r: d for r, d in descs.items() if d is not None}
+        missing = [r for r, d in descs.items() if d is None]
+        # a missing seq only diverges when that rank recorded activity
+        # on BOTH sides of it (a hole). A dump that merely ends
+        # earlier (rank died/dumped first) covers a shorter range, not
+        # a divergent stream — and so does one that STARTS later
+        # because the bounded ring evicted its oldest events
+        # (dropped > 0 in the header); a front-missing seq on a rank
+        # that dropped NOTHING really is a hole.
+        holes = [r for r in missing if streams[r]
+                 and seq < max(streams[r])
+                 and (seq > min(streams[r]) or dropped.get(r, 0) == 0)]
+        vals = set(present.values())
+        if len(vals) > 1 or holes:
+            per_rank = {r: descs[r] for r in ranks}
+            detail = ", ".join(
+                f"rank {r}: {descs[r] if descs[r] is not None else '<missing>'}"
+                for r in ranks)
+            return {"diverged": True, "seq": seq, "ranks": ranks,
+                    "per_rank": per_rank,
+                    "agreed_through": agreed_through,
+                    "note": (f"first diverging exchange SEQ {seq}: "
+                             f"{detail}")}
+        if len(present) == len(ranks):
+            agreed_through = seq
+    return {"diverged": False, "seq": None, "ranks": ranks,
+            "per_rank": {}, "agreed_through": agreed_through,
+            "note": (f"streams agree through exchange SEQ "
+                     f"{agreed_through}" if agreed_through is not None
+                     else "no common stream events")}
+
+
+def report_text(report: dict) -> str:
+    """Human-readable rendering of a :func:`correlate` report."""
+    lines = [f"== flight forensics: ranks {report['ranks']} =="]
+    if report["diverged"]:
+        lines.append(f"DIVERGED at exchange SEQ {report['seq']} "
+                     f"(streams agreed through "
+                     f"{report['agreed_through']})")
+        for r in report["ranks"]:
+            d = report["per_rank"].get(r)
+            lines.append(f"  rank {r}: "
+                         f"{d if d is not None else '<no event>'}")
+    else:
+        lines.append(report["note"])
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    from multiverso_tpu.utils.log import Log
+    parser = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.telemetry.forensics",
+        description="align per-rank flight-recorder dumps by exchange "
+                    "SEQ and report the first diverging stream position")
+    parser.add_argument("paths", nargs="+",
+                        help="per-rank flight_rank<R>.jsonl dumps")
+    args = parser.parse_args(argv)
+    report = correlate(args.paths)
+    Log.Info("%s", report_text(report))
+    return 1 if report["diverged"] else 0
+
+
+if __name__ == "__main__":      # pragma: no cover - CLI shim
+    raise SystemExit(main())

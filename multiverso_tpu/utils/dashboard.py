@@ -163,8 +163,10 @@ class Dashboard:
         """Print the cross-host aggregate (Display's job-wide sibling),
         plus this process's serving-plane stats (lookup count/shed,
         latency p99, snapshot age, live versions) when the serving
-        front-end has run — serving is per-process state, so its lines
-        are local, not part of the collective monitor reduce."""
+        front-end has run, and the local ops-plane line (flight
+        recorder counts, ops port, last fence cause) — serving and ops
+        are per-process state, so their lines are local, not part of
+        the collective monitor reduce."""
         lines = [format_monitor_line(name, rec["count"], rec["elapse_ms"],
                                      " (all hosts)")
                  for name, rec in cls.AggregateAcrossHosts().items()]
@@ -173,10 +175,33 @@ class Dashboard:
             lines += serving.status_lines()
         except Exception:       # pragma: no cover - serving torn down
             pass
+        lines += cls._ops_lines()
         out = "\n".join(lines)
         for line in lines:
             Log.Info("%s", line)
         return out
+
+    @staticmethod
+    def _ops_lines() -> list:
+        """The local [Ops] observability line (round 9): flight events
+        recorded/dropped, the live ops endpoint port, and the last
+        classified pipeline fence cause. Best-effort — the dashboard
+        must render even while telemetry tears down."""
+        try:
+            from multiverso_tpu.telemetry import flight, ops
+            from multiverso_tpu.zoo import Zoo
+            recorded, dropped = flight.stats()
+            port = ops.port()
+            eng = Zoo.Get().server_engine
+            last_fence = (getattr(eng, "last_fence_cause", "")
+                          if eng is not None else "")
+            return [
+                f"[Ops] flight_events = {recorded} recorded / "
+                f"{dropped} dropped, ops_port = "
+                f"{port if port is not None else 'off'}, "
+                f"last_fence = {last_fence or '-'}"]
+        except Exception:       # pragma: no cover - teardown races
+            return []
 
     @classmethod
     def _reset_for_tests(cls) -> None:

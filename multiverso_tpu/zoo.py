@@ -117,6 +117,8 @@ class Zoo:
             self.server_engine.Start()
         from multiverso_tpu.telemetry.export import start_reporter
         start_reporter()        # -stats_interval_s periodic reports
+        from multiverso_tpu.telemetry.ops import start_ops
+        start_ops()             # -mv_ops_port /metrics·/healthz·/flight
         self.started = True
         Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
                   "mode=%s", self.num_servers, self.num_workers,
@@ -127,8 +129,16 @@ class Zoo:
     def Stop(self, finalize_net: bool = True) -> None:
         if not self.started:
             return
+        # ops plane down FIRST and BOUNDED: the HTTP daemon thread and
+        # the periodic reporter are both joined through
+        # failsafe.deadline.bounded paths, so back-to-back worlds in one
+        # pytest process cannot leak daemon threads or find the ops port
+        # still bound (-mv_ops_port=0 picks an ephemeral port per world
+        # for exactly that reason)
         from multiverso_tpu.telemetry.export import stop_reporter
         stop_reporter()
+        from multiverso_tpu.telemetry.ops import stop_ops
+        stop_ops()
         if self.server_engine is not None:
             try:
                 self.FinishTrain()
@@ -146,6 +156,14 @@ class Zoo:
         # later MV_Init world starts from a fresh plane
         from multiverso_tpu.serving import shutdown_plane
         shutdown_plane()
+        # one-flag postmortem: with -mv_diag_dir set, every world leaves
+        # its flight ring + telemetry sidecar + span trace on disk at
+        # teardown (failure paths already dumped the ring mid-flight)
+        try:
+            from multiverso_tpu.telemetry.ops import dump_diagnostics
+            dump_diagnostics()
+        except Exception as exc:   # diagnostics must never break Stop
+            Log.Error("Zoo.Stop: diagnostics dump failed: %r", exc)
         self.worker_tables.clear()
         self.server_tables.clear()
         self.started = False

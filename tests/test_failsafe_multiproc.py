@@ -86,7 +86,8 @@ SPEC = ("mailbox.drop:0.06,mailbox.dup:0.08,mailbox.delay:0.08@0.002,"
         "serving.overload:0.12,serving.delay:0.12@0.003")
 mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
             "-dist_size=2", "-mv_deadline_s=120", "-mv_max_retries=12",
-            f"-chaos_spec={SPEC}", "-chaos_seed=1234"])
+            f"-chaos_spec={SPEC}", "-chaos_seed=1234",
+            "-mv_ops_port=0"])
 R, C, STEPS, SERVE_STEPS = 48, 4, 30, 8
 mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
 rng = np.random.default_rng(100 + rank)
@@ -149,6 +150,28 @@ for t in readers:
     t.start()
 for step in range(SERVE_STEPS):
     train_step()
+    if step == 2:
+        # round 9: LIVE /metrics scrape mid-soak (training + chaos +
+        # serving all active). The handler serves a LOCAL snapshot and
+        # never issues collectives, so scraping from inside the chaos
+        # phase is safe by design — that is the acceptance claim.
+        import re as _re
+        import urllib.request as _url
+        from multiverso_tpu.telemetry import ops as _tops
+        _p = _tops.port()
+        assert _p is not None, "ops endpoint not running in soak"
+        _text = _url.urlopen(f"http://127.0.0.1:{_p}/metrics",
+                             timeout=30).read().decode()
+        _VAL = r"[-+]?(?:\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
+        _line = _re.compile(
+            r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*.*"
+            r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? " + _VAL + r")$")
+        for _ln in _text.strip().splitlines():
+            assert _line.match(_ln), f"bad prometheus line: {_ln!r}"
+        assert "mv_chaos_" in _text, "chaos counters missing from scrape"
+        assert "mv_engine_fence_" in _text
+        _h = _url.urlopen(f"http://127.0.0.1:{_p}/healthz", timeout=30)
+        assert _h.status == 200, "healthy soak world must scrape 200"
 stop.set()
 for t in readers:
     t.join(60)
@@ -286,6 +309,12 @@ if rank == 0:
         dt = time.monotonic() - t0
         assert dt < 12, f"pipeline deadline fired late: {dt}"
         assert "diagnostic bundle" in str(e), str(e)[:400]
+        # round 9: the bundle carries the flight-recorder tail — the
+        # same events a -mv_diag_dir dump would hold (the warm round's
+        # windows are in it)
+        assert "-- flight --" in str(e), str(e)[:400]
+        assert "window." in str(e).split("-- flight --", 1)[1], \
+            str(e).split("-- flight --", 1)[1][:400]
         # both stages drained + the actor poisoned: the NEXT verb fails
         # fast and typed instead of feeding a dead pipeline. The waiter
         # is failed BEFORE the actor loop finishes unwinding into its

@@ -1,0 +1,593 @@
+"""Ops plane (round 9): flight recorder, Prometheus exposition,
+/healthz, forensics correlation, and the 2% overhead guard.
+
+* flight recorder — ring bound + drop accounting, the
+  ``-mv_flight_events=0`` no-op gate, JSONL dump schema;
+* /metrics — text-exposition GRAMMAR checked line by line against the
+  Prometheus 0.0.4 format, counter monotonicity across two scrapes,
+  histogram bucket cumulativity + ``_count`` == the ``+Inf`` bucket;
+* /healthz — 200 while healthy, flipping to 503 the moment the engine
+  actor poisons (driven through the real actor-death path);
+* forensics — ``correlate()`` pinpoints the first diverging exchange
+  SEQ (unit-level synthetic dumps + the live 2-proc drill, which
+  injects a single-rank verb transient through the chaos streams);
+* overhead guard — the blocking host round with the recorder at its
+  always-on default must stay within 2% of ``-mv_flight_events=0``
+  (noise-bracketed: the baseline is measured twice around the
+  flight-on run so scheduler jitter can't fail a healthy build).
+"""
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.telemetry import flight, forensics, metrics, ops
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+from tests.test_multihost import run_two_process
+
+
+def _scrape(path: str) -> tuple:
+    port = ops.port()
+    assert port is not None, "ops endpoint not running"
+    resp = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10)
+    return resp.status, resp.read().decode()
+
+
+# -- flight recorder ----------------------------------------------------
+
+
+class TestFlightRecorder:
+    def setup_method(self):
+        flight._reset_for_tests()
+
+    def teardown_method(self):
+        SetCMDFlag("mv_flight_events", 4096)
+        flight._reset_for_tests()
+
+    def test_ring_bound_and_drop_accounting(self):
+        SetCMDFlag("mv_flight_events", 8)
+        for i in range(20):
+            flight.record("test.event", seq=i, detail=f"e{i}")
+        events = flight.events()
+        assert len(events) == 8
+        # newest kept, oldest dropped, order preserved
+        assert [e["seq"] for e in events] == list(range(12, 20))
+        recorded, dropped = flight.stats()
+        assert recorded == 20 and dropped == 12
+        assert flight.last_detail("test.event") == "e19"
+        assert flight.last_detail("absent.kind") is None
+
+    def test_zero_capacity_is_a_noop_gate(self):
+        SetCMDFlag("mv_flight_events", 0)
+        assert not flight.enabled()
+        for i in range(10):
+            flight.record("test.event", seq=i)
+        assert flight.stats() == (0, 0)
+        assert flight.events() == []
+
+    def test_dump_jsonl_schema_and_load(self, tmp_path):
+        flight.record("window.exchanged", seq=3, epoch=2, detail="A0,G1")
+        flight.record("fence", seq=4, detail="depth")
+        path = str(tmp_path / "ring.jsonl")
+        assert flight.dump(path) == path
+        lines = [json.loads(ln) for ln in
+                 open(path).read().strip().splitlines()]
+        assert lines[0]["flight_header"] == 1
+        assert lines[0]["recorded"] == 2 and lines[0]["dropped"] == 0
+        assert "rank" in lines[0] and "pid" in lines[0]
+        assert [e["kind"] for e in lines[1:]] == ["window.exchanged",
+                                                  "fence"]
+        loaded = forensics.load(path)
+        assert loaded["rank"] == 0
+        assert len(loaded["events"]) == 2
+
+    def test_bundle_carries_the_flight_tail(self):
+        from multiverso_tpu.failsafe import diagnostics
+        flight.record("window.exchanged", seq=7, detail="A0")
+        text = diagnostics.bundle("test failure")
+        assert "-- flight --" in text
+        assert "window.exchanged seq=7" in text
+        SetCMDFlag("mv_flight_events", 0)
+        assert "flight recorder off" in diagnostics.bundle("again")
+
+
+# -- forensics ----------------------------------------------------------
+
+
+def _write_dump(path, rank, events, dropped=0):
+    with open(path, "w") as f:
+        f.write(json.dumps({"flight_header": 1, "rank": rank,
+                            "pid": 1,
+                            "recorded": len(events) + dropped,
+                            "dropped": dropped}) + "\n")
+        for kind, seq, detail in events:
+            f.write(json.dumps({"t": 0.0, "kind": kind, "seq": seq,
+                                "epoch": -1, "detail": detail}) + "\n")
+
+
+class TestForensicsCorrelate:
+    def test_pinpoints_first_diverging_seq_and_verbs(self, tmp_path):
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", 0, "A0"),
+                            ("window.exchanged", 1, "A0,G0"),
+                            ("window.exchanged", 2, "A1")])
+        _write_dump(p1, 1, [("window.exchanged", 0, "A0"),
+                            ("window.exchanged", 1, "A0,G0"),
+                            ("window.exchanged", 2, "A0")])
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] is True
+        assert report["seq"] == 2
+        assert report["agreed_through"] == 1
+        assert report["per_rank"][0] == "window.exchanged:A1"
+        assert report["per_rank"][1] == "window.exchanged:A0"
+        text = forensics.report_text(report)
+        assert "SEQ 2" in text and "rank 0" in text
+
+    def test_barrier_vs_verb_mismatch_diverges(self, tmp_path):
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", 0, "A0"),
+                            ("barrier", 1, "Request_StoreLoad")])
+        _write_dump(p1, 1, [("window.exchanged", 0, "A0"),
+                            ("window.exchanged", 1, "A0")])
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] and report["seq"] == 1
+        assert report["per_rank"][0].startswith("barrier:")
+        assert report["per_rank"][1].startswith("window.exchanged:")
+
+    def test_agreeing_streams_do_not_diverge(self, tmp_path):
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        evs = [("window.exchanged", i, "A0") for i in range(4)]
+        _write_dump(p0, 0, evs)
+        _write_dump(p1, 1, evs)
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] is False
+        assert report["agreed_through"] == 3
+        assert forensics.main([p0, p1]) == 0
+
+    def test_shorter_dump_without_a_hole_is_not_divergence(self, tmp_path):
+        # rank 1 simply died earlier: its dump ends at seq 1 with no
+        # later activity — that is loss, not stream divergence
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", i, "A0")
+                            for i in range(4)])
+        _write_dump(p1, 1, [("window.exchanged", i, "A0")
+                            for i in range(2)])
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] is False
+        assert report["agreed_through"] == 1
+
+    def test_hole_in_one_stream_is_divergence(self, tmp_path):
+        # rank 1 skipped seq 1 but exchanged seq 2: a hole, not a tail
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", i, "A0")
+                            for i in range(3)])
+        _write_dump(p1, 1, [("window.exchanged", 0, "A0"),
+                            ("window.exchanged", 2, "A0")])
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] and report["seq"] == 1
+        assert forensics.main([p0, p1]) == 1
+
+    def test_ring_eviction_front_truncation_is_not_divergence(
+            self, tmp_path):
+        # rank 1's bounded ring aged out seqs 0-1 (dropped > 0 in its
+        # header) — a long-running rank with extra local events, not a
+        # diverged stream: the healthy overlap (2..4) must agree
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", i, "A0")
+                            for i in range(5)])
+        _write_dump(p1, 1, [("window.exchanged", i, "A0")
+                            for i in range(2, 5)], dropped=7)
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] is False, report
+        assert report["agreed_through"] == 4
+
+    def test_front_missing_without_drops_is_divergence(self, tmp_path):
+        # same shape but rank 1 dropped NOTHING: the missing leading
+        # seqs cannot be ring eviction — that IS a stream divergence
+        p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+        _write_dump(p0, 0, [("window.exchanged", i, "A0")
+                            for i in range(5)])
+        _write_dump(p1, 1, [("window.exchanged", i, "A0")
+                            for i in range(2, 5)], dropped=0)
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] and report["seq"] == 0
+
+
+# -- Prometheus exposition + healthz ------------------------------------
+
+#: exposition grammar (text format 0.0.4): TYPE/HELP comments + samples
+_VALUE = r"[-+]?(?:\d+(?:\.\d+)?(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?)"
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABELS = (r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\""
+           r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\")*\}")
+_TYPE_RE = re.compile(rf"^# TYPE {_NAME} (?:counter|gauge|histogram)$")
+_HELP_RE = re.compile(rf"^# HELP {_NAME} .*$")
+_SAMPLE_RE = re.compile(rf"^({_NAME})(?:{_LABELS})? {_VALUE}$")
+
+
+def check_prometheus_grammar(text: str) -> dict:
+    """Assert every line parses; return the family types + samples."""
+    types = {}
+    samples = {}
+    for ln in text.strip().splitlines():
+        if ln.startswith("# TYPE"):
+            assert _TYPE_RE.match(ln), f"bad TYPE line: {ln!r}"
+            _, _, name, kind = ln.split()
+            types[name] = kind
+            continue
+        if ln.startswith("#"):
+            assert _HELP_RE.match(ln), f"bad comment line: {ln!r}"
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"bad sample line: {ln!r}"
+        samples[ln.rsplit(" ", 1)[0]] = float(ln.rsplit(" ", 1)[1])
+        # every sample belongs to a declared family
+        base = m.group(1)
+        family = re.sub(r"_(bucket|sum|count)$", "", base)
+        assert base in types or family in types, \
+            f"sample without TYPE declaration: {ln!r}"
+    return {"types": types, "samples": samples}
+
+
+class TestPrometheusExposition:
+    def test_scrape_parses_and_counters_are_monotonic(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init(["-mv_ops_port=0"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            d = np.ones((8, 4), np.float32)
+            table.AddRows(ids, d)
+            table.GetRows(ids)
+            status, text1 = _scrape("/metrics")
+            assert status == 200
+            parsed1 = check_prometheus_grammar(text1)
+            # the fence-cause breakdown is registered eagerly: the
+            # whole taxonomy is visible at zero from the first scrape
+            for cause in ("barrier", "nonlocal_table", "device_wire",
+                          "depth"):
+                assert f"mv_engine_fence_{cause}" in parsed1["types"]
+            assert parsed1["types"]["mv_engine_fence_barrier"] == "counter"
+            # more work, then scrape again: counters are monotonic
+            for _ in range(3):
+                table.AddRows(ids, d)
+                table.GetRows(ids)
+            _, text2 = _scrape("/metrics")
+            parsed2 = check_prometheus_grammar(text2)
+            counters = [n for n, k in parsed1["types"].items()
+                        if k == "counter"]
+            assert counters, "no counters scraped"
+            for name in counters:
+                v1 = parsed1["samples"].get(name)
+                v2 = parsed2["samples"].get(name)
+                assert v1 is not None and v2 is not None, name
+                assert v2 >= v1, (name, v1, v2)
+            moved = [n for n in counters
+                     if parsed2["samples"][n] > parsed1["samples"][n]]
+            assert moved, "no counter advanced between scrapes"
+        finally:
+            mv.MV_ShutDown()
+
+    def test_histograms_expose_cumulative_buckets(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init(["-mv_ops_port=0"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            for _ in range(4):
+                table.AddRows(ids, np.ones((8, 4), np.float32))
+            status, text = _scrape("/metrics")
+            assert status == 200
+            parsed = check_prometheus_grammar(text)
+            hist_families = [n for n, k in parsed["types"].items()
+                             if k == "histogram"]
+            assert "mv_server_window_latency_s" in hist_families
+            for fam in hist_families:
+                buckets = []
+                inf_val = None
+                for key, val in parsed["samples"].items():
+                    if key.startswith(f"{fam}_bucket{{"):
+                        if 'le="+Inf"' in key:
+                            inf_val = val
+                        else:
+                            le = float(key.split('le="')[1].split('"')[0])
+                            buckets.append((le, val))
+                count = parsed["samples"].get(f"{fam}_count")
+                assert count is not None, fam
+                assert f"{fam}_sum" in parsed["samples"], fam
+                assert inf_val is not None, f"{fam} missing +Inf bucket"
+                assert inf_val == count, (fam, inf_val, count)
+                buckets.sort()
+                vals = [v for _, v in buckets]
+                assert vals == sorted(vals), f"{fam} not cumulative"
+                if vals:
+                    assert vals[-1] <= count
+        finally:
+            mv.MV_ShutDown()
+
+    def test_ephemeral_port_and_thread_lifecycle(self):
+        """-mv_ops_port=0 picks an ephemeral port per world and
+        Zoo.Stop joins the thread: back-to-back worlds never collide
+        on a port or leak the HTTP daemon."""
+        import threading
+        for _ in range(2):
+            mv.MV_Init(["-mv_ops_port=0"])
+            try:
+                assert ops.port() is not None
+                status, _ = _scrape("/healthz")
+                assert status == 200
+            finally:
+                mv.MV_ShutDown()
+            assert ops.port() is None
+        time.sleep(0.1)
+        leaked = [t for t in threading.enumerate()
+                  if t.name == "mv-ops-http"]
+        assert not leaked, leaked
+
+
+class TestHealthz:
+    def test_flips_to_503_when_engine_poisons(self):
+        from multiverso_tpu.message import Message, MsgType
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        mv.MV_Init(["-mv_ops_port=0"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                        num_cols=4))
+            table.AddRows(np.arange(4, dtype=np.int32),
+                          np.ones((4, 4), np.float32))
+            status, body = _scrape("/healthz")
+            assert status == 200
+            rep = json.loads(body)
+            assert rep["healthy"] is True
+            assert rep["engine"]["poisoned"] is None
+            assert rep["flight"]["recorded"] >= 1
+            # poison through the REAL actor-death path: a handler
+            # raising an mv_fatal error kills the loop thread
+            eng = Zoo.Get().server_engine
+
+            def boom(msg):
+                exc = RuntimeError("test: fatal engine fault")
+                exc.mv_fatal = True
+                raise exc
+
+            eng.RegisterHandler(MsgType.Default, boom)
+            eng.Receive(Message(msg_type=MsgType.Default))
+            t0 = time.monotonic()
+            while eng._poison is None and time.monotonic() - t0 < 10:
+                time.sleep(0.02)
+            assert eng._poison is not None, "engine never poisoned"
+            try:
+                _scrape("/healthz")
+                raise AssertionError("healthz stayed 200 after poison")
+            except urllib.error.HTTPError as e:
+                status2, body2 = e.code, e.read().decode()
+            assert status2 == 503
+            rep2 = json.loads(body2)
+            assert rep2["healthy"] is False
+            assert any("poisoned" in r for r in rep2["reasons"])
+            # the poison itself is a flight event
+            assert flight.last_detail("actor.poison") is not None
+        finally:
+            mv.MV_ShutDown()    # bounded teardown past a dead engine
+
+
+class TestOpsObservabilitySurfaces:
+    def test_fence_taxonomy_registered_eagerly_and_reported(self):
+        """The -stats_interval_s reporter logs the local snapshot; the
+        fence-cause breakdown must be in it from engine start (at
+        zero), not only after the first fence."""
+        from multiverso_tpu.telemetry.export import StatsReporter
+        mv.MV_Init([])
+        try:
+            snap = metrics.snapshot()
+            for cause in ("barrier", "nonlocal_table", "device_wire",
+                          "depth"):
+                assert snap.get(f"engine.fence.{cause}", {}).get(
+                    "type") == "counter", sorted(snap)
+            assert snap.get("engine.fence.stall_s", {}).get(
+                "type") == "histogram"
+            StatsReporter(60.0).emit()  # must not raise; rides the log
+        finally:
+            mv.MV_ShutDown()
+
+    def test_dashboard_ops_line(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.utils.dashboard import Dashboard
+        mv.MV_Init(["-mv_ops_port=0"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                        num_cols=4))
+            table.AddRows(np.arange(4, dtype=np.int32),
+                          np.ones((4, 4), np.float32))
+            out = Dashboard.DisplayAll()
+            ops_lines = [ln for ln in out.splitlines()
+                         if ln.startswith("[Ops]")]
+            assert len(ops_lines) == 1, out
+            line = ops_lines[0]
+            assert "recorded" in line and "dropped" in line
+            assert f"ops_port = {ops.port()}" in line
+            assert "last_fence" in line
+        finally:
+            mv.MV_ShutDown()
+
+    def test_diag_dir_bundles_all_artifacts(self, tmp_path):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init([f"-mv_diag_dir={tmp_path}"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=16,
+                                                        num_cols=4))
+            table.AddRows(np.arange(4, dtype=np.int32),
+                          np.ones((4, 4), np.float32))
+        finally:
+            mv.MV_ShutDown()
+        # one flag -> the complete postmortem layout at teardown
+        assert (tmp_path / "flight_rank0.jsonl").exists()
+        assert (tmp_path / "telemetry_rank0.json").exists()
+        assert (tmp_path / "trace_rank0.json").exists()
+        loaded = forensics.load(str(tmp_path / "flight_rank0.jsonl"))
+        assert any(e["kind"] == "window.applied"
+                   for e in loaded["events"])
+        snap = json.loads((tmp_path / "telemetry_rank0.json").read_text())
+        assert "server.window.verbs" in snap
+
+
+# -- the 2% overhead guard ----------------------------------------------
+
+
+class TestFlightOverheadGuard:
+    def test_blocking_round_overhead_within_2pct(self):
+        """Tier-1 guard: the always-on recorder must cost <= 2% on the
+        blocking host round vs -mv_flight_events=0. The baseline is
+        measured TWICE, bracketing the flight-on run, and the
+        allowance widens to the observed baseline noise when the
+        machine is noisier than the budget — a healthy build cannot
+        flake on scheduler jitter, a regression past both bars still
+        fails."""
+        from multiverso_tpu.tables import MatrixTableOption
+
+        k, rounds = 512, 15
+        rng = np.random.default_rng(7)
+
+        def measure(argv):
+            mv.MV_Init(list(argv))
+            try:
+                table = mv.MV_CreateTable(MatrixTableOption(
+                    num_rows=8192, num_cols=8))
+                ids = rng.choice(8192, size=k,
+                                 replace=False).astype(np.int32)
+                deltas = rng.standard_normal((k, 8)).astype(np.float32)
+                table.AddRows(ids, deltas)      # warm the jit caches
+                table.GetRows(ids)
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        table.AddRows(ids, deltas)
+                        table.GetRows(ids)
+                    best = min(best, time.perf_counter() - t0)
+            finally:
+                mv.MV_ShutDown()
+            return best / rounds
+
+        # alternate off/on worlds, best per side: per-world session
+        # noise runs ±5-10% on this round — interleaving with min-of-2
+        # measures the true delta, not the world-ordering noise
+        offs, ons = [], []
+        for _ in range(2):
+            offs.append(measure(["-mv_flight_events=0"]))
+            ons.append(measure([]))
+        base, on = min(offs), min(ons)
+        noise_pct = 100.0 * (max(offs) - base) / base
+        overhead_pct = 100.0 * (on - base) / base
+        allowed = max(2.0, 2.0 * noise_pct)
+        assert overhead_pct <= allowed, (
+            f"flight recorder overhead {overhead_pct:.2f}% exceeds "
+            f"{allowed:.2f}% (baseline noise {noise_pct:.2f}%; "
+            f"off={[round(o * 1e6) for o in offs]}us, "
+            f"on={[round(o * 1e6) for o in ons]}us per round)")
+
+
+# -- 2-proc forensics drill ---------------------------------------------
+
+_HDR = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+'''
+
+_FORENSICS_CHILD = _HDR + r'''
+import time
+from multiverso_tpu.failsafe.errors import TransientError
+from multiverso_tpu.tables import MatrixTableOption
+
+diag = sys.argv[3]
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=30", "-mv_max_retries=0",
+            f"-mv_diag_dir={diag}"])
+tab0 = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=4))
+tab1 = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=4))
+ids = np.arange(4, dtype=np.int32)
+d = np.ones((4, 4), np.float32)
+# lockstep warm rounds: the rings gain an AGREEING prefix (seq 0..3)
+for _ in range(2):
+    tab0.AddRows(ids, d)
+    tab1.AddRows(ids, d)
+mv.MV_Barrier()
+# THE INJECTION: rank 0 arms a deterministic verb transient
+# (prob 1.0, -mv_max_retries=0) for exactly its next tracked Add, so
+# that verb never becomes a stream position on rank 0 ONLY; rank 1
+# issues it normally. Rank 0's next verb is then table 1's Add while
+# rank 1 is at table 0's — the exchanged window descriptors differ and
+# the SPMD divergence CHECK fires on BOTH ranks, each dumping its ring
+# under -mv_diag_dir.
+diverged = False
+try:
+    if rank == 0:
+        mv.MV_SetFlag("chaos_spec", "verb.transient:1.0")
+        try:
+            tab0.AddRows(ids, d)
+            raise AssertionError("chaos did not reject the verb")
+        except TransientError:
+            pass
+        mv.MV_SetFlag("chaos_spec", "")
+        tab1.AddRows(ids, d)
+    else:
+        tab0.AddRows(ids, d)
+        tab1.AddRows(ids, d)
+except Exception as e:
+    diverged = True
+    print(f"child {rank} DIVERGENCE-TYPED {type(e).__name__}",
+          flush=True)
+assert diverged, "single-rank stream divergence never surfaced"
+path = os.path.join(diag, f"flight_rank{rank}.jsonl")
+t0 = time.monotonic()
+while not os.path.exists(path) and time.monotonic() - t0 < 10:
+    time.sleep(0.05)
+assert os.path.exists(path), "flight ring was not dumped on divergence"
+print(f"child {rank} FORENSICS OK", flush=True)
+os._exit(0)
+'''
+
+
+class TestForensicsDrill:
+    def test_single_rank_divergence_is_pinpointed(self, tmp_path):
+        """Acceptance (round 9): a deterministic single-rank verb
+        transient desyncs the 2-proc verb streams; both ranks dump
+        their rings on the divergence CHECK, and correlate() names the
+        exact first diverging exchange SEQ with each rank's verb at
+        that position."""
+        run_two_process(_FORENSICS_CHILD, tmp_path, str(tmp_path),
+                        expect="FORENSICS OK")
+        p0 = str(tmp_path / "flight_rank0.jsonl")
+        p1 = str(tmp_path / "flight_rank1.jsonl")
+        assert os.path.exists(p0) and os.path.exists(p1)
+        report = forensics.correlate([p0, p1])
+        assert report["diverged"] is True, report
+        # 4 lockstep warm exchanges agree (seq 0..3); the injected
+        # divergence is the very next exchange
+        assert report["agreed_through"] == 3, report
+        assert report["seq"] == 4, report
+        # ...and the report names each rank's differing verb: rank 0
+        # skipped table 0's Add (chaos) and exchanged table 1's; rank 1
+        # exchanged table 0's
+        assert report["per_rank"][0] == "window.exchanged:A1", report
+        assert report["per_rank"][1] == "window.exchanged:A0", report
+        assert forensics.main([p0, p1]) == 1
+        text = forensics.report_text(report)
+        assert "SEQ 4" in text

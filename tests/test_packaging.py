@@ -45,10 +45,13 @@ class TestWheel:
         names = zipfile.ZipFile(wheel).namelist()
         assert "multiverso_tpu/native/libmultiverso_tpu.so" in names, (
             "wheel must carry the native runtime when a toolchain exists")
-        # and the full package tree (incl. the round-8 serving subpackage)
+        # and the full package tree (incl. the round-8 serving subpackage
+        # and the round-9 ops-plane modules)
         assert any(n == "multiverso_tpu/api.py" for n in names)
         assert any(n.startswith("multiverso_tpu/tables/") for n in names)
         assert any(n.startswith("multiverso_tpu/serving/") for n in names)
+        for mod in ("flight", "ops", "forensics"):
+            assert f"multiverso_tpu/telemetry/{mod}.py" in names, names
 
     def test_install_and_import_in_clean_venv(self, wheel, tmp_path):
         env_dir = tmp_path / "venv"

@@ -309,6 +309,11 @@ class TestNoBarePrintLint:
         # the logger like everything else
         assert any(rel.startswith("serving") for rel in scanned), \
             sorted(scanned)
+        # ...and the ops-plane modules (round 9): the forensics CLI and
+        # the HTTP handler both emit text and must ride the logger too
+        for need in ("flight.py", "ops.py", "forensics.py"):
+            assert os.path.join("telemetry", need) in scanned, \
+                sorted(scanned)
         assert not offenders, (
             "bare print() in the package — route output through "
             "utils/log.py or the telemetry exporters:\n"
