@@ -1839,6 +1839,96 @@ print(f"child {rank} KV BENCH OK", flush=True)
 '''
 
 
+_NPROC_ELASTIC_CHILD = r'''
+import os, sys, time, json
+rank, port, nproc, port2 = (int(sys.argv[1]), sys.argv[2],
+                            int(sys.argv[3]), sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+
+# the rebalance pause is what the verb stream pays for an epoch
+# transition: fence + cut rendezvous + capture + (join: shard move +
+# peer rebuild) + mesh/table rebuild + commit. Measured on the
+# SURVIVOR's side — the member whose training loop actually stalls.
+R, C, WARM = 4096, 64, 6
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=60", "-mv_elastic=true",
+            f"-mv_elastic_addr=127.0.0.1:{port2}", "-mv_ops_port=0"])
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+ids = np.arange(64, dtype=np.int32)
+d = np.ones((64, C), np.float32)
+for _ in range(WARM):
+    mat.AddRows(ids, d)
+assert mv.MV_ElasticSync() == 0          # warm sync (cut capture cost)
+if rank == 1:
+    mv.MV_ElasticLeave()                 # drain 2 -> 1
+    mv.MV_ElasticJoin()                  # re-admit 1 -> 2
+else:
+    t0 = time.perf_counter()
+    assert mv.MV_ElasticSync() == 1      # applies the drain
+    drain_ms = (time.perf_counter() - t0) * 1e3
+    for _ in range(WARM):
+        mat.AddRows(ids, d)              # solo training between epochs
+    # admit rank 1 back: its JOIN staging RPC races the solo sync, so
+    # poll — the measured pause is the ONE sync that performed the
+    # transition, not the no-op polls before it
+    while True:
+        t0 = time.perf_counter()
+        ep = mv.MV_ElasticSync()
+        join_ms = (time.perf_counter() - t0) * 1e3
+        if ep == 2:
+            break
+        time.sleep(0.02)
+for _ in range(WARM):
+    mat.AddRows(ids, d)                  # re-formed world trains again
+mv.MV_Barrier()
+mv.MV_ShutDown()
+if rank == 0:
+    print("NPROC_RESULT " + json.dumps({
+        "drain_pause_ms": round(drain_ms, 2),
+        "join_pause_ms": round(join_ms, 2),
+        "table_bytes": R * C * 4,
+    }), flush=True)
+print(f"child {rank} ELASTIC BENCH OK", flush=True)
+'''
+
+
+def elastic_numbers() -> dict:
+    """--elastic: the rebalance-pause section (round 10). Wall-time the
+    verb stream is fenced during a 2->1 drain and a 1->2 re-admission
+    of a 1MiB (4096x64 f32) matrix world; ``elastic_rebalance_pause_ms`` (the
+    worse of the two) joins the tier-1 guard with a ceiling — a
+    regression here means membership transitions started stalling
+    training."""
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port2 = s.getsockname()[1]
+    s.close()
+    res = _launch_nproc(_NPROC_ELASTIC_CHILD, 2, port2)
+    out = {
+        "elastic_drain_pause_ms": res["drain_pause_ms"],
+        "elastic_join_pause_ms": res["join_pause_ms"],
+        "elastic_rebalance_pause_ms": round(
+            max(res["drain_pause_ms"], res["join_pause_ms"]), 2),
+        "elastic_note": (
+            "pause = wall the survivor's MV_ElasticSync stalls the "
+            "verb stream for one epoch transition of a "
+            f"{res['table_bytes'] >> 20}MiB matrix world: fence + cut "
+            "rendezvous + snapshot-cut capture + mesh/table rebuild "
+            "(+ join: CRC'd shard move through the coordinator and "
+            "the joiner's rebuild+commit). Drain is capture+rebuild "
+            "bound; join adds the move wire, so it is the guarded "
+            "worst case."),
+    }
+    return out
+
+
 def _launch_nproc(child_src: str, nproc: int, *extra,
                   timeout: int = 280) -> dict:
     """Launch ``nproc`` CPU-backend children (tests/test_multihost.py
@@ -1949,6 +2039,8 @@ def two_proc_numbers() -> dict:
     # serving plane (round 8): snapshot lookups vs blocking Gets under
     # concurrent readers — the read tier's scale-out headline
     out.update(serving_two_proc_numbers())
+    # elastic plane (round 10): the rebalance-pause guard metric
+    out.update(elastic_numbers())
     res = _launch_nproc(_NPROC_KV_CHILD, 2)
     out["kv_burst_2proc_per_proc_Melem_s"] = res["burst_per_proc_Melem_s"]
     out["kv_burst_2proc_collectives_per_op"] = res[
@@ -2084,7 +2176,8 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
             "matrix_table_2proc_host_per_proc_Melem_s",
             "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec",
             "serving_lookup_qps", "serving_lookup_p99_ms",
-            "serving_lookup_2proc_qps", "serving_lookup_2proc_p99_ms")
+            "serving_lookup_2proc_qps", "serving_lookup_2proc_p99_ms",
+            "elastic_rebalance_pause_ms")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key
@@ -2138,6 +2231,25 @@ def serving_section_main() -> int:
 if __name__ == "__main__":
     if sys.argv[1:2] == ["--update-guard"]:
         sys.exit(update_guard(*sys.argv[2:3]))
+    if sys.argv[1:2] == ["--elastic"]:
+        # standalone elastic rebalance-pause section (CPU subprocesses),
+        # merged into the artifact when platform/host match (the
+        # --serving pattern)
+        res = elastic_numbers()
+        try:
+            with open(FULL_JSON_PATH) as f:
+                data = json.load(f)
+        except Exception:
+            data = None
+        if (data is not None and data.get("platform") == "cpu"
+                and data.get("host_cores") == os.cpu_count()):
+            data.update(res)
+            with open(FULL_JSON_PATH, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"merged elastic metrics into {FULL_JSON_PATH}")
+        print(json.dumps(res, indent=1, sort_keys=True))
+        sys.exit(0)
     if sys.argv[1:2] == ["--serving"]:
         sys.exit(serving_section_main())
     if sys.argv[1:2] == ["--update-doc"]:

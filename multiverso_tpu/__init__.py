@@ -52,6 +52,11 @@ from multiverso_tpu.api import (  # noqa: F401
     MV_DumpTrace,
     MV_DumpFlightRecorder,
     MV_DumpDiagnostics,
+    MV_ElasticSync,
+    MV_ElasticLeave,
+    MV_ElasticJoin,
+    MV_ElasticEpoch,
+    MV_ElasticMembers,
     MV_WorkerContext,
 )
 

@@ -278,6 +278,53 @@ def MV_DumpFlightRecorder(path: str) -> str:
     return flight.dump(path)
 
 
+def MV_ElasticSync() -> int:
+    """Elastic sync point (requires ``-mv_elastic``): a LOCKSTEP
+    rendezvous every active member calls at the same loop position.
+    Applies at most one staged membership transition (drain / admit)
+    at a fenced window-stream cut and always refreshes the retained
+    snapshot cut (the silent-death rollback anchor). Returns the
+    membership epoch in effect."""
+    from multiverso_tpu import elastic
+    return elastic.sync()
+
+
+def MV_ElasticLeave() -> int:
+    """Gracefully drain THIS member from the running world: stages the
+    departure and runs the final collective sync that applies it (the
+    other members reach the same position via ``MV_ElasticSync``).
+    The process stays alive — ``MV_ElasticJoin`` re-admits it later.
+    Returns the epoch departed at."""
+    from multiverso_tpu import elastic
+    return elastic.leave()
+
+
+def MV_ElasticJoin() -> int:
+    """(Re)admission of a departed member: stages the join, parks until
+    the live members reach a sync point, downloads every table from
+    the shard-move plane (the snapshot cut the world fenced at),
+    rebuilds them on the new world's mesh and commits. Returns the
+    epoch joined at."""
+    from multiverso_tpu import elastic
+    # unbounded-ok: every RPC inside elastic.join() is bounded by the
+    # elastic control timeout (the joiner legitimately parks until the
+    # live members reach their next sync point)
+    return elastic.join()
+
+
+def MV_ElasticEpoch() -> int:
+    """The membership epoch in effect (0 = boot world / plane off)."""
+    from multiverso_tpu import elastic
+    return elastic.epoch()
+
+
+def MV_ElasticMembers() -> tuple:
+    """Boot ranks of the current world's members (empty tuple when the
+    elastic plane is off)."""
+    from multiverso_tpu import elastic
+    return elastic.members()
+
+
 def MV_DumpDiagnostics(dir_path: Optional[str] = None) -> Optional[str]:
     """Write the complete postmortem artifact set — flight ring
     (``flight_rank<R>.jsonl``), local telemetry snapshot

@@ -129,6 +129,28 @@ def _read_table(stream: Stream, table) -> None:
         replace, table.state["aux"])
 
 
+def write_table_frame(table, table_id: int = 0) -> bytes:
+    """ONE table's complete logical state (Store payload + updater aux
+    leaves in mesh-independent layout) as a self-contained byte frame —
+    the unit the elastic plane captures at a cut, splits into row
+    shards for the move wire, and restores from on an epoch's new mesh
+    (elastic/rebalance.py). Same format as one table's slice of a
+    checkpoint file, so the two serializations cannot drift."""
+    buf = _io.BytesIO()
+    _write_table(Stream(buf, f"<frame {table_id}>"), table_id, table)
+    return buf.getvalue()
+
+
+def read_table_frame(table, blob: bytes) -> None:
+    """Restore ``table`` from a :func:`write_table_frame` blob. The
+    table's live mesh/sharding may differ from the writer's — values
+    and aux re-place with the live shardings, exactly like a checkpoint
+    load onto a different mesh size."""
+    stream = Stream(_io.BytesIO(blob), "<frame>")
+    stream.ReadInt()                    # table_id (caller's bookkeeping)
+    _read_table(stream, table)
+
+
 def _quiesce(zoo) -> None:
     """Drain the engine mailbox, then (multihost) barrier: no in-flight
     async Add may still be issuing collectives on any process's engine

@@ -1,0 +1,756 @@
+"""Membership coordinator (rank-0 authority) + member client.
+
+The reference's world is frozen at ``MV_Init``: ``zoo.cpp`` registers a
+fixed rank set and every peer address is known forever (SURVEY.md §1).
+The elastic plane replaces that with the OSDI'14 parameter-server
+membership model: ONE authority (hosted by boot rank 0, the same
+process that already hosts the ``jax.distributed`` coordinator) owns an
+**epoch-numbered view** — the set of live members and the shard→owner
+map — and every membership change is a staged transition applied at a
+fenced stream cut, never an in-place mutation.
+
+Protocol: length-framed pickled dicts over TCP with a CRC32 trailer per
+frame (the same corruption posture as the window wire, parallel/
+wire.py) — a torn or bit-flipped control frame raises instead of
+silently desyncing the membership state machine. Every operation is
+idempotent or rendezvous-shaped, so the client may retry transients
+(the ``membership.*`` chaos sites rehearse exactly that):
+
+* ``register``    — boot member announces itself (plane start).
+* ``hb``          — heartbeat: refreshes the member's lease. A member
+                    whose lease expires is declared DEAD by whichever
+                    wait (``dead_check``, ``sync``, ``xchg``) next
+                    evaluates leases — silent deaths ride the SAME
+                    deadline machinery the failsafe subsystem already
+                    uses for collectives (the engine's exchange
+                    deadline is what prompts the ``dead_check``).
+* ``leave`` / ``join`` — stage a graceful drain / (re)admission; the
+                    change applies at the next sync rendezvous.
+* ``sync``        — lockstep rendezvous of all active members (the
+                    app-paced elastic sync point): computes at most one
+                    transition per rendezvous index and answers every
+                    member identically.
+* ``cut``         — fence rendezvous: old-view members report the
+                    engine stream SEQ they fenced at; all must agree
+                    (the window-stream cut the rebalance ships from).
+* ``manifest`` / ``shard_put`` / ``shard_get`` — the shard move plane:
+                    owners publish CRC-framed shard blobs keyed by
+                    ``(epoch, table, shard)``; re-delivery of a key is
+                    deduped (at-most-once, like the verb wire's
+                    ``(src, msg_id)`` window); joiners block-fetch.
+* ``commit``      — rendezvous of every NEW-view member; installs the
+                    epoch as current and frees the shard store.
+* ``joiner_wait`` — a (re)joining member parks here until a transition
+                    admitting it is staged and its manifest published.
+* ``xchg`` / ``gbar`` — the post-transition group transport: an
+                    allgather-bytes / barrier among the CURRENT view's
+                    members, relayed through the authority (the boot
+                    world's gloo collectives cannot subset the world;
+                    after any transition the group rides this relay).
+* ``state``       — observability snapshot for /healthz + dashboards.
+
+Coordinator failover is out of scope (as is the jax.distributed
+coordinator's): rank 0 cannot drain, and its death ends the world.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, Optional
+
+from multiverso_tpu.failsafe.errors import (MembershipChanged,
+                                            TransientError, WireCorruption)
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.log import CHECK, Log
+
+_LEN = struct.Struct("<I")
+
+#: cap on one control/shard frame (guards the length prefix against
+#: reading garbage as a gigabyte allocation)
+_MAX_FRAME = 1 << 31
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    sock.sendall(_LEN.pack(len(body)) + body + _LEN.pack(crc))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("membership peer closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket):
+    n = _LEN.unpack(_recv_exact(sock, 4))[0]
+    CHECK(0 < n < _MAX_FRAME, f"membership frame length insane: {n}")
+    body = _recv_exact(sock, n)
+    crc = _LEN.unpack(_recv_exact(sock, 4))[0]
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        tmetrics.counter("wire.crc_failures").inc()
+        raise WireCorruption(
+            f"membership control frame failed CRC32 ({n} bytes)")
+    return pickle.loads(body)
+
+
+class _MemberRec:
+    __slots__ = ("rank", "status", "last_hb", "lease_s")
+
+    def __init__(self, rank: int, lease_s: float):
+        self.rank = rank
+        self.status = "active"        # active | left | dead | reaped
+        self.last_hb = time.monotonic()
+        self.lease_s = lease_s
+
+    def expired(self, now: float) -> bool:
+        return (self.status == "active"
+                and now - self.last_hb > self.lease_s)
+
+
+class Coordinator:
+    """The rank-0 membership authority. Thread-per-connection TCP
+    server; all state under one lock + condition (rendezvous ops wait
+    on it). Never issues collectives itself — it is pure control
+    plane."""
+
+    def __init__(self, host: str, port: int, lease_s: float):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._lease_s = float(lease_s)
+        self.epoch = 0
+        self.members: Dict[int, _MemberRec] = {}
+        self._pending_join: set = set()
+        self._pending_leave: set = set()
+        #: staged (not yet committed) transition, or None
+        self._transition: Optional[dict] = None
+        #: sync rendezvous bookkeeping. Generations are SERVER-assigned
+        #: (member's n-th sync call joins generation n): a re-admitted
+        #: member's counter re-aligns to the admitting generation at
+        #: install, so rejoined worlds rendezvous without the members
+        #: having to agree on call counts out of band.
+        self._sync_counts: Dict[int, int] = {}
+        self._sync_arrived: Dict[int, set] = {}
+        self._sync_answer: Dict[int, Optional[dict]] = {}
+        #: cut rendezvous: epoch -> {member: seq}
+        self._cut_seqs: Dict[int, Dict[int, int]] = {}
+        #: shard store: (epoch, table, shard) -> blob; manifest: epoch->
+        self._shards: Dict[tuple, bytes] = {}
+        self._manifests: Dict[int, dict] = {}
+        self._shard_dups = 0
+        #: commit rendezvous: epoch -> set of committed members
+        self._commits: Dict[int, set] = {}
+        #: group transport: (epoch, key, idx) -> {member: blob}; once
+        #: complete the ordered blob list parks in _xchg_results until
+        #: every participant has read it
+        self._xchg: Dict[tuple, Dict[int, bytes]] = {}
+        self._xchg_results: Dict[tuple, tuple] = {}
+
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = _recv_frame(self.request)
+                    resp = outer._dispatch(req)
+                except (MembershipChanged, TransientError) as exc:
+                    resp = {"err": type(exc).__name__, "msg": str(exc),
+                            "epoch": getattr(exc, "epoch", -1),
+                            "members": list(getattr(exc, "members", ())),
+                            "departed": list(getattr(exc, "departed", ())),
+                            "joined": list(getattr(exc, "joined", ()))}
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return
+                except Exception as exc:
+                    Log.Error("elastic coordinator op failed: %r", exc)
+                    resp = {"err": "FatalError", "msg": repr(exc)}
+                try:
+                    _send_frame(self.request, resp)
+                except OSError:
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="mv-elastic-coordinator", daemon=True)
+        self._thread.start()
+        Log.Info("elastic: coordinator up at %s:%d (lease %.1fs)",
+                 host, self.port, lease_s)
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:       # pragma: no cover - teardown race
+            pass
+
+    # -- state machine -------------------------------------------------------
+
+    def _reap_expired(self, now: Optional[float] = None) -> list:
+        """Mark lease-expired active members dead; returns the newly
+        dead ranks. Caller holds the lock."""
+        now = time.monotonic() if now is None else now
+        dead = []
+        for rec in self.members.values():
+            if rec.expired(now):
+                rec.status = "dead"
+                dead.append(rec.rank)
+                Log.Error("elastic: member %d lease expired (%.1fs) — "
+                          "declared dead", rec.rank, rec.lease_s)
+        if dead:
+            tmetrics.counter("elastic.lease_expirations").inc(len(dead))
+            self._cv.notify_all()
+        return dead
+
+    def _active(self) -> list:
+        return sorted(r for r, m in self.members.items()
+                      if m.status == "active")
+
+    def _stage_transition(self, cause: str,
+                          sync_gen: Optional[int] = None) -> Optional[dict]:
+        """Compute + stage the next epoch view from pending changes.
+        Caller holds the lock. None when nothing changes.
+
+        DEATH transitions take only the survivors: pending joins (and
+        drains) stay staged for the NEXT graceful sync — the survivors'
+        error-path transition (engine_transition) has no shard-move
+        plane, so admitting a joiner there would park it forever."""
+        if self._transition is not None:
+            return self._transition
+        old = self._active()
+        dead = sorted(r for r, m in self.members.items()
+                      if m.status == "dead" and r in
+                      self._transitioned_view())
+        if cause == "death":
+            leaving, joining = [], []
+        else:
+            leaving = sorted(self._pending_leave)
+            joining = sorted(self._pending_join)
+        new = sorted((set(old) - set(leaving)) | set(joining))
+        if new == self._transitioned_view() and not dead:
+            return None
+        CHECK(new, "elastic: transition would empty the world")
+        self._transition = {
+            "epoch": self.epoch + 1,
+            "members": new,
+            "old_members": self._transitioned_view(),
+            "departed": sorted(set(self._transitioned_view()) - set(new)),
+            "joined": sorted(set(new) - set(self._transitioned_view())),
+            "dead": dead,
+            "cause": cause,
+            "sync_gen": sync_gen,
+        }
+        if cause != "death":
+            self._pending_leave.clear()
+            self._pending_join.clear()
+        self._cv.notify_all()
+        Log.Info("elastic: staged epoch %d (%s): members %s",
+                 self._transition["epoch"], cause, new)
+        return self._transition
+
+    def _transitioned_view(self) -> list:
+        """The CURRENT epoch's member list (active + the just-dead —
+        i.e. everyone the current epoch believed in;
+        ``reaped`` corpses belong to already-committed past epochs)."""
+        return sorted(r for r, m in self.members.items()
+                      if m.status in ("active", "dead"))
+
+    def _has_pending(self) -> bool:
+        return bool(self._pending_leave or self._pending_join
+                    or self._transition is not None
+                    or any(m.status == "dead"
+                           for m in self.members.values()))
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        fn = getattr(self, f"_op_{op}", None)
+        CHECK(fn is not None, f"elastic coordinator: unknown op {op!r}")
+        return fn(req)
+
+    def _op_register(self, req: dict) -> dict:
+        with self._lock:
+            rank = int(req["member"])
+            rec = self.members.get(rank)
+            if rec is None or rec.status in ("left", "dead"):
+                self.members[rank] = _MemberRec(rank, self._lease_s)
+            else:
+                rec.last_hb = time.monotonic()
+            self._cv.notify_all()
+            return {"epoch": self.epoch, "members": self._active()}
+
+    def _op_hb(self, req: dict) -> dict:
+        with self._lock:
+            rec = self.members.get(int(req["member"]))
+            if rec is not None and rec.status not in ("dead",):
+                rec.last_hb = time.monotonic()
+            return {"epoch": self.epoch, "pending": self._has_pending()}
+
+    def _op_leave(self, req: dict) -> dict:
+        with self._lock:
+            rank = int(req["member"])
+            CHECK(rank != 0, "elastic: the coordinator rank (0) cannot "
+                             "drain — it hosts the membership authority")
+            rec = self.members.get(rank)
+            CHECK(rec is not None and rec.status == "active",
+                  f"elastic: leave from non-active member {rank}")
+            self._pending_leave.add(rank)
+            self._cv.notify_all()
+            return {"epoch": self.epoch}
+
+    def _op_join(self, req: dict) -> dict:
+        with self._lock:
+            rank = int(req["member"])
+            rec = self.members.get(rank)
+            staged_departing = (self._transition is not None
+                                and rank in self._transition["departed"])
+            CHECK(rec is None or rec.status == "left" or staged_departing,
+                  f"elastic: join from member {rank} in state "
+                  f"{rec.status if rec else '?'}")
+            # a re-join racing its own drain's install is legal: the
+            # drain is staged/committing, the join lands in the NEXT
+            # transition's pending set either way
+            self._pending_join.add(rank)
+            self._cv.notify_all()
+            return {"epoch": self.epoch}
+
+    def _op_sync(self, req: dict) -> dict:
+        """Lockstep sync rendezvous: a member's n-th call joins
+        generation n (server-assigned — see _sync_counts); the FIRST
+        complete rendezvous computes the answer (stage a transition or
+        not), later arrivals read it. Waits are lease-aware: a member
+        dying mid-rendezvous converts the sync into a death transition
+        instead of a hang."""
+        member = int(req["member"])
+        timeout = float(req.get("timeout") or 300.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            gen = self._sync_counts.get(member, 0) + 1
+            self._sync_counts[member] = gen
+            self._sync_arrived.setdefault(gen, set()).add(member)
+            self._cv.notify_all()
+            while True:
+                if gen in self._sync_answer:
+                    ans = self._sync_answer[gen]
+                    # the last reader tidies the bookkeeping
+                    self._sync_arrived[gen].discard(member)
+                    if not self._sync_arrived[gen]:
+                        del self._sync_arrived[gen]
+                        del self._sync_answer[gen]
+                    return {"transition": ans, "epoch": self.epoch}
+                self._reap_expired()
+                expected = set(self._active())
+                if expected and expected <= self._sync_arrived[gen]:
+                    t = None
+                    if self._has_pending():
+                        t = self._stage_transition(
+                            self._transition["cause"]
+                            if self._transition else "graceful",
+                            sync_gen=gen)
+                    self._sync_answer[gen] = t
+                    self._cv.notify_all()
+                    continue
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic sync rendezvous {gen} timed out "
+                        f"(arrived {sorted(self._sync_arrived[gen])}, "
+                        f"expected {sorted(expected)})")
+                self._cv.wait(0.1)
+
+    def _op_dead_check(self, req: dict) -> dict:
+        """A member's collective deadline fired: block (briefly) until
+        either a lease verdict arrives — some member is dead, a shrink
+        transition is staged and returned — or every lease proves fresh
+        (the deadline was a genuine divergence: transition None)."""
+        timeout = float(req.get("timeout") or self._lease_s + 2.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._reap_expired()
+                if any(m.status == "dead" for m in self.members.values()):
+                    t = self._stage_transition("death")
+                    return {"transition": t, "epoch": self.epoch}
+                if self._transition is not None:
+                    return {"transition": self._transition,
+                            "epoch": self.epoch}
+                if time.monotonic() > deadline:
+                    return {"transition": None, "epoch": self.epoch}
+                self._cv.wait(0.1)
+
+    def _op_cut(self, req: dict) -> dict:
+        """Fence rendezvous: every old-view member that is ALIVE reports
+        the stream SEQ it fenced at; they must agree (the lockstep
+        window-stream cut). Dead members are excused — their fence is
+        the point the survivors' deadline fired at."""
+        member, seq = int(req["member"]), int(req["seq"])
+        epoch = int(req["epoch"])
+        timeout = float(req.get("timeout") or 300.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            seqs = self._cut_seqs.setdefault(epoch, {})
+            if member in seqs:
+                CHECK(seqs[member] == seq,
+                      f"elastic: member {member} re-cut at a different "
+                      f"seq ({seqs[member]} vs {seq})")
+            seqs[member] = seq
+            self._cv.notify_all()
+            while True:
+                self._reap_expired()
+                t = self._transition
+                CHECK(t is not None and t["epoch"] == epoch,
+                      f"elastic: cut for unstaged epoch {epoch}")
+                expected = {r for r in t["old_members"]
+                            if self.members[r].status != "dead"}
+                if expected <= set(seqs):
+                    got = {seqs[r] for r in expected}
+                    CHECK(len(got) == 1,
+                          f"elastic: cut SEQs diverge across members: "
+                          f"{ {r: seqs[r] for r in sorted(expected)} } — "
+                          f"the fence must land at one lockstep stream "
+                          f"position")
+                    return {"cut_seq": seqs[member], "epoch": epoch}
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic cut rendezvous timed out (arrived "
+                        f"{sorted(seqs)}, expected {sorted(expected)})")
+                self._cv.wait(0.1)
+
+    def _op_manifest(self, req: dict) -> dict:
+        with self._lock:
+            epoch = int(req["epoch"])
+            if epoch not in self._manifests:      # idempotent (retries)
+                self._manifests[epoch] = req["manifest"]
+                self._cv.notify_all()
+            return {"ok": True}
+
+    def _op_manifest_get(self, req: dict) -> dict:
+        epoch = int(req["epoch"])
+        deadline = time.monotonic() + float(req.get("timeout") or 300.0)
+        with self._lock:
+            while epoch not in self._manifests:
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic: manifest for epoch {epoch} never "
+                        f"published")
+                self._cv.wait(0.1)
+            return {"manifest": self._manifests[epoch]}
+
+    def _op_shard_put(self, req: dict) -> dict:
+        key = (int(req["epoch"]), int(req["table_id"]), int(req["shard"]))
+        with self._lock:
+            dup = key in self._shards
+            if dup:
+                # at-most-once shard delivery: a retried PUT (transient
+                # control fault, chaos membership site) answers from
+                # the record instead of re-storing
+                self._shard_dups += 1
+                tmetrics.counter("elastic.shard_dedup_hits").inc()
+            else:
+                self._shards[key] = req["blob"]
+                self._cv.notify_all()
+            return {"ok": True, "dup": dup}
+
+    def _op_shard_get(self, req: dict) -> dict:
+        key = (int(req["epoch"]), int(req["table_id"]), int(req["shard"]))
+        deadline = time.monotonic() + float(req.get("timeout") or 300.0)
+        with self._lock:
+            while key not in self._shards:
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic: shard {key} never published")
+                self._cv.wait(0.1)
+            return {"blob": self._shards[key]}
+
+    def _op_commit(self, req: dict) -> dict:
+        """NEW-view rendezvous: when every member of the staged view has
+        committed, the epoch becomes current and the shard store is
+        freed."""
+        member, epoch = int(req["member"]), int(req["epoch"])
+        deadline = time.monotonic() + float(req.get("timeout") or 300.0)
+        with self._lock:
+            if self.epoch >= epoch:     # raced past the install: done
+                return {"epoch": self.epoch, "members": self._active()}
+            t = self._transition
+            CHECK(t is not None and t["epoch"] == epoch,
+                  f"elastic: commit for unstaged epoch {epoch} "
+                  f"(current {self.epoch})")
+            self._commits.setdefault(epoch, set()).add(member)
+            self._cv.notify_all()
+            while True:
+                if self.epoch >= epoch:
+                    return {"epoch": self.epoch,
+                            "members": self._active()}
+                if set(t["members"]) <= self._commits.get(epoch, set()):
+                    self._install(t)
+                    continue
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic commit rendezvous timed out "
+                        f"(committed "
+                        f"{sorted(self._commits.get(epoch, set()))}, "
+                        f"expected {t['members']})")
+                self._cv.wait(0.1)
+
+    def _install(self, t: dict) -> None:
+        """Make the staged transition current. Caller holds the lock."""
+        for r in t["departed"]:
+            rec = self.members.get(r)
+            if rec is None:
+                continue
+            # dead members are REAPED at install: the committed epoch
+            # excludes them, so they must stop registering as pending
+            # state — otherwise every later sync re-stages a spurious
+            # epoch and every group exchange re-raises membership
+            rec.status = "reaped" if rec.status == "dead" else "left"
+        for r in t["joined"]:
+            rec = self.members.get(r)
+            if rec is None:
+                self.members[r] = _MemberRec(r, self._lease_s)
+            else:
+                rec.status = "active"
+                rec.last_hb = time.monotonic()
+            # re-align the joiner's sync generation with the rendezvous
+            # that admitted it: its next sync joins the live members'
+            # next generation
+            gen = t.get("sync_gen")
+            if gen is None:
+                gen = max([self._sync_counts.get(m, 0)
+                           for m in t["members"] if m != r] or [0])
+            self._sync_counts[r] = gen
+        self.epoch = t["epoch"]
+        self._transition = None
+        # free the move plane: committed shards are installed everywhere
+        self._shards = {k: v for k, v in self._shards.items()
+                        if k[0] > self.epoch}
+        self._manifests = {e: m for e, m in self._manifests.items()
+                          if e > self.epoch}
+        self._cut_seqs.pop(self.epoch, None)
+        self._commits.pop(self.epoch, None)
+        tmetrics.gauge("elastic.epoch").set(self.epoch)
+        tmetrics.gauge("elastic.members").set(len(self._active()))
+        self._cv.notify_all()
+        Log.Info("elastic: epoch %d committed — members %s",
+                 self.epoch, self._active())
+
+    def _op_joiner_wait(self, req: dict) -> dict:
+        """Joiner parks until a staged transition admits it AND its
+        manifest is published (the owners finished their shard PUTs'
+        inventory declaration)."""
+        member = int(req["member"])
+        deadline = time.monotonic() + float(req.get("timeout") or 300.0)
+        with self._lock:
+            while True:
+                t = self._transition
+                if (t is not None and member in t["joined"]
+                        and t["epoch"] in self._manifests):
+                    return {"transition": t,
+                            "manifest": self._manifests[t["epoch"]]}
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic: joiner {member} admission never "
+                        f"staged")
+                self._cv.wait(0.1)
+
+    def _op_xchg(self, req: dict) -> dict:
+        """Group allgather-bytes rendezvous among the CURRENT view:
+        blocks until every member posted for (epoch, key, idx), then
+        answers each with all blobs in member order. Lease-aware: a
+        member dying mid-exchange fails the round with a typed
+        membership error instead of hanging the survivors."""
+        member, epoch = int(req["member"]), int(req["epoch"])
+        key = (epoch, req["key"], int(req["idx"]))
+        timeout = float(req.get("timeout") or 300.0)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            CHECK(epoch == self.epoch,
+                  f"elastic: exchange for epoch {epoch} but current is "
+                  f"{self.epoch} (stale member?)")
+            slot = self._xchg.setdefault(key, {})
+            slot[member] = req["blob"]
+            self._cv.notify_all()
+            while True:
+                done = self._xchg_results.get(key)
+                if done is not None:
+                    blobs, members, unread = done
+                    unread.discard(member)
+                    if not unread:
+                        self._xchg_results.pop(key, None)
+                        self._xchg.pop(key, None)
+                    return {"blobs": list(blobs), "members": list(members)}
+                expected = self._active()
+                if set(expected) <= set(slot):
+                    self._xchg_results[key] = (
+                        tuple(slot[r] for r in expected), tuple(expected),
+                        set(expected))
+                    self._cv.notify_all()
+                    continue
+                newly_dead = self._reap_expired()
+                if newly_dead or any(
+                        m.status == "dead"
+                        for m in self.members.values()):
+                    self._xchg.pop(key, None)
+                    self._xchg_results.pop(key, None)
+                    t = self._stage_transition("death")
+                    raise MembershipChanged(
+                        f"group exchange {req['key']!r}",
+                        epoch=t["epoch"] if t else self.epoch,
+                        members=t["members"] if t else self._active(),
+                        departed=t["departed"] if t else (),
+                        joined=t["joined"] if t else ())
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"elastic group exchange {key} timed out "
+                        f"(posted {sorted(slot)}, expected {expected})")
+                self._cv.wait(0.05)
+
+    def _op_gbar(self, req: dict) -> dict:
+        """Group barrier = a degenerate exchange of empty blobs."""
+        req = dict(req, blob=b"", key=("BAR", req.get("name", "")))
+        self._op_xchg(req)
+        return {"ok": True}
+
+    def _op_state(self, req: dict) -> dict:
+        with self._lock:
+            self._reap_expired()
+            return {
+                "epoch": self.epoch,
+                "members": self._active(),
+                "statuses": {r: m.status
+                             for r, m in sorted(self.members.items())},
+                "pending": self._has_pending(),
+                "staged": (dict(self._transition)
+                           if self._transition else None),
+                "shard_frames": len(self._shards),
+                "shard_dedup_hits": self._shard_dups,
+            }
+
+
+class MemberClient:
+    """One member's RPC client to the authority. Fresh socket per call
+    (control-plane rates are low; this keeps concurrent callers —
+    heartbeat thread, engine thread, app thread — trivially isolated).
+    Ops the chaos ``membership.*`` sites target retry on
+    TransientError."""
+
+    def __init__(self, host: str, port: int, member: int,
+                 lease_s: float):
+        self.host, self.port = host, int(port)
+        self.member = int(member)
+        self.lease_s = float(lease_s)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._xchg_idx: Dict = {}
+        self._xchg_lock = threading.Lock()
+
+    def call(self, op: str, timeout: Optional[float] = None,
+             **kw) -> dict:
+        """One RPC. ``timeout`` is forwarded as the SERVER-side
+        rendezvous bound; the socket waits 10s past it so the server's
+        typed answer (TransientError/MembershipChanged with diagnostic
+        membership detail) always wins over a raw socket timeout."""
+        req = dict(kw, op=op, member=self.member)
+        bound = float(timeout if timeout is not None
+                      else kw.get("timeout") or 300.0)
+        req.setdefault("timeout", bound)
+        with socket.create_connection((self.host, self.port),
+                                      timeout=10.0) as sock:
+            sock.settimeout(bound + 10.0)
+            _send_frame(sock, req)
+            resp = _recv_frame(sock)
+        err = resp.get("err") if isinstance(resp, dict) else None
+        if err == "MembershipChanged":
+            raise MembershipChanged(resp.get("msg", "coordinator"),
+                                    epoch=resp.get("epoch", -1),
+                                    members=resp.get("members", ()),
+                                    departed=resp.get("departed", ()),
+                                    joined=resp.get("joined", ()))
+        if err == "TransientError":
+            raise TransientError(resp["msg"])
+        CHECK(err is None, f"elastic coordinator error: {resp}")
+        return resp
+
+    def call_retry(self, op: str, attempts: int = 3, **kw) -> dict:
+        """RPC with transient retries — connection refused while the
+        coordinator comes up, chaos-injected control faults."""
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                return self.call(op, **kw)
+            except (TransientError, ConnectionError, OSError) as exc:
+                last = exc
+                tmetrics.counter("failsafe.retries").inc()
+                time.sleep(0.05 * (1 + i))
+        raise last  # type: ignore[misc]
+
+    # -- heartbeats ---------------------------------------------------------
+
+    def start_heartbeats(self) -> None:
+        if self._hb_thread is not None:
+            return
+        period = max(0.05, self.lease_s / 3.0)
+
+        def _beat():
+            while not self._hb_stop.wait(period):
+                try:
+                    self.call("hb", timeout=5.0)
+                except Exception:
+                    # a missed beat is what the lease machinery exists
+                    # to notice — nothing useful to do locally
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=_beat, name=f"mv-elastic-hb-{self.member}",
+            daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+
+    # -- group transport ----------------------------------------------------
+
+    def group_exchange(self, epoch: int, blob: bytes, key,
+                       timeout: float) -> list:
+        """Allgather-bytes among the epoch's members (relayed). Round
+        indices are scoped PER (epoch, key): lockstep members advance
+        each key's index identically, and every epoch starts every key
+        at round 0 on every member — a re-admitted member (whose
+        counters froze while it was departed) therefore agrees with
+        the survivors from the new epoch's first round."""
+        with self._xchg_lock:
+            k = (epoch, key)
+            idx = self._xchg_idx.get(k, 0)
+            self._xchg_idx[k] = idx + 1
+        resp = self.call("xchg", epoch=epoch, key=repr(key), idx=idx,
+                         blob=blob, timeout=timeout)
+        return resp["blobs"]
+
+    def group_barrier(self, epoch: int, name: str,
+                      timeout: float) -> None:
+        with self._xchg_lock:
+            k = (epoch, "BAR", name)
+            idx = self._xchg_idx.get(k, 0)
+            self._xchg_idx[k] = idx + 1
+        self.call("gbar", epoch=epoch, name=name, idx=idx,
+                  timeout=timeout)

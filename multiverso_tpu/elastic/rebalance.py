@@ -1,0 +1,308 @@
+"""Shard re-partitioning: pure ownership math + the shard-move wire.
+
+The table layer's ``Partition()`` hooks (tables/matrix_table.py:1685,
+tables/array_table.py:429) already express the ceil-block ownership law
+(parallel/mesh.py ``ceil_block_rows``) as vectorized pure functions over
+the DEVICE axis. The elastic plane lifts the same law one level up, to
+the MEMBER axis: under a view of M members, every table's addressable
+space (matrix rows / array elements / KV items) splits into M ceil
+blocks, member ``members[i]`` owning block i. An epoch transition
+N -> M re-partitions by the same math — :func:`plan_moves` names
+exactly the contiguous runs whose owner changed (the ``shard.moved``
+flight events), and the unit matrix in tests/test_elastic.py proves no
+row is lost or duplicated for every N, M pair.
+
+The move wire: one table's complete logical state is a **checkpoint
+frame** (checkpoint.write_table_frame — Store payload + updater aux in
+mesh-independent layout, the exact bytes a checkpoint file would hold,
+so the two serializations cannot drift). :func:`split_frame` slices the
+frame's value region into the M row shards WITHOUT decoding values
+(the per-family region math below knows each Store format's header and
+stride); shard 0 additionally carries the header + aux tail. Each shard
+ships sealed with the window wire's CRC32 trailer
+(parallel/wire.seal_frame) and is deduped by ``(epoch, table, shard)``
+at the coordinator (at-most-once, like the verb wire's (src, msg_id)
+window). :func:`join_shards` reassembles — refusing torn coverage
+(a lost or duplicated row range raises, never silently mis-joins).
+
+Rebuild: :func:`rebuild_world` re-forms the device mesh over the new
+view's processes and re-creates every server table on it from its
+frame — the checkpoint layer's documented mesh-independence ("a job may
+resume on a different mesh size") is what makes a 2-proc table land on
+a 1-proc mesh and back without bespoke per-family migration code.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import pickle
+from typing import Dict, List, Tuple
+
+from multiverso_tpu.parallel import wire
+from multiverso_tpu.parallel.mesh import ceil_block_rows
+from multiverso_tpu.utils.io import Stream
+from multiverso_tpu.utils.log import CHECK, Log
+
+# -- pure ownership math (the member-axis Partition()) -------------------
+
+
+def shard_ranges(count: int, nshards: int) -> List[Tuple[int, int]]:
+    """``nshards`` contiguous ceil blocks covering ``[0, count)`` —
+    the member-axis twin of the tables' device-shard law
+    (mesh.ceil_block_rows): block i = [i*b, min((i+1)*b, count)), tail
+    blocks possibly empty. Pure; unit-tested for exact coverage."""
+    CHECK(nshards > 0, "shard_ranges: nshards must be positive")
+    CHECK(count >= 0, "shard_ranges: negative count")
+    block = ceil_block_rows(count, nshards) if count else 0
+    out = []
+    for s in range(nshards):
+        lo = min(s * block, count)
+        hi = min((s + 1) * block, count) if s < nshards - 1 else count
+        out.append((lo, hi))
+    return out
+
+
+def shard_owner_map(count: int, members) -> Dict[int, Tuple[int, int]]:
+    """``{member_rank: (lo, hi)}`` — the epoch's shard→owner view for
+    one table's addressable space."""
+    members = sorted(members)
+    ranges = shard_ranges(count, len(members))
+    return {m: ranges[i] for i, m in enumerate(members)}
+
+
+def plan_moves(count: int, old_members, new_members) -> List[Tuple[int, int, int, int]]:
+    """Contiguous ``(lo, hi, from_member, to_member)`` runs whose owner
+    changes across an old-view -> new-view transition (``from_member``
+    is -1 for rows previously unowned — only possible when the space
+    grew, which tables never do today). Rows whose owner is unchanged
+    do not appear. Pure; the flight recorder's ``shard.moved`` events
+    and the unit matrix both consume this. O(|old| + |new|): ownership
+    is piecewise-constant between the two views' merged block
+    boundaries, so the plan walks boundary segments, never rows (this
+    runs inside the transition fence — a per-row walk would add
+    seconds of fenced stream per 10M-row table)."""
+    old_members, new_members = sorted(old_members), sorted(new_members)
+    old_ranges = shard_ranges(count, len(old_members))
+    new_ranges = shard_ranges(count, len(new_members))
+
+    def _owner_at(row, view, ranges):
+        for m, (lo, hi) in zip(view, ranges):
+            if lo <= row < hi:
+                return m
+        return -1
+
+    cuts = sorted({0, count}
+                  | {b for lo, hi in old_ranges for b in (lo, hi)}
+                  | {b for lo, hi in new_ranges for b in (lo, hi)})
+    moves: List[Tuple[int, int, int, int]] = []
+    for lo, hi in zip(cuts, cuts[1:]):
+        if lo >= hi:
+            continue
+        pair = (_owner_at(lo, old_members, old_ranges),
+                _owner_at(lo, new_members, new_ranges))
+        if pair[0] == pair[1]:
+            continue
+        if moves and moves[-1][1] == lo and moves[-1][2:] == pair:
+            moves[-1] = (moves[-1][0], hi, *pair)   # merge adjacent run
+        else:
+            moves.append((lo, hi, *pair))
+    return moves
+
+
+def shard_shippers(nshards: int, old_members) -> Dict[int, int]:
+    """Which LIVE old-view member ships shard i of the new view: round-
+    robin over the old members (every member holds the full logical cut
+    — the assignment is pure load balancing of the move wire)."""
+    old_members = sorted(old_members)
+    CHECK(old_members, "shard_shippers: empty old view")
+    return {s: old_members[s % len(old_members)] for s in range(nshards)}
+
+
+# -- checkpoint-frame region math (split without decoding) ---------------
+# A write_table_frame blob is:
+#   table_id i64 | type str | store_len i64 | store bytes | aux tail
+# and each family's Store payload opens with a fixed header whose
+# counts locate the row-strided value region(s):
+#   Matrix/Sparse:  rows i64 | cols i64 | rows*cols*itemsize raw
+#   Array:          size i64 | size*itemsize raw
+#   KV:             n i64    | n*8 keys | n*itemsize values
+
+
+def _parse_frame(blob: bytes) -> dict:
+    stream = Stream(_io.BytesIO(blob), "<shard split>")
+    table_id = stream.ReadInt()
+    type_name = stream.ReadStr()
+    store_len = stream.ReadInt()
+    pos = stream._f.tell()
+    store = blob[pos:pos + store_len]
+    aux_tail = blob[pos + store_len:]
+    return {"table_id": table_id, "type": type_name, "store": store,
+            "aux_tail": aux_tail}
+
+
+def _store_regions(type_name: str, store: bytes) -> dict:
+    """``{count, header, regions: [(offset, stride)]}`` for one family's
+    Store payload — the minimal knowledge needed to slice rows without
+    decoding values. Unknown families return count=0 (whole-frame
+    transfer in shard 0: correct, just not row-granular)."""
+    import struct
+    i64 = struct.Struct("<q")
+    if type_name in ("MatrixServerTable", "SparseMatrixServerTable"):
+        rows, cols = i64.unpack_from(store, 0)[0], i64.unpack_from(store, 8)[0]
+        body = len(store) - 16
+        stride = body // rows if rows else 0
+        CHECK(rows == 0 or stride * rows == body,
+              f"matrix store region not row-strided ({body} bytes / "
+              f"{rows} rows)")
+        return {"count": rows, "header": store[:16],
+                "regions": [(16, stride)]}
+    if type_name == "ArrayServer":
+        size = i64.unpack_from(store, 0)[0]
+        body = len(store) - 8
+        stride = body // size if size else 0
+        CHECK(size == 0 or stride * size == body,
+              "array store region not element-strided")
+        return {"count": size, "header": store[:8],
+                "regions": [(8, stride)]}
+    if type_name == "KVServerTable":
+        n = i64.unpack_from(store, 0)[0]
+        vbody = len(store) - 8 - n * 8
+        stride = vbody // n if n else 0
+        CHECK(n == 0 or stride * n == vbody,
+              "kv store value region not item-strided")
+        return {"count": n, "header": store[:8],
+                "regions": [(8, 8), (8 + n * 8, stride)]}
+    return {"count": 0, "header": store, "regions": []}
+
+
+def split_frame(blob: bytes, nshards: int, epoch: int = 0) -> List[bytes]:
+    """One table frame -> ``nshards`` sealed shard blobs. Shard i holds
+    the value-region rows of ceil block i; shard 0 additionally carries
+    the frame header, Store header and aux tail. Every shard is sealed
+    with the window wire's CRC32 trailer."""
+    parsed = _parse_frame(blob)
+    meta = _store_regions(parsed["type"], parsed["store"])
+    ranges = shard_ranges(meta["count"], nshards) if meta["count"] \
+        else [(0, 0)] * nshards
+    out = []
+    for s, (lo, hi) in enumerate(ranges):
+        shard = {
+            "v": 1, "epoch": int(epoch),
+            "table_id": parsed["table_id"], "type": parsed["type"],
+            "shard": s, "nshards": nshards,
+            "lo": lo, "hi": hi, "count": meta["count"],
+            "regions": [parsed["store"][off + lo * stride:
+                                        off + hi * stride]
+                        for off, stride in meta["regions"]],
+        }
+        if s == 0:
+            shard["header"] = meta["header"]
+            shard["aux_tail"] = parsed["aux_tail"]
+            shard["frame_head"] = blob[:len(blob) - len(parsed["aux_tail"])
+                                       - len(parsed["store"])]
+        out.append(wire.seal_frame(pickle.dumps(
+            shard, protocol=pickle.HIGHEST_PROTOCOL)))
+    return out
+
+
+def join_shards(shard_blobs: List[bytes]) -> bytes:
+    """Sealed shard blobs (any order) -> the original table frame.
+    Validates CRC per shard, then coverage: the shards' [lo, hi) ranges
+    must tile [0, count) exactly — a lost or duplicated row range
+    raises instead of silently mis-joining."""
+    shards = [pickle.loads(wire.open_frame(b)) for b in shard_blobs]
+    CHECK(shards, "join_shards: no shards")
+    shards.sort(key=lambda s: s["shard"])
+    head = shards[0]
+    CHECK(head["shard"] == 0 and "header" in head,
+          "join_shards: shard 0 (header carrier) missing")
+    n = head["nshards"]
+    CHECK([s["shard"] for s in shards] == list(range(n)),
+          f"join_shards: shard set not exactly 0..{n - 1}: "
+          f"{[s['shard'] for s in shards]}")
+    count = head["count"]
+    cover = 0
+    for s in shards:
+        CHECK(s["count"] == count and s["nshards"] == n
+              and s["type"] == head["type"]
+              and s["table_id"] == head["table_id"]
+              and s["epoch"] == head["epoch"],
+              "join_shards: mixed shard sets")
+        CHECK(s["lo"] == cover,
+              f"join_shards: row coverage torn at {cover} (shard "
+              f"{s['shard']} starts at {s['lo']}) — rows lost or "
+              f"duplicated")
+        CHECK(s["hi"] >= s["lo"], "join_shards: negative shard range")
+        cover = s["hi"]
+    CHECK(cover == count,
+          f"join_shards: rows {cover}..{count} never shipped")
+    nregions = len(head["regions"])
+    store = bytearray(head["header"])
+    for r in range(nregions):
+        for s in shards:
+            CHECK(len(s["regions"]) == nregions,
+                  "join_shards: region count mismatch")
+            store.extend(s["regions"][r])
+    return head["frame_head"] + bytes(store) + head["aux_tail"]
+
+
+# -- capture + rebuild ---------------------------------------------------
+
+
+def capture_cut(tables) -> List[bytes]:
+    """Every table's frame at the current stream position. Runs at the
+    engine fence (collective over the OLD view when it has >1 member —
+    the frames' fetches are matched collectives at a lockstep
+    position)."""
+    from multiverso_tpu.checkpoint import write_table_frame
+    return [write_table_frame(t, tid) for tid, t in enumerate(tables)]
+
+
+def _devices_for(members) -> list:
+    """The device set of the new view's mesh: every boot process still
+    in the world contributes its local devices. A solo view's mesh is
+    fully process-local — no program on it can ever issue a cross-
+    process collective, which is what makes a survivor's world sound
+    after a peer died mid-collective."""
+    import jax
+    members = set(members)
+    if len(members) == 1:
+        return list(jax.local_devices())
+    devs = [d for d in jax.devices() if d.process_index in members]
+    return devs or list(jax.local_devices())
+
+
+def rebuild_world(zoo, frames: List[bytes], members) -> None:
+    """Re-form the mesh over ``members`` and re-create every server
+    table on it from its cut frame, swapping the new tables into the
+    zoo + engine registries in place. Must run with the verb stream
+    fenced (engine thread, or a quiesced world): nothing may hold a
+    reference to the old device arrays mid-swap."""
+    from multiverso_tpu.parallel.mesh import MeshContext, build_mesh
+    CHECK(len(frames) == len(zoo.server_tables),
+          f"rebuild_world: {len(frames)} frames for "
+          f"{len(zoo.server_tables)} tables")
+    zoo.mesh_ctx = MeshContext(mesh=build_mesh(_devices_for(members)))
+    from multiverso_tpu.checkpoint import read_table_frame
+    engine = zoo.server_engine
+    for tid, frame in enumerate(frames):
+        old = zoo.server_tables[tid]
+        option = getattr(old, "_mv_option", None)
+        CHECK(option is not None,
+              f"table {tid} ({type(old).__name__}) has no creation "
+              f"option recorded — cannot rebuild elastically")
+        new = option.make_server(zoo)
+        new._mv_option = option
+        read_table_frame(new, frame)
+        zoo.server_tables[tid] = new
+        if engine is not None:
+            engine.store_[tid] = new
+    # worker-side fast-path caches refer to pre-transition state:
+    # drop them (combined adds were flushed by the fence already)
+    for wt in zoo.worker_tables:
+        cache = getattr(wt, "_gc_cache", None)
+        if isinstance(cache, dict):
+            cache.clear()
+    Log.Info("elastic: rebuilt %d tables on a %d-device mesh "
+             "(members %s)", len(frames), zoo.mesh_ctx.num_servers,
+             sorted(members))

@@ -37,6 +37,13 @@ Spec grammar (comma-separated)::
     serving.delay:P[@delay_s]  serving dispatcher stalls a micro-batch
                                by delay_s before serving it (drives the
                                per-request deadline path)
+    membership.leave:P         elastic control plane: the drain's staged
+                               LEAVE op is re-delivered after a fault
+                               delay — the coordinator's idempotent
+                               staging must absorb the duplicate
+    membership.join:P          same rehearsal for the admission path
+                               (duplicate JOIN staging / shard-move
+                               dedup)
 
     (serving.* draws come from concurrent reader threads: the outcome
     sequence per site stays seeded-deterministic, but which caller
@@ -69,7 +76,8 @@ MV_DEFINE_int("chaos_seed", 0, "fault-schedule seed (chaos_spec)")
 _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "wire.bitflip", "wire.truncate",
           "verb.transient", "verb.failack",
-          "serving.overload", "serving.delay")
+          "serving.overload", "serving.delay",
+          "membership.leave", "membership.join")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -168,6 +176,15 @@ class ChaosInjector:
         if self._fire("serving.delay"):
             return self.param("serving.delay")
         return 0.0
+
+    def membership_fault(self, kind: str) -> bool:
+        """Consulted once per elastic ``leave``/``join`` control op:
+        True = rehearse a lost-then-retransmitted control RPC (the
+        elastic plane re-delivers the staged op; the coordinator's
+        idempotent staging + shard dedup must absorb it). Control ops
+        run on app threads at app-paced sync points — per-site outcome
+        sequences stay seeded-deterministic like every other site."""
+        return self._fire(f"membership.{kind}")
 
     def corrupt_blob(self, blob: bytes) -> Optional[bytes]:
         """Consulted once per outgoing window exchange blob: a

@@ -64,6 +64,43 @@ class ServingOverloaded(FailsafeError):
     for the marginal one."""
 
 
+class MembershipChanged(FailsafeError):
+    """The elastic world's membership changed under this operation.
+
+    Raised (instead of an opaque hang or a fatal ``DeadlineExceeded``)
+    when a rank joins or leaves the running world — gracefully through
+    the coordinator's drain/admit protocol, or silently when a member's
+    heartbeat lease expired mid-collective. Carries the NEW epoch view
+    so callers can re-anchor:
+
+    * a worker whose verb was in flight across the transition receives
+      this error: its effects were rolled back to the epoch's snapshot
+      cut — re-run from the last elastic sync point;
+    * a stale identity lookup (``MV_WorkerIdToRank`` against a departed
+      member) receives it instead of a wrong rank.
+
+    ``epoch`` is the membership epoch now in effect, ``members`` the
+    surviving boot ranks, ``departed``/``joined`` the delta vs the
+    previous view."""
+
+    def __init__(self, what: str, epoch: int, members=(),
+                 departed=(), joined=()):
+        self.what = what
+        self.epoch = int(epoch)
+        self.members = tuple(members)
+        self.departed = tuple(departed)
+        self.joined = tuple(joined)
+        delta = []
+        if self.departed:
+            delta.append(f"departed={list(self.departed)}")
+        if self.joined:
+            delta.append(f"joined={list(self.joined)}")
+        super().__init__(
+            f"membership changed during {what}: epoch {epoch}, "
+            f"members={list(self.members)}"
+            + (f" ({', '.join(delta)})" if delta else ""))
+
+
 class ActorDied(FailsafeError):
     """An actor's loop thread died; its mailbox is poisoned. Raised
     immediately by ``Receive``/pending ``Wait``s instead of enqueueing

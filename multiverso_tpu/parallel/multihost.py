@@ -93,6 +93,153 @@ STATS = {"host_collective_rounds": 0,
 def note_collective(n: int = 1) -> None:
     STATS["host_collective_rounds"] += n
 
+
+# -- elastic membership groups (round 10, elastic/) ----------------------
+# The boot world is jax.distributed's: process_index/process_count are
+# frozen at init, and every host-byte exchange above rides gloo
+# allgathers over ALL boot processes. An elastic epoch installs a GROUP
+# — the subset of boot ranks currently in the world — and the exchange
+# layer re-forms around it: singleton groups take the single-process
+# identity paths (no collectives at all, which is also what makes a
+# survivor's world sound after a peer died mid-allgather: the abandoned
+# gloo stream is simply never touched again), and multi-member groups
+# ride the coordinator-relayed exchange the elastic plane provides
+# (gloo cannot subset the boot world, and after ANY transition the
+# boot-world collective stream can no longer be trusted to be aligned).
+# process_index()/process_count() deliberately keep their boot meaning
+# (device ownership, forensic rank identity); membership-aware code
+# asks world_rank()/world_size().
+
+class Group:
+    """One membership epoch's view of the world.
+
+    ``members`` are boot ranks, sorted; ``exchange(blob, key)`` is the
+    group's allgather-bytes primitive (None = identity / unused for
+    singleton groups); ``barrier(name)`` its rendezvous."""
+
+    def __init__(self, epoch: int, members, exchange=None, barrier=None):
+        self.epoch = int(epoch)
+        self.members = tuple(sorted(int(m) for m in members))
+        self._exchange = exchange
+        self._barrier = barrier
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+    def rank(self) -> int:
+        """This process's position in the member list, -1 if departed."""
+        try:
+            return self.members.index(process_index())
+        except ValueError:
+            return -1
+
+    def _require_member(self, what: str) -> None:
+        if self.rank() < 0:
+            from multiverso_tpu.failsafe.errors import MembershipChanged
+            raise MembershipChanged(
+                f"{what} from a departed member", epoch=self.epoch,
+                members=self.members, departed=(process_index(),))
+
+    def exchange(self, blob: bytes, key) -> list:
+        if self.size <= 1 and self.rank() >= 0:
+            return [blob]
+        self._require_member("collective exchange")
+        CHECK(self._exchange is not None,
+              "multi-member elastic group without an exchange transport")
+        note_collective()
+        return self._exchange(blob, key)
+
+    def barrier(self, name: str) -> None:
+        if self.size <= 1 and self.rank() >= 0:
+            return
+        self._require_member("collective barrier")
+        CHECK(self._barrier is not None,
+              "multi-member elastic group without a barrier transport")
+        note_collective()
+        self._barrier(name)
+
+
+_group: Optional[Group] = None
+
+#: collective isolation (elastic rebuild_world): the host-byte exchange
+#: layer answers as a single-member world while a transition fence
+#: rebuilds tables — constructors re-run boot-time agreement
+#: collectives (e.g. SparseMatrixTable's -num_workers check), but the
+#: agreement was already established at boot and the fence has no
+#: matched peer round to pair them with. world_rank()/world_size() are
+#: NOT isolated: the rebuilt tables must bind the new view's identity.
+_isolated = False
+
+
+class collective_isolation:
+    def __enter__(self):
+        global _isolated
+        self._prev = _isolated
+        _isolated = True
+        return self
+
+    def __exit__(self, *exc):
+        global _isolated
+        _isolated = self._prev
+
+
+#: a boot-world member DIED (silent death, elastic shrink): the
+#: jax.distributed runtime's shutdown barrier would block on the dead
+#: task and the coordination client then TERMINATES the survivor —
+#: net_finalize skips the runtime shutdown instead (the process exit
+#: reaps it)
+_boot_world_broken = False
+
+
+def mark_boot_world_broken() -> None:
+    global _boot_world_broken
+    if not _boot_world_broken:
+        _boot_world_broken = True
+        Log.Error("multihost: a boot-world member died — the "
+                  "jax.distributed runtime will not be shut down "
+                  "cleanly (survivors skip its shutdown barrier)")
+
+
+def install_group(group: Optional[Group]) -> None:
+    """Install the membership view every exchange routes through from
+    now on (None restores the boot world). Called by the elastic plane
+    at an epoch transition — on the engine thread, at the fenced stream
+    position, so no exchange is in flight across the swap."""
+    global _group
+    _group = group
+    if group is not None:
+        Log.Info("multihost: membership epoch %d installed — members %s "
+                 "(this process %s)", group.epoch, list(group.members),
+                 "rank %d" % group.rank() if group.rank() >= 0
+                 else "DEPARTED")
+
+
+def current_group() -> Optional[Group]:
+    return _group
+
+
+def membership_epoch() -> int:
+    """The installed membership epoch (0 = boot world)."""
+    return _group.epoch if _group is not None else 0
+
+
+def world_size() -> int:
+    """Active member count of the CURRENT world (boot process count
+    until an elastic epoch is installed)."""
+    if _group is not None:
+        return _group.size
+    return process_count() if _initialized else 1
+
+
+def world_rank() -> int:
+    """This process's rank in the CURRENT world ordering (= boot rank
+    until an elastic epoch is installed); -1 when this process has
+    departed the world."""
+    if _group is not None:
+        return _group.rank()
+    return process_index() if _initialized else 0
+
 # Explicit-endpoint bring-up state (MV_NetBind / MV_NetConnect): the
 # launcher-free deployment path. The reference's ZMQ transport let a
 # process declare its own (rank, endpoint) and the full world without MPI
@@ -175,9 +322,11 @@ def net_reset() -> None:
     interpreters (evolved caps) with fresh ranks (defaults), and
     mismatched caps mean mismatched allgather buffer shapes — caps must
     restart from defaults on every world, like the engine's per-instance
-    _mh_caps do."""
-    global _net_rank, _net_endpoint, _net_world
+    _mh_caps do. Also forgets any installed elastic membership group —
+    a new world starts at epoch 0 (boot membership)."""
+    global _net_rank, _net_endpoint, _net_world, _group
     _net_rank = _net_endpoint = _net_world = None
+    _group = None
     _OBJ_CAPS.clear()
 
 
@@ -192,6 +341,16 @@ def net_finalize() -> None:
     global _initialized, _owns_runtime
     net_reset()
     if not _initialized or not _owns_runtime:
+        return
+    if _boot_world_broken:
+        # a dead boot member can never reach the runtime's shutdown
+        # barrier; entering it would hang this survivor and then
+        # TERMINATE it (coordination client fatal-error path). Leave
+        # the runtime to process exit.
+        Log.Info("net_finalize: boot world broken — skipping "
+                 "jax.distributed.shutdown()")
+        _initialized = False
+        _owns_runtime = False
         return
     import jax
     try:
@@ -376,9 +535,14 @@ def process_count() -> int:
 
 
 def host_barrier(name: str = "mv_barrier") -> None:
-    """Block until every process reaches this point (no-op single-process).
-    Collective: every process must call it (reference controller barrier,
-    controller.cpp:12-36)."""
+    """Block until every member of the CURRENT world reaches this point
+    (no-op single-member). Collective: every member must call it
+    (reference controller barrier, controller.cpp:12-36)."""
+    if _isolated:
+        return
+    if _group is not None:
+        _group.barrier(name)
+        return
     if process_count() <= 1:
         return
     from jax.experimental import multihost_utils
@@ -387,8 +551,15 @@ def host_barrier(name: str = "mv_barrier") -> None:
 
 
 def host_allreduce_sum(data: np.ndarray) -> np.ndarray:
-    """Elementwise sum of ``data`` across processes (identity
-    single-process). Collective."""
+    """Elementwise sum of ``data`` across the current world's members
+    (identity single-member). Collective."""
+    if _isolated:
+        return data
+    if _group is not None:
+        if _group.size <= 1:
+            return data
+        parts = host_allgather_objects(np.asarray(data))
+        return np.sum(parts, axis=0).astype(data.dtype)
     if process_count() <= 1:
         return data
     from jax.experimental import multihost_utils
@@ -398,10 +569,15 @@ def host_allreduce_sum(data: np.ndarray) -> np.ndarray:
 
 
 def host_allgather_bytes(data: bytes) -> list:
-    """Every process's byte blob, ordered by process index (collective;
-    single-process: ``[data]``). Blobs may differ in length — lengths are
+    """Every member's byte blob, ordered by world rank (collective;
+    single-member: ``[data]``). Blobs may differ in length — lengths are
     exchanged first, then payloads ride one fixed-shape allgather padded
-    to the global max."""
+    to the global max (elastic groups ride the group transport in one
+    keyed round instead)."""
+    if _isolated:
+        return [data]
+    if _group is not None:
+        return _group.exchange(data, "HAB")
     if process_count() <= 1:
         return [data]
     from jax.experimental import multihost_utils
@@ -444,7 +620,14 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     the standing cap snaps to the ladder rung of this exchange's max
     need, so per-key steady workloads (an engine window headed by the
     same verb) stay on the 1-round path. Collective; single-process
-    returns ``[blob]``."""
+    returns ``[blob]``. In an elastic epoch the exchange rides the
+    group transport instead (the gloo boot-world allgather cannot
+    subset the world); ``caps`` are not consulted there — the relay is
+    length-framed by construction."""
+    if _isolated:
+        return [blob]
+    if _group is not None:
+        return _group.exchange(blob, key)
     if process_count() <= 1:
         return [blob]
     from jax.experimental import multihost_utils
@@ -496,7 +679,7 @@ def host_allgather_objects_capped(obj, key) -> list:
     identically at this lockstep call site — e.g. a call-site label —
     or buffer shapes diverge and the world hangs. Use for small,
     latency-sensitive agreements (the device planes' bucket rounds)."""
-    if process_count() <= 1:
+    if world_size() <= 1:
         return [obj]
     import pickle
     return [pickle.loads(b) for b in
@@ -504,12 +687,12 @@ def host_allgather_objects_capped(obj, key) -> list:
 
 
 def host_allgather_objects(obj) -> list:
-    """Every process's picklable object, ordered by process index
-    (collective; single-process: ``[obj]``). Used by the table layer to
+    """Every member's picklable object, ordered by world rank
+    (collective; single-member: ``[obj]``). Used by the table layer to
     merge per-process host-plane payloads — e.g. each process's row-id/delta
     batch of one logical Add — so reference PS semantics (every worker's
     Add accumulates, whichever process it ran on) hold across hosts."""
-    if process_count() <= 1:
+    if world_size() <= 1:
         return [obj]
     import pickle
     blobs = host_allgather_bytes(pickle.dumps(obj))
@@ -527,7 +710,7 @@ def merge_collective_add(option, *arrays, with_parts: bool = False):
     ``with_parts``: also return the per-rank first arrays (the id sets),
     in rank order — SparseMatrixTable derives its per-keeper freshness
     transitions from them without a second host collective."""
-    if process_count() <= 1:
+    if world_size() <= 1:
         return (arrays, [arrays[0]]) if with_parts else arrays
     parts = host_allgather_objects((arrays, option))
     opts = [p[1] for p in parts]
@@ -546,7 +729,7 @@ def sum_collective_add(option, values: np.ndarray,
     option agreement CHECK as merge_collective_add). Identity
     single-process. ``with_parts``: also return the per-rank id sets —
     ``None`` per rank (a whole-table push)."""
-    if process_count() <= 1:
+    if world_size() <= 1:
         return (values, [None]) if with_parts else values
     parts = host_allgather_objects((values, option))
     opts = [p[1] for p in parts]
@@ -562,13 +745,20 @@ def union_collective_ids(ids: np.ndarray) -> Optional[np.ndarray]:
     """Sorted union of every process's id/key set of one collective Get —
     the one identical set all processes gather so their device programs
     match. None single-process (caller keeps its local fast path)."""
-    if process_count() <= 1:
+    if world_size() <= 1:
         return None
     return np.unique(np.concatenate(host_allgather_objects(ids)))
 
 
 def broadcast_from_master(data: np.ndarray) -> np.ndarray:
-    """Host 0's value to everyone (identity single-process). Collective."""
+    """The world's lowest-rank member's value to everyone (identity
+    single-member). Collective."""
+    if _isolated:
+        return data
+    if _group is not None:
+        if _group.size <= 1:
+            return data
+        return host_allgather_objects(np.asarray(data))[0]
     if process_count() <= 1:
         return data
     from jax.experimental import multihost_utils

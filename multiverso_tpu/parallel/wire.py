@@ -252,6 +252,20 @@ def _seal(body: bytes) -> bytes:
     return body + _U32.pack(zlib.crc32(body) & 0xFFFFFFFF)
 
 
+def seal_frame(body: bytes) -> bytes:
+    """Public sealing for satellite planes (elastic shard moves): the
+    same CRC32 trailer every window blob carries, so one corruption
+    posture covers every byte that crosses a process boundary."""
+    return _seal(body)
+
+
+def open_frame(blob: bytes) -> bytes:
+    """Verify + strip a :func:`seal_frame` trailer; raises
+    ``WireCorruption`` (counting ``wire.crc_failures``) on mismatch."""
+    check_crc(blob)
+    return blob[:-CRC_TRAILER_BYTES]
+
+
 def check_crc(blob: bytes) -> None:
     """Verify a sealed blob's CRC32 trailer; raises ``WireCorruption``
     (counting ``wire.crc_failures``) on mismatch or truncation. Runs

@@ -34,8 +34,10 @@ from multiverso_tpu.failsafe import chaos
 from multiverso_tpu.failsafe import deadline as fdeadline
 from multiverso_tpu.failsafe.dedup import DedupWindow
 from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            MembershipChanged,
                                             TransientError, WireCorruption)
 from multiverso_tpu.message import Message, MsgType, copy_result
+from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel import wire
 from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
@@ -267,8 +269,11 @@ class _ExchangeStage:
         #: its intervals against these (see Server._note_overlap)
         self.busy_since = 0.0
         self.busy_s = 0.0
-        from multiverso_tpu.parallel import multihost
-        self._my_rank = multihost.process_index()
+        # the WORLD rank (elastic membership view), not the boot rank:
+        # exchanged windows index by position in the current member
+        # order. A stage never survives an epoch transition (the rebase
+        # retires it), so binding at construction is sound.
+        self._my_rank = multihost.world_rank()
         self._thread = threading.Thread(target=self._main,
                                         name="mv-engine-exchange",
                                         daemon=True)
@@ -580,6 +585,7 @@ class Server(Actor):
         if tflight.enabled():
             tflight.record("window.exchanged", seq=self._mh_seq - 1,
                            epoch=self.window_epoch,
+                           mepoch=multihost.membership_epoch(),
                            detail=",".join(f"{k}{t}"
                                            for k, t in descs[my_rank]))
 
@@ -591,7 +597,8 @@ class Server(Actor):
         self._t_fence_stall_s.observe(stall_s)
         self.last_fence_cause = cause
         tflight.record("fence", seq=self._mh_seq,
-                       epoch=self.window_epoch, detail=cause)
+                       epoch=self.window_epoch,
+                       mepoch=multihost.membership_epoch(), detail=cause)
 
     def _note_overlap(self, s: float) -> None:
         """Record ``s`` seconds of exchange/apply concurrency (called by
@@ -606,6 +613,70 @@ class Server(Actor):
             if busy > 0:
                 self._t_overlap_pct.set(
                     min(100.0, 100.0 * self._overlap_s / busy))
+
+    # -- elastic plane hooks (round 10, elastic/) ---------------------------
+
+    def _elastic_rebase(self, mepoch: int, cause: str) -> None:
+        """Epoch transition, ON the engine thread with the stream
+        fenced: re-base the exchange stream for the new world — SEQ
+        back to 0 (every surviving member re-bases at the same cut, so
+        the counters stay lockstep), standing caps dropped (the world
+        size changed, so per-key exchanged buffer shapes changed), and
+        the exchange stage retired (the next window builds a fresh one
+        bound to the new world rank)."""
+        st = self._ex_stage
+        if st is not None:
+            st.poison()
+            st.dead = st.dead or _StageKilled()
+            self._ex_stage = None
+        self._mh_seq = 0
+        self._mh_caps.clear()
+        tflight.record("membership.epoch", seq=0,
+                       epoch=self.window_epoch, mepoch=mepoch,
+                       detail=f"cause={cause}")
+        Log.Info("engine: exchange stream re-based for membership "
+                 "epoch %d (%s)", mepoch, cause)
+
+    def _elastic_post_transition(self, pending) -> bool:
+        """After a barrier dispatch that performed an epoch transition:
+        when the new world is single-member the collective protocol is
+        gone — drain the remaining pipeline/batch contents through the
+        local window path and report True."""
+        if multihost.world_size() > 1:
+            return False
+        batch = list(pending)
+        pending.clear()
+        if batch:
+            self._local_window(batch)
+            self.window_epoch += 1
+            tflight.record("window.applied", epoch=self.window_epoch,
+                           mepoch=multihost.membership_epoch(),
+                           detail=f"{len(batch)}v")
+        return True
+
+    @staticmethod
+    def _bounded_collective(fn, what: str):
+        """fdeadline.bounded + membership-lease consult: a deadline on
+        a collective asks the elastic authority whether a peer's lease
+        expired BEFORE going fatal — a dead peer converts the deadline
+        into the typed MembershipChanged the transition path handles
+        (heartbeat leases riding the failsafe deadline machinery). No
+        elastic plane (or every lease fresh): the DeadlineExceeded
+        propagates exactly as before."""
+        try:
+            return fdeadline.bounded(fn, what)
+        except MembershipChanged:
+            raise
+        except BaseException as exc:
+            # a dead peer surfaces either as the deadline OR as a
+            # transport error from the abandoned collective — both
+            # consult the lease. Fresh leases: the original error
+            # re-raises untouched (genuine divergence stays fatal).
+            from multiverso_tpu import elastic
+            repl = elastic.peer_loss(what) if elastic.enabled() else None
+            if repl is not None:
+                raise repl from exc
+            raise
 
     #: how many queued messages one Get/Add drains into its window.
     #: Each pipelined Get hides one device->host copy RTT, queued Adds to
@@ -744,8 +815,7 @@ class Server(Actor):
         batch = [m for m in batch if self._admit(m)]
         if not batch:
             return
-        from multiverso_tpu.parallel import multihost
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             # multi-process WINDOWED protocol (round 5): one host
             # collective exchanges the whole window; verbs then apply
             # from the exchanged parts with cross-rank coalescing/dedup.
@@ -906,13 +976,37 @@ class Server(Actor):
         -mv_deadline_s set) fails EVERY drained message — their waiters
         raise instead of hanging — and then propagates with its fatal
         mark so the actor poisons itself: after an abandoned collective
-        this rank's collective stream is unsound."""
+        this rank's collective stream is unsound.
+
+        ELASTIC EXCEPTION (round 10): a MembershipChanged — a peer's
+        heartbeat lease expired, confirmed by the coordinator when the
+        exchange deadline consulted it — is NOT fatal when the elastic
+        plane can transition: the engine rolls every table back to the
+        retained snapshot cut on the shrunk world's mesh, re-bases the
+        exchange stream (SEQ 0, caps dropped, stage retired) and stays
+        healthy; the drained messages fail with the TYPED error (their
+        effects were rolled back with everything after the cut) so the
+        worker re-runs from its last elastic sync point — continuity,
+        not a full-world restart."""
         pending: Deque[Message] = collections.deque(batch)
         try:
-            if _pipeline_flag():
-                self._mh_pipelined(pending)
-            else:
-                self._mh_windows_inner(pending)
+            try:
+                if _pipeline_flag():
+                    self._mh_pipelined(pending)
+                else:
+                    self._mh_windows_inner(pending)
+            except MembershipChanged as exc:
+                from multiverso_tpu import elastic
+                if self._ex_stage is not None:
+                    st = self._ex_stage
+                    st.poison()
+                    st.dead = st.dead or exc
+                    self._ex_stage = None
+                if not elastic.engine_transition(self, exc):
+                    raise       # no plane / no cut: the fatal path below
+                for m in pending:
+                    m.reply(exc)
+                return
         except Exception as exc:
             # ANY escape aborts the stream mid-window — an abandoned
             # exchange (DeadlineExceeded), an exhausted frame retry or
@@ -935,6 +1029,7 @@ class Server(Actor):
             # failed, so a fast-exiting worker can't beat the dump
             tflight.record("engine.fatal", seq=self._mh_seq,
                            epoch=self.window_epoch,
+                           mepoch=multihost.membership_epoch(),
                            detail=f"{type(exc).__name__}: "
                                   f"{exc}"[:200])
             tflight.dump_failure(
@@ -1014,6 +1109,19 @@ class Server(Actor):
                 # fatal apply error is about to poison the actor, the
                 # stage must not hang inside _wait_applied
                 stage.note_applied()
+            if self._ex_stage is not stage:
+                # an elastic rebase retired the stage inside that
+                # barrier dispatch (epoch transition): the pipeline's
+                # remaining contents re-anchor to the NEW world —
+                # single-member worlds drain through the local window
+                # path, otherwise a fresh stage (bound to the new
+                # world rank, SEQ 0) takes over and the verbs re-lead
+                # the new epoch's stream
+                if self._elastic_post_transition(fed):
+                    return
+                stage = self._ex_stage = _ExchangeStage(self)
+                for m in fed:
+                    self._pl_feed(stage, m)
 
     def _pl_feed(self, stage: _ExchangeStage, m: Message) -> None:
         if m.msg_type in (MsgType.Request_Add, MsgType.Request_Get):
@@ -1043,6 +1151,7 @@ class Server(Actor):
             self.window_epoch += 1
             tflight.record("window.applied", seq=self._mh_seq,
                            epoch=self.window_epoch,
+                           mepoch=multihost.membership_epoch(),
                            detail=f"{prefix}v")
 
     def _mh_windows_inner(self, pending: "Deque[Message]") -> None:
@@ -1061,6 +1170,8 @@ class Server(Actor):
                 self.window_barrier_splits += 1
                 self._t_splits.inc()
                 self._dispatch(head)
+                if self._elastic_post_transition(pending):
+                    return
                 continue
             verbs = []
             for m in pending:
@@ -1094,9 +1205,8 @@ class Server(Actor):
         already diverged across mismatched keys: the exchange itself
         then fails at the runtime layer (mismatched buffer shapes) —
         still an error, not a silent hang."""
-        from multiverso_tpu.parallel import multihost
         marker = wire.encode_head_barrier(int(head.msg_type))
-        blobs = fdeadline.bounded(
+        blobs = self._bounded_collective(
             lambda: multihost.capped_exchange(marker, self._mh_caps,
                                               "HEAD_B"),
             "window head-marker exchange")
@@ -1105,6 +1215,7 @@ class Server(Actor):
         # diverged peer exchanged at that same seq
         tflight.record("barrier", seq=self._mh_seq,
                        epoch=self.window_epoch,
+                       mepoch=multihost.membership_epoch(),
                        detail=MsgType(head.msg_type).name)
         kinds = [wire.decode_head_kind(b) for b in blobs]
         CHECK(all(k == kinds[0] for k in kinds),
@@ -1183,7 +1294,6 @@ class Server(Actor):
         """Encode + exchange + decode one window, deadline-bounded,
         retrying the full (collective) exchange when a received frame
         fails its CRC32 trailer. Returns every rank's verb list."""
-        from multiverso_tpu.parallel import multihost
         last_exc = None
         for attempt in range(1 + self.MH_WIRE_RETRIES):
             # flat binary codec (parallel/wire.py): pickle's object-
@@ -1206,7 +1316,7 @@ class Server(Actor):
             # in steady loops — so the exchange stays on the 1-round path
             with ttrace.span("server.window.exchange", cat="server",
                              args={"bytes": len(blob)}):
-                blobs = fdeadline.bounded(
+                blobs = self._bounded_collective(
                     lambda: multihost.capped_exchange(
                         blob, self._mh_caps, (local[0][0], local[0][1])),
                     "window exchange")
@@ -1238,6 +1348,7 @@ class Server(Actor):
                 last_exc = exc
                 tflight.record("wire.crc_retry", seq=self._mh_seq,
                                epoch=self.window_epoch,
+                               mepoch=multihost.membership_epoch(),
                                detail=f"attempt{attempt + 1}")
                 Log.Error("window exchange frame corrupt (attempt "
                           "%d/%d): %r — re-exchanging", attempt + 1,
@@ -1288,6 +1399,7 @@ class Server(Actor):
         self._t_budget.set(packed)
         tflight.record("window.admitted", seq=self._mh_seq,
                        epoch=self.window_epoch,
+                       mepoch=multihost.membership_epoch(),
                        detail=f"{len(used)}v/{packed}B")
         return local, used
 
@@ -1319,8 +1431,7 @@ class Server(Actor):
         return None
 
     def _mh_collective_window_inner(self, verbs) -> int:
-        from multiverso_tpu.parallel import multihost
-        my_rank = multihost.process_index()
+        my_rank = multihost.world_rank()
         local, used = self._mh_pack_window(verbs)
         windows = self._mh_exchange_decode(local, my_rank)
         prefix = min(len(w) for w in windows)
@@ -1333,7 +1444,9 @@ class Server(Actor):
         self._mh_apply_window(used[:prefix], windows, prefix, descs[0])
         self.window_epoch += 1
         tflight.record("window.applied", seq=self._mh_seq,
-                       epoch=self.window_epoch, detail=f"{prefix}v")
+                       epoch=self.window_epoch,
+                       mepoch=multihost.membership_epoch(),
+                       detail=f"{prefix}v")
         return prefix
 
     def _mh_apply_window(self, verbs, windows, prefix, descs0) -> None:
@@ -1342,8 +1455,7 @@ class Server(Actor):
         own messages. Shared by the serial engine and the pipelined
         apply stage — the semantics (ordering, grouping, error routing)
         are identical in both."""
-        from multiverso_tpu.parallel import multihost
-        my_rank = multihost.process_index()
+        my_rank = multihost.world_rank()
         self.mh_window_verbs += prefix
         self._t_verbs.inc(prefix)
         # group per table: Add positions, and Get positions split into

@@ -222,7 +222,7 @@ class ArrayServer(ServerTable):
         return True
 
     def ProcessGet(self, option: GetOption) -> np.ndarray:
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             # replicate through XLA (ICI) so every rank reads the full
             # table locally — no host-collective reassembly round
             return self._replicated_full()[: self.size].copy()
@@ -244,7 +244,7 @@ class ArrayServer(ServerTable):
         return [full.copy() for _ in positions]
 
     def ProcessGetAsync(self, option: GetOption = None):
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             return None  # multihost fetch is a collective — keep sync path
         out = self._access(self.state, None)
         if not self._has_access:
@@ -328,7 +328,7 @@ class ArrayServer(ServerTable):
                 d = np.pad(d, (0, self.padded - d.size))
         CHECK(d.shape[0] == self.padded, "parts delta size mismatch")
         return place_parts(self._zoo.mesh_ctx.mesh, d,
-                           multihost.process_count())
+                           multihost.world_size())
 
     def device_update_parts(self, state, parts_delta, opt):
         """Traceable: one collective whole-table Add from per-process

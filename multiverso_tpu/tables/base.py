@@ -585,7 +585,7 @@ class WorkerTable:
             eng = self._zoo.server_engine
             ok = (eng is not None
                   and getattr(eng, "GET_CACHE_OK", False)
-                  and multihost.process_count() <= 1)
+                  and multihost.world_size() <= 1)
             self._gc_enabled = ok
         return ok
 
@@ -653,6 +653,10 @@ def CreateTable(option: TableOption):
           f"(compress={option.compress!r})")
     zoo = Zoo.Get()
     server_table = option.make_server(zoo)
+    # the creation record rides the server half: an elastic epoch
+    # transition re-runs make_server against the new mesh and restores
+    # state from the cut frame (elastic/rebalance.rebuild_world)
+    server_table._mv_option = option
     table_id = zoo.RegisterServerTable(server_table)
     worker_table = option.make_worker(zoo)
     worker_table.table_id = table_id

@@ -370,7 +370,7 @@ class KVServerTable(ServerTable):
         worlds — on a remote accelerator that is one dispatch RTT per
         verb, the BENCH_r05 1.5 Melem/s wall)."""
         from multiverso_tpu.parallel import multihost
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             return False    # the collective window protocol owns those
         return self.ProcessAddRunParts([[p] for p in payloads], 0)
 
@@ -382,7 +382,7 @@ class KVServerTable(ServerTable):
         eagerly (nothing to overlap); multi-process keeps the sync
         parts path."""
         from multiverso_tpu.parallel import multihost
-        if multihost.process_count() > 1 or keys is None:
+        if multihost.world_size() > 1 or keys is None:
             return None
         keys = np.asarray(keys, np.int64).ravel()
         if self._host_backed or self._np_values() is not None:
@@ -439,7 +439,7 @@ class KVServerTable(ServerTable):
         passes the precomputed union so no key collective runs here."""
         keys = np.asarray(keys, np.int64).ravel()
         npv = self._np_values()
-        if npv is not None and multihost.process_count() > 1:
+        if npv is not None and multihost.world_size() > 1:
             # replicated mirror: serve locally — no union round, no
             # device program (the mirror evolves in lockstep everywhere)
             slots = self._slots_for(keys, create=False)
@@ -510,7 +510,7 @@ class KVServerTable(ServerTable):
         if self._host_backed:
             return None     # host-resident values: per-position is local
         npv = self._np_values()
-        if npv is not None and multihost.process_count() > 1:
+        if npv is not None and multihost.world_size() > 1:
             out = []
             for parts in positions:
                 keys = np.asarray(parts[my_rank]["keys"], np.int64).ravel()
@@ -563,7 +563,7 @@ class KVServerTable(ServerTable):
         scan-style loops."""
         self._check_device_plane()
         keys = np.asarray(keys, np.int64).ravel()
-        if multihost.process_count() > 1 and (create or bucket is None):
+        if multihost.world_size() > 1 and (create or bucket is None):
             # identical index evolution on every host: resolve the union
             # in process order first (the control plane is host logic —
             # the one host collective the KV device plane keeps); the
@@ -589,7 +589,7 @@ class KVServerTable(ServerTable):
         Device-resident deltas stay in HBM (place_parts). Single-process
         it simply places the batch on device."""
         slots = np.asarray(padded_slots, np.int32).ravel()
-        nproc = multihost.process_count()
+        nproc = multihost.world_size()
         ctx = self._zoo.mesh_ctx
         local_dev = local_device_count(ctx.mesh)
         CHECK(len(slots) % local_dev == 0,

@@ -630,7 +630,7 @@ class MatrixServerTable(ServerTable):
         collective-merge protocol owns those), whole-table adds,
         non-linear/aux updaters, and anything that fails validation
         (the per-message path then reports precise errors)."""
-        if multihost.process_count() > 1 or not self._merge_adds:
+        if multihost.world_size() > 1 or not self._merge_adds:
             return False
         ids_list, deltas_list = [], []
         for p in payloads:
@@ -720,7 +720,7 @@ class MatrixServerTable(ServerTable):
         the collective-merge protocol owns that path."""
         ids = np.asarray(comp["row_ids"], np.int32).ravel()
         self._check_ids(ids)
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             # BSP/direct multi-process path: host-decompress, then the
             # normal collective row Add (the windowed engine routes its
             # multi-process compressed Adds through ProcessAddParts)
@@ -1180,7 +1180,7 @@ class MatrixServerTable(ServerTable):
     def _full_logical(self) -> np.ndarray:
         """The whole logical matrix on THIS host. Multi-process: XLA
         replicates over ICI (no host-collective reassembly round)."""
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             if not hasattr(self, "_access_full_repl"):
                 from jax.sharding import NamedSharding
 
@@ -1334,7 +1334,7 @@ class MatrixServerTable(ServerTable):
         the gather + start the device->host copy now, fetch in finalize —
         the engine overlaps a window of these so queued host Gets pay one
         pipelined RTT instead of one each."""
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             return None  # collective fetch/union — keep the sync path
         nat = self._host_store()
         if nat is not None:
@@ -1391,7 +1391,7 @@ class MatrixServerTable(ServerTable):
         across this process's devices with on-device slices)."""
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
-        nproc = multihost.process_count()
+        nproc = multihost.world_size()
         local_dev = local_device_count(self._mesh)
         if bucket is None:
             bucket = parts_bucket(max(
@@ -1423,16 +1423,16 @@ class MatrixServerTable(ServerTable):
         one merged SPMD gather round."""
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             gids = self.device_place_batch(ids)
-            bucket = gids.shape[0] // multihost.process_count()
+            bucket = gids.shape[0] // multihost.world_size()
             rows = self._gather_rows_parts_j(self.state["data"],
                                              self.state["aux"], gids)
             # rows is fully replicated: slice THIS process's range out of
             # an addressable single-device copy — a per-process-divergent
             # slice of the global array would claim replicated contents
             # it doesn't have
-            start = multihost.process_index() * bucket
+            start = multihost.world_rank() * bucket
             return rows.addressable_data(0)[start: start + len(ids)]
         padded = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
         rows = self._gather_rows(self.state["data"], self.state["aux"],
@@ -1446,7 +1446,7 @@ class MatrixServerTable(ServerTable):
         Multi-process: collective; per-process batches merge on device."""
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
-        if multihost.process_count() > 1:
+        if multihost.world_size() > 1:
             gids, gdeltas = self.device_place_batch(ids, deltas)
             self.state = self._update_rows_parts_j(
                 self.state, gids, gdeltas, (option or AddOption()).as_jnp())
@@ -1493,7 +1493,7 @@ class MatrixServerTable(ServerTable):
         if nat is not None and mode != "device":
             # get_all() fills a FRESH buffer — it IS the copy-on-publish
             return ssnap.MatrixSnapshot.host(nat.get_all())
-        device_legal = (multihost.process_count() <= 1
+        device_legal = (multihost.world_size() <= 1
                         and not jax.tree.leaves(self.state["aux"]))
         want_device = mode == "device" or (
             mode == "auto" and jax.default_backend() != "cpu")

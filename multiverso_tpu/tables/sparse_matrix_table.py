@@ -63,8 +63,8 @@ class SparseMatrixServerTable(MatrixServerTable):
         super().__init__(num_rows, num_cols, dtype, zoo, updater_type,
                          initializer, compress=compress)
         from multiverso_tpu.parallel import multihost
-        self._procs = max(1, multihost.process_count())
-        self._rank = multihost.process_index() if self._procs > 1 else 0
+        self._procs = max(1, multihost.world_size())
+        self._rank = multihost.world_rank() if self._procs > 1 else 0
         self._workers_per_proc = zoo.num_workers
         if self._procs > 1:
             # the gwid mapping for EVERY rank is computed from the local

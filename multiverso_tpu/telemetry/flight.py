@@ -74,14 +74,14 @@ class FlightRecorder:
         self._recorded = 0
 
     def record(self, cap: int, kind: str, seq: int, epoch: int,
-               detail: str) -> None:
+               detail: str, mepoch: int = 0) -> None:
         with self._lock:
             ring = self._ring
             if ring.maxlen != cap:
                 # capacity flag changed: keep the newest events that fit
                 ring = collections.deque(ring, maxlen=cap)
                 self._ring = ring
-            ring.append((time.time(), kind, seq, epoch, detail))
+            ring.append((time.time(), kind, seq, epoch, detail, mepoch))
             self._recorded += 1
 
     def stats(self) -> Tuple[int, int]:
@@ -95,28 +95,33 @@ class FlightRecorder:
         line probe), or None."""
         with self._lock:
             events = list(self._ring)
-        for t, k, seq, epoch, detail in reversed(events):
-            if k == kind:
-                return detail
+        for ev in reversed(events):
+            if ev[1] == kind:
+                return ev[4]
         return None
 
     def events(self, n: Optional[int] = None) -> List[dict]:
         """The newest ``n`` events (all when None) as dicts, oldest
-        first — the /flight endpoint + bundle tail shape."""
+        first — the /flight endpoint + bundle tail shape. ``mepoch`` is
+        the membership epoch the event was recorded under (0 = boot
+        world; the elastic plane re-bases the exchange SEQ per
+        membership epoch, so forensics aligns by (mepoch, seq))."""
         with self._lock:
             raw = list(self._ring)
         if n is not None and n > 0:
             raw = raw[-n:]
-        return [{"t": t, "kind": k, "seq": seq, "epoch": epoch,
-                 "detail": detail}
-                for t, k, seq, epoch, detail in raw]
+        return [{"t": ev[0], "kind": ev[1], "seq": ev[2],
+                 "epoch": ev[3], "detail": ev[4],
+                 "mepoch": ev[5] if len(ev) > 5 else 0}
+                for ev in raw]
 
     def tail_text(self, n: int = 40) -> str:
         """Compact textual tail for the failsafe diagnostic bundle."""
         lines = []
         for e in self.events(n):
+            me = f" mepoch={e['mepoch']}" if e.get("mepoch") else ""
             lines.append(f"{e['t']:.6f} {e['kind']} seq={e['seq']} "
-                         f"epoch={e['epoch']} {e['detail']}")
+                         f"epoch={e['epoch']}{me} {e['detail']}")
         return "\n".join(lines) or "<flight ring empty>"
 
     def _reset_for_tests(self) -> None:
@@ -129,13 +134,16 @@ RECORDER = FlightRecorder()
 
 
 def record(kind: str, seq: int = -1, epoch: int = -1,
-           detail: str = "") -> None:
+           detail: str = "", mepoch: int = 0) -> None:
     """Record one event. The disabled path (``-mv_flight_events=0``)
-    is one cached int read and a return — the no-op gate pattern."""
+    is one cached int read and a return — the no-op gate pattern.
+    ``mepoch`` stamps the membership epoch (elastic plane; 0 = boot
+    world): stream events under a re-based exchange SEQ align by
+    (mepoch, seq)."""
     cap = _cap()
     if cap <= 0:
         return
-    RECORDER.record(cap, kind, seq, epoch, detail)
+    RECORDER.record(cap, kind, seq, epoch, detail, mepoch)
 
 
 def enabled() -> bool:

@@ -32,6 +32,12 @@ Events *applied* (``window.applied``) carry the window epoch instead;
 they corroborate how far each rank's APPLY stage got but alignment
 rides the exchange SEQ, which is the collective clock.
 
+Elastic worlds (round 10): the engine re-bases the exchange SEQ to 0
+at every MEMBERSHIP epoch transition, and every stream event carries
+its membership epoch (``mepoch``) — alignment therefore keys on the
+``(mepoch, seq)`` pair, so a legal re-base never reads as a
+divergence while a real divergence *within* an epoch still does.
+
 CLI::
 
     python -m multiverso_tpu.telemetry.forensics diag/flight_rank*.jsonl
@@ -68,12 +74,21 @@ def load(path: str) -> dict:
             "events": events, "path": path}
 
 
-def _stream(events: List[dict]) -> Dict[int, List[dict]]:
-    """seq -> ordered stream events at that seq (see module doc)."""
-    out: Dict[int, List[dict]] = {}
+def _stream(events: List[dict]) -> Dict[tuple, List[dict]]:
+    """(mepoch, seq) -> ordered stream events at that position.
+
+    The membership epoch joined the alignment key in round 10: the
+    elastic plane RE-BASES the exchange SEQ to 0 at every epoch
+    transition, so two healthy ranks legally both record seq 0 once
+    per epoch — keying on the (mepoch, seq) pair aligns streams across
+    an epoch boundary instead of flagging the re-base as a divergence.
+    Dumps from pre-elastic worlds carry no mepoch field and read as
+    epoch 0 throughout."""
+    out: Dict[tuple, List[dict]] = {}
     for e in events:
         if e.get("kind") in _STREAM_KINDS and e.get("seq", -1) >= 0:
-            out.setdefault(int(e["seq"]), []).append(e)
+            key = (int(e.get("mepoch", 0) or 0), int(e["seq"]))
+            out.setdefault(key, []).append(e)
     return out
 
 
@@ -84,12 +99,14 @@ def _desc(evs: Optional[List[dict]]) -> Optional[str]:
 
 
 def correlate(paths: List[str]) -> dict:
-    """Align the rings in ``paths`` by exchange SEQ; return a report:
+    """Align the rings in ``paths`` by (membership epoch, exchange SEQ);
+    return a report:
 
-    ``{"diverged": bool, "seq": first diverging seq or None,
-    "per_rank": {rank: verbs-at-that-seq or None}, "ranks": [...],
+    ``{"diverged": bool, "seq": first diverging seq or None, "mepoch":
+    its membership epoch (0 = boot world), "per_rank": {rank:
+    verbs-at-that-position or None}, "ranks": [...],
     "agreed_through": last seq every rank agreed at (or None),
-    "note": str}``
+    "agreed_mepoch": that position's membership epoch, "note": str}``
 
     A rank whose dump merely covers a SHORTER seq range than its
     peers' does not count as diverged at the uncovered seqs: a dump
@@ -109,40 +126,47 @@ def correlate(paths: List[str]) -> dict:
         streams[rank] = _stream(d["events"])
         dropped[rank] = int(d["header"].get("dropped", 0))
     ranks = sorted(streams)
-    all_seqs = sorted(set().union(*[set(s) for s in streams.values()])
-                      if streams else set())
-    agreed_through: Optional[int] = None
-    for seq in all_seqs:
-        descs = {r: _desc(streams[r].get(seq)) for r in ranks}
+    all_pos = sorted(set().union(*[set(s) for s in streams.values()])
+                     if streams else set())
+    agreed: Optional[tuple] = None
+    for pos in all_pos:
+        mepoch, seq = pos
+        descs = {r: _desc(streams[r].get(pos)) for r in ranks}
         present = {r: d for r, d in descs.items() if d is not None}
         missing = [r for r, d in descs.items() if d is None]
-        # a missing seq only diverges when that rank recorded activity
-        # on BOTH sides of it (a hole). A dump that merely ends
-        # earlier (rank died/dumped first) covers a shorter range, not
-        # a divergent stream — and so does one that STARTS later
+        # a missing position only diverges when that rank recorded
+        # activity on BOTH sides of it (a hole). A dump that merely
+        # ends earlier (rank died/dumped first) covers a shorter range,
+        # not a divergent stream — and so does one that STARTS later
         # because the bounded ring evicted its oldest events
-        # (dropped > 0 in the header); a front-missing seq on a rank
-        # that dropped NOTHING really is a hole.
+        # (dropped > 0 in the header); a front-missing position on a
+        # rank that dropped NOTHING really is a hole.
         holes = [r for r in missing if streams[r]
-                 and seq < max(streams[r])
-                 and (seq > min(streams[r]) or dropped.get(r, 0) == 0)]
+                 and pos < max(streams[r])
+                 and (pos > min(streams[r]) or dropped.get(r, 0) == 0)]
         vals = set(present.values())
         if len(vals) > 1 or holes:
             per_rank = {r: descs[r] for r in ranks}
             detail = ", ".join(
                 f"rank {r}: {descs[r] if descs[r] is not None else '<missing>'}"
                 for r in ranks)
-            return {"diverged": True, "seq": seq, "ranks": ranks,
-                    "per_rank": per_rank,
-                    "agreed_through": agreed_through,
-                    "note": (f"first diverging exchange SEQ {seq}: "
-                             f"{detail}")}
+            ep = f" (membership epoch {mepoch})" if mepoch else ""
+            return {"diverged": True, "seq": seq, "mepoch": mepoch,
+                    "ranks": ranks, "per_rank": per_rank,
+                    "agreed_through": (agreed[1] if agreed else None),
+                    "agreed_mepoch": (agreed[0] if agreed else None),
+                    "note": (f"first diverging exchange SEQ {seq}"
+                             f"{ep}: {detail}")}
         if len(present) == len(ranks):
-            agreed_through = seq
-    return {"diverged": False, "seq": None, "ranks": ranks,
-            "per_rank": {}, "agreed_through": agreed_through,
-            "note": (f"streams agree through exchange SEQ "
-                     f"{agreed_through}" if agreed_through is not None
+            agreed = pos
+    return {"diverged": False, "seq": None, "mepoch": None,
+            "ranks": ranks, "per_rank": {},
+            "agreed_through": (agreed[1] if agreed else None),
+            "agreed_mepoch": (agreed[0] if agreed else None),
+            "note": (f"streams agree through exchange SEQ {agreed[1]}"
+                     + (f" of membership epoch {agreed[0]}"
+                        if agreed[0] else "")
+                     if agreed is not None
                      else "no common stream events")}
 
 
@@ -150,7 +174,9 @@ def report_text(report: dict) -> str:
     """Human-readable rendering of a :func:`correlate` report."""
     lines = [f"== flight forensics: ranks {report['ranks']} =="]
     if report["diverged"]:
-        lines.append(f"DIVERGED at exchange SEQ {report['seq']} "
+        ep = (f" of membership epoch {report['mepoch']}"
+              if report.get("mepoch") else "")
+        lines.append(f"DIVERGED at exchange SEQ {report['seq']}{ep} "
                      f"(streams agreed through "
                      f"{report['agreed_through']})")
         for r in report["ranks"]:

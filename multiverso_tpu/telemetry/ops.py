@@ -120,6 +120,17 @@ def health_report() -> dict:
             if poison is not None:
                 out["healthy"] = False
                 out["reasons"].append(f"engine poisoned: {poison!r}")
+            try:
+                from multiverso_tpu import elastic
+                el = elastic.state_report()
+                if el is not None:
+                    # current membership epoch + member count (round
+                    # 10): the liveness answer changes meaning across
+                    # epochs, so the scrape names the epoch it
+                    # describes
+                    out["elastic"] = el
+            except Exception:   # elastic plane torn down mid-scrape
+                pass
             stage = getattr(eng, "_ex_stage", None)
             if stage is not None:
                 out["engine"]["exchange_stage"] = {

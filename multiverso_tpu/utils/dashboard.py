@@ -195,11 +195,22 @@ class Dashboard:
             eng = Zoo.Get().server_engine
             last_fence = (getattr(eng, "last_fence_cause", "")
                           if eng is not None else "")
-            return [
+            lines = [
                 f"[Ops] flight_events = {recorded} recorded / "
                 f"{dropped} dropped, ops_port = "
                 f"{port if port is not None else 'off'}, "
                 f"last_fence = {last_fence or '-'}"]
+            from multiverso_tpu import elastic
+            el = elastic.state_report()
+            if el is not None:
+                lines.append(
+                    f"[Elastic] epoch = {el['epoch']}, members = "
+                    f"{len(el['members'])} {el['members']}"
+                    + (" (this member departed)" if el["departed"]
+                       else "")
+                    + (f", cut_seq = {el['cut_seq']}"
+                       if el.get("cut_seq") is not None else ""))
+            return lines
         except Exception:       # pragma: no cover - teardown races
             return []
 

@@ -37,13 +37,16 @@ from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # mv_deadline_s/chaos_spec/chaos_seed) — they MUST be registered before
 # Start()'s ParseCMDFlags runs, or a first-call "-sync=true" would be
 # silently dropped.
+import multiverso_tpu.elastic  # noqa: F401
 import multiverso_tpu.failsafe  # noqa: F401
 import multiverso_tpu.serving  # noqa: F401
 import multiverso_tpu.sync.server  # noqa: F401
 import multiverso_tpu.telemetry  # noqa: F401
 import multiverso_tpu.updaters.base  # noqa: F401
+from multiverso_tpu import elastic
 from multiverso_tpu.failsafe import deadline as fdeadline
-from multiverso_tpu.failsafe.errors import ActorDied, DeadlineExceeded
+from multiverso_tpu.failsafe.errors import (ActorDied, DeadlineExceeded,
+                                            MembershipChanged)
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel.allreduce import RendezvousAllreduce
@@ -119,6 +122,9 @@ class Zoo:
         start_reporter()        # -stats_interval_s periodic reports
         from multiverso_tpu.telemetry.ops import start_ops
         start_ops()             # -mv_ops_port /metrics·/healthz·/flight
+        # elastic membership plane LAST (needs the engine up): rank 0
+        # hosts the coordinator, every rank registers + heartbeats
+        elastic.start_plane(self)
         self.started = True
         Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
                   "mode=%s", self.num_servers, self.num_workers,
@@ -151,6 +157,13 @@ class Zoo:
                           "continuing shutdown", exc)
             self.server_engine.Stop()
             self.server_engine = None
+        # membership plane down AFTER the engine drain: the drain's
+        # final flushes must still route under the CURRENT epoch view
+        # (restoring the boot-world group earlier would aim the drain's
+        # collectives at dead/departed boot peers). Heartbeats stop
+        # here and the boot-world group is restored for the next
+        # MV_Init.
+        elastic.shutdown_plane()
         # serving plane down AFTER the engine (no more publishes can
         # arrive) — drops every snapshot and stops the dispatcher so a
         # later MV_Init world starts from a fresh plane
@@ -196,7 +209,9 @@ class Zoo:
 
     @property
     def size(self) -> int:
-        return multihost.process_count() if self._multihost else 1
+        """Member count of the CURRENT world: the boot process count
+        until an elastic epoch transition shrinks or regrows it."""
+        return multihost.world_size() if self._multihost else 1
 
     @property
     def num_servers(self) -> int:
@@ -228,11 +243,39 @@ class Zoo:
 
         return _Ctx()
 
+    def _id_to_member(self, global_id: int, per_member: int,
+                      what: str) -> int:
+        """Global worker/server id -> hosting member's boot rank under
+        the CURRENT epoch view. Ids partition contiguously across the
+        member list (member i hosts ids [i*per_member, (i+1)*per_member)
+        — the boot-time mapping generalized to the live view). A stale
+        id — one the current view no longer hosts because the world
+        shrank — raises the TYPED MembershipChanged instead of
+        returning a wrong rank (round 10 fix: these used to read the
+        frozen boot mapping)."""
+        CHECK(global_id >= 0, f"{what} id must be >= 0, got {global_id}")
+        CHECK(per_member > 0, f"no {what}s in this world")
+        view = (multihost.current_group().members
+                if multihost.current_group() is not None
+                else tuple(range(multihost.process_count()
+                                 if self._multihost else 1)))
+        member_pos = global_id // per_member
+        if member_pos >= len(view):
+            if elastic.enabled():
+                raise MembershipChanged(
+                    f"{what}_id_to_rank({global_id}) — the id maps past "
+                    f"the current view", epoch=elastic.epoch(),
+                    members=view)
+            CHECK(False, f"{what} id {global_id} out of range for "
+                         f"{len(view)} member(s) x {per_member}")
+        return view[member_pos]
+
     def worker_id_to_rank(self, worker_id: int) -> int:
-        return 0
+        return self._id_to_member(worker_id, self.num_workers, "worker")
 
     def server_id_to_rank(self, server_id: int) -> int:
-        return 0
+        per = max(1, self.num_servers // max(1, self.size))
+        return self._id_to_member(server_id, per, "server")
 
     # -- table registries (reference zoo.h:68-73) ---------------------------
 
@@ -249,6 +292,9 @@ class Zoo:
 
     def SendToServer(self, msg: Message) -> None:
         CHECK(self.server_engine is not None, "no server engine (ma mode?)")
+        # a DEPARTED elastic member's verb fails typed instead of
+        # forking the world's state (one bool read when the plane is off)
+        elastic.guard_verbs()
         if msg.msg_type not in (MsgType.Request_Get, MsgType.Request_Add):
             # non-verb messages (StoreLoad, barrier pings, FinishTrain)
             # are ordering points: a checkpoint snapshot must include
@@ -257,23 +303,28 @@ class Zoo:
             self.flush_combined_adds()
         self.server_engine.Receive(msg)
 
-    def CallOnEngine(self, msg_type: MsgType, fn, what: str):
+    def CallOnEngine(self, msg_type: MsgType, fn, what: str,
+                     timeout_s: Optional[float] = None):
         """Run ``fn()`` on the engine thread at the current stream
         position — the ONE consistent-cut mechanism (round 8): the
         engine treats any non-verb message as a window barrier, so every
         Add admitted before this call is applied first and none after,
         at a lockstep position in multi-process worlds. Checkpoint
-        saves (Request_StoreLoad) and serving publishes (Request_Publish)
-        both ride this helper, so their cut semantics cannot drift.
-        Bounded by ``-mv_deadline_s`` when set; engine-side failures
-        re-raise here."""
+        saves (Request_StoreLoad), serving publishes (Request_Publish)
+        AND elastic membership transitions all ride this helper, so
+        their cut semantics cannot drift. Bounded by ``timeout_s`` when
+        given, else ``-mv_deadline_s``; engine-side failures re-raise
+        here. (Elastic fences pass their own bound: a transition
+        legitimately outlives a verb deadline — it blocks on a joiner's
+        shard download.)"""
         CHECK(self.server_engine is not None,
               f"{what} needs a server engine (not -ma mode)")
         waiter = Waiter(1)
         msg = Message(msg_type=msg_type, payload={"fn": fn}, waiter=waiter)
         self.SendToServer(msg)   # flushes combined-write buffers first
-        if not waiter.Wait(fdeadline.timeout_or_none()):
-            fdeadline.raise_deadline(what)
+        if not waiter.Wait(timeout_s if timeout_s is not None
+                           else fdeadline.timeout_or_none()):
+            fdeadline.raise_deadline(what, seconds=timeout_s)
         if isinstance(msg.result, Exception):
             raise msg.result
         return msg.result

@@ -48,6 +48,12 @@ GUARDED = {
 GUARDED_CEIL = {
     "serving_lookup_p99_ms": 2.0,
     "serving_lookup_2proc_p99_ms": 2.0,
+    # round 10: wall the verb stream is fenced for one elastic epoch
+    # transition (the worse of 2->1 drain and 1->2 re-admission).
+    # Generous multiple: the transition is dominated by subprocess
+    # scheduling + one full-table capture, both noisy on a busy host —
+    # the guard exists to catch it going O(seconds), not +50%.
+    "elastic_rebalance_pause_ms": 4.0,
 }
 
 

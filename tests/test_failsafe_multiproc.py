@@ -12,6 +12,13 @@
 * crash-recovery drill — kill one rank mid-window; the survivor reports
   a bounded, typed failure; a fresh world ``MV_LoadCheckpoint``s and
   re-runs the lost steps to exact parity with an uninterrupted run.
+
+Round 10: the chaos soak's mid-soak KILL phase lives in
+``tests/test_elastic.py::TestElasticKillSoak`` — same chaos machinery,
+but with ``-mv_elastic`` the survivor CONTINUES from the snapshot cut
+on the shrunk world (bit-exact to the shrunk-world oracle) instead of
+restarting, which is this drill's restart-based recovery superseded
+for elastic worlds.
 """
 
 import os
