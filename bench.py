@@ -1340,6 +1340,7 @@ _COMPACT_PRIORITY = [
     "kv_burst_2proc_collectives_per_op",
     "matrix_table_2proc_overlap_pct",
     "matrix_table_2proc_fence_causes",
+    "matrix_table_2proc_critpath",
     "flight_recorder_overhead_pct",
     "matrix_table_2proc_pipeline_burst_per_proc_Melem_s",
     "two_proc_transport_crossover_MB",
@@ -1670,6 +1671,37 @@ fence_causes = {name.rsplit(".", 1)[-1]: int(rec.get("value", 0))
                 if name.startswith("engine.fence.")
                 and rec.get("type") == "counter"}
 fence_stall = _snap.get("engine.fence.stall_s", {})
+# round 11 — critical-path breakdown: WHERE the non-overlapped time
+# goes and WHICH rank binds each window. Every rank dumps its flight
+# ring; after the barrier (both dumps complete) rank 0 merges them
+# with the offline critpath correlator and ships the summary next to
+# overlap_pct + the fence causes.
+import glob, shutil, tempfile
+from multiverso_tpu.telemetry import flight as tflight
+critpath = {}
+if nproc > 1:
+    cp_dir = os.path.join(tempfile.gettempdir(), f"mv_critpath_{port}")
+    os.makedirs(cp_dir, exist_ok=True)
+    tflight.dump(os.path.join(cp_dir, f"flight_rank{rank}.jsonl"))
+    mv.MV_Barrier()
+    if rank == 0:
+        from multiverso_tpu.telemetry import critpath as tcrit
+        rep = tcrit.correlate(sorted(
+            glob.glob(os.path.join(cp_dir, "flight_rank*.jsonl"))))
+        critpath = {
+            "n_windows": rep["n_windows"],
+            "binding_rank_hist": rep["binding_rank_hist"],
+            "binding_phase_hist": rep["binding_phase_hist"],
+            "align_err_ms": round(rep["align_err_s"] * 1e3, 3),
+            "exchange_wait_excess_ms": {
+                r: round(s * 1e3, 1)
+                for r, s in rep["exchange_wait_excess_s"].items()},
+            "phase_ms_rank0": {
+                p: round(s * 1e3, 1)
+                for p, s in rep["phase_totals_s"].get(0, {}).items()},
+            "top_tables": rep["tables_top"][:3],
+        }
+        shutil.rmtree(cp_dir, ignore_errors=True)
 mv.MV_Barrier()
 mv.MV_ShutDown()
 if rank == 0:
@@ -1684,6 +1716,9 @@ if rank == 0:
             1e3 * fence_stall.get("sum", 0.0), 1),
         "fence_stall_ms_p99": round(
             1e3 * fence_stall.get("p99", 0.0), 2),
+        # round 11: the first accounting of where the non-overlapped
+        # window time actually goes (binding rank + phase per window)
+        "critpath": critpath,
         # add-only Melem/s of the multi-window fire-and-forget burst
         # (K/2*C elems per add; the drain Get excluded from the count)
         "pipeline_burst_per_proc_Melem_s": round(

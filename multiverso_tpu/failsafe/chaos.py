@@ -44,6 +44,13 @@ Spec grammar (comma-separated)::
     membership.join:P          same rehearsal for the admission path
                                (duplicate JOIN staging / shard-move
                                dedup)
+    apply.delay:P[@delay_s]    engine window apply stalled by delay_s
+                               BEFORE applying — a PERF fault, not a
+                               correctness one: the verb stream stays
+                               lockstep, it models a straggling rank's
+                               slow apply stage (armed on ONE rank, it
+                               is the deliberate straggler the critpath
+                               drill must attribute)
 
     (serving.* draws come from concurrent reader threads: the outcome
     sequence per site stays seeded-deterministic, but which caller
@@ -77,7 +84,8 @@ _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "wire.bitflip", "wire.truncate",
           "verb.transient", "verb.failack",
           "serving.overload", "serving.delay",
-          "membership.leave", "membership.join")
+          "membership.leave", "membership.join",
+          "apply.delay")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -175,6 +183,19 @@ class ChaosInjector:
         from scheduler-dependent caller interleaving."""
         if self._fire("serving.delay"):
             return self.param("serving.delay")
+        return 0.0
+
+    def apply_delay(self) -> float:
+        """Consulted once per engine window apply: seconds to stall the
+        apply stage BEFORE it runs (0.0 = no fault). A PERF fault, not
+        a correctness one — the verb stream stays lockstep; it models a
+        straggling rank's slow apply, which is exactly the scenario the
+        critpath straggler drill (tests/test_critpath.py) must
+        attribute when the spec is armed on one rank only. Drawn on the
+        single apply thread, so the schedule keeps the strict
+        (seed, site, call-index) reproducibility."""
+        if self._fire("apply.delay"):
+            return self.param("apply.delay")
         return 0.0
 
     def membership_fault(self, kind: str) -> bool:

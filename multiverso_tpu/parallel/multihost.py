@@ -94,6 +94,34 @@ def note_collective(n: int = 1) -> None:
     STATS["host_collective_rounds"] += n
 
 
+#: per-call timing of the LAST capped_exchange on this process — the
+#: engine's phase stamping (round 11, sync/server.py) reads it right
+#: after its window exchange returns, on the same thread, to split the
+#: time BLOCKED IN THE COLLECTIVE (``coll_s``) from local staging work
+#: and to anchor cross-rank clock alignment on the exchange-done wall
+#: stamp (every rank leaves the same allgather at ~the same instant — a
+#: free sync pulse per window; telemetry/critpath.py). The dict is
+#: replaced atomically per call (readers see an old or a new record,
+#: never a torn one); cost when nobody reads it is four float stores.
+_exchange_last = {"enter_m": 0.0, "done_m": 0.0, "done_w": 0.0,
+                  "coll_s": 0.0}
+
+
+def _stamp_exchange(enter_m: float, coll_s: float, done_m: float,
+                    done_w: float) -> None:
+    global _exchange_last
+    _exchange_last = {"enter_m": enter_m, "done_m": done_m,
+                      "done_w": done_w, "coll_s": coll_s}
+
+
+def last_exchange_stats() -> dict:
+    """Timing of this process's most recent :func:`capped_exchange`:
+    ``enter_m``/``done_m`` (perf_counter), ``done_w`` (wall clock at
+    collective exit — the rendezvous pulse) and ``coll_s`` (seconds
+    blocked inside the collective op(s), excluding local staging)."""
+    return _exchange_last
+
+
 # -- elastic membership groups (round 10, elastic/) ----------------------
 # The boot world is jax.distributed's: process_index/process_count are
 # frozen at init, and every host-byte exchange above rides gloo
@@ -627,7 +655,13 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     if _isolated:
         return [blob]
     if _group is not None:
-        return _group.exchange(blob, key)
+        # the elastic group relay IS the collective: its whole wall is
+        # blocked-in-collective time for the phase split
+        _t0 = _time.perf_counter()
+        out = _group.exchange(blob, key)
+        _done = _time.perf_counter()
+        _stamp_exchange(_t0, _done - _t0, _done, _time.time())
+        return out
     if process_count() <= 1:
         return [blob]
     from jax.experimental import multihost_utils
@@ -642,14 +676,18 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     if need <= cap and blob:
         buf[9:9 + len(blob)] = np.frombuffer(blob, np.uint8)
     note_collective()
+    _tc = _time.perf_counter()
     gathered = np.asarray(
         multihost_utils.process_allgather(buf)).reshape(process_count(),
                                                         cap)
+    _done_m, _done_w = _time.perf_counter(), _time.time()
+    coll_s = _done_m - _tc
     lens = [int(np.frombuffer(gathered[i, 1:9].tobytes(), "<i8")[0])
             for i in range(process_count())]
     fits = [bool(gathered[i, 0]) for i in range(process_count())]
     caps[key] = next_bucket(max(lens) + 9, min_bucket=4096)
     if all(fits):
+        _stamp_exchange(_t0, coll_s, _done_m, _done_w)
         STATS["exchange_seconds"] += _time.perf_counter() - _t0
         return [gathered[i, 9:9 + lens[i]].tobytes()
                 for i in range(process_count())]
@@ -659,9 +697,13 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     if blob:
         buf2[: len(blob)] = np.frombuffer(blob, np.uint8)
     note_collective()
+    _tc = _time.perf_counter()
     gathered2 = np.asarray(
         multihost_utils.process_allgather(buf2)).reshape(process_count(),
                                                          big)
+    _done_m, _done_w = _time.perf_counter(), _time.time()
+    coll_s += _done_m - _tc
+    _stamp_exchange(_t0, coll_s, _done_m, _done_w)
     STATS["exchange_seconds"] += _time.perf_counter() - _t0
     return [gathered2[i, : lens[i]].tobytes()
             for i in range(process_count())]

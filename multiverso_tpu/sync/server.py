@@ -119,6 +119,62 @@ MV_DEFINE_int("mv_get_staleness", 0,
               "from the stream, which the multi-process SPMD collective "
               "contract cannot tolerate.")
 
+# Round 11 — performance forensics. Every window's lifecycle is
+# stamped per rank as compact flight events keyed by (mepoch, SEQ):
+# form (verbs waiting for the stage to pick them up), pack, encode,
+# exchange (with the time BLOCKED IN THE COLLECTIVE split out from
+# local staging via multihost.last_exchange_stats — the exchange-done
+# wall stamp is also the cross-rank clock-alignment rendezvous), decode
+# and apply, with apply time additionally attributed per table family
+# and verb kind. telemetry/critpath.py merges per-rank dumps into a
+# cross-rank timeline and names the binding rank + phase per window.
+# Rides the flight recorder's listener-cached gate; the tier-1 overhead
+# guard (tests/test_critpath.py) holds the stamping to the same <=2%
+# blocking-round budget as the recorder itself.
+MV_DEFINE_bool("mv_phase_stamps", True,
+               "per-window lifecycle phase stamping (form/pack/encode/"
+               "exchange/decode/apply flight events + engine.phase.* "
+               "histograms; false = window events only). No-op while "
+               "-mv_flight_events=0 gates the recorder off. "
+               "Multi-process windows stamp EVERY window (the "
+               "cross-rank critical path needs every (mepoch, SEQ) "
+               "position, and those windows cost a collective each); "
+               "single-process windows observe the apply histogram "
+               "every window but sample the flight events + per-table "
+               "attribution 1-in-32 — those windows run in ~250us and "
+               "per-window stamping would blow the 2% blocking-round "
+               "budget the tier-1 guard enforces.")
+_phase_stamps_flag = cached_bool_flag("mv_phase_stamps", True)
+
+#: single-process sampling period for the full stamp (power of two;
+#: window 1, 33, 65, ... stamp — the FIRST window always does, so
+#: short tests and short jobs still leave phase records)
+_PH_SP_SAMPLE = 32
+
+#: the window lifecycle phase taxonomy (order = the gauge encoding of
+#: engine.binding_phase: index into this tuple, -1 = none yet).
+#: ``exchange_wait`` is the slice of ``exchange`` blocked inside the
+#: collective op itself — the part a straggling peer inflates.
+ENGINE_PHASES = ("form", "pack", "encode", "exchange", "exchange_wait",
+                 "decode", "apply")
+
+#: table families the per-family apply-seconds histograms are
+#: registered for eagerly (visible at zero from the first scrape);
+#: custom table classes get a lazy family from their class name
+_TABLE_FAMILIES = ("matrix", "sparse", "array", "kv")
+
+
+def _table_family(table) -> str:
+    """Short family label of a server table for the apply attribution
+    (``SparseMatrixServerTable`` -> ``sparse``, ``KVServerTable`` ->
+    ``kv``; unknown classes degrade to their lowercased class name)."""
+    name = type(table).__name__.lower()
+    for fam in ("sparse", "kv", "array", "matrix"):
+        if fam in name:
+            return fam
+    return name.replace("servertable", "").replace("table", "") or "table"
+
+
 #: apply-stage poll granularity while an exchange is in flight: the
 #: actor keeps draining the mailbox (feeding the NEXT window) between
 #: polls instead of blocking inside the collective like the serial
@@ -269,6 +325,10 @@ class _ExchangeStage:
         #: its intervals against these (see Server._note_overlap)
         self.busy_since = 0.0
         self.busy_s = 0.0
+        #: perf forensics: when the CURRENT pending run started filling
+        #: (0.0 = empty) — the window's "form" phase is the stretch its
+        #: verbs waited for the stage to pick them up
+        self._pending_since = 0.0
         # the WORLD rank (elastic membership view), not the boot rank:
         # exchanged windows index by position in the current member
         # order. A stage never survives an epoch transition (the rebase
@@ -381,6 +441,8 @@ class _ExchangeStage:
             # input order is admission order: only LEADING verb items
             # may join pending ahead of a queued barrier
             while items and items[0][0] == "verbs":
+                if not self._pending:
+                    self._pending_since = _time.perf_counter()
                 self._pending.extend(items.popleft()[1])
             if self._pending:
                 self._exchange_one()
@@ -404,6 +466,14 @@ class _ExchangeStage:
         verbs = list(self._pending)
         t0 = _time.perf_counter()
         self.busy_since = t0
+        # perf forensics: the window's phase record, threaded through
+        # the exchange (this thread) into the apply stage (the actor),
+        # emitted as ONE compact flight event at apply-done
+        ph = None
+        if srv._phases_on():
+            ph = {}
+            if self._pending_since:
+                ph["form"] = max(0.0, t0 - self._pending_since)
         try:
             # the "server.window" span opens HERE (parented to the head
             # verb, exactly like the serial engine) so the nested
@@ -413,8 +483,12 @@ class _ExchangeStage:
             with ttrace.span("server.window", cat="server",
                              parent=verbs[0].trace_ctx,
                              args={"pending": len(verbs)}) as win_ctx:
+                _tp = _time.perf_counter()
                 local, used = srv._mh_pack_window(verbs)
-                windows = srv._mh_exchange_decode(local, self._my_rank)
+                if ph is not None:
+                    ph["pack"] = _time.perf_counter() - _tp
+                windows = srv._mh_exchange_decode(local, self._my_rank,
+                                                  ph)
         finally:
             now = _time.perf_counter()
             self.busy_since = 0.0
@@ -435,12 +509,22 @@ class _ExchangeStage:
         for _ in range(prefix):
             self._pending.popleft()
         self._emitted += 1
+        # re-led verbs' form clock restarts HERE: form measures how
+        # long the next window's head waited since the stage could
+        # have started it (the previous window's cut), not since the
+        # verb's original arrival — a stalled run would otherwise read
+        # cumulative, unbounded form times
+        self._pending_since = (_time.perf_counter() if self._pending
+                               else 0.0)
         fence_cause = srv._mh_fence_cause(descs[0], windows, prefix)
         if fence_cause is not None:
             self._fence_at = self._emitted
             self._fence_cause = fence_cause
+        if ph is not None:
+            ph["seq"] = srv._mh_seq - 1
+            ph["mepoch"] = multihost.membership_epoch()
         self.out.Push(("window", used[:prefix], windows, prefix, descs[0],
-                       t0, win_ctx))
+                       t0, win_ctx, ph))
 
 
 class Server(Actor):
@@ -527,6 +611,33 @@ class Server(Actor):
         self._t_fence_stall_s = tmetrics.histogram("engine.fence.stall_s")
         #: last classified fence cause (dashboard [Ops] line probe)
         self.last_fence_cause = ""
+        # round 11 — perf forensics: phase histograms + per-family
+        # apply seconds + the local binding-phase gauge, all registered
+        # EAGERLY so /metrics and the -stats_interval_s reporter show
+        # the whole taxonomy at zero from the first scrape
+        # handles CACHED on the engine (not looked up per window: the
+        # registry get takes a lock + an f-string — measurable against
+        # the <=2% phase-stamp budget on the blocking round)
+        self._t_phase = {p: tmetrics.histogram(f"engine.phase.{p}_s")
+                         for p in ENGINE_PHASES}
+        self._t_apply_fam = {
+            fam: tmetrics.histogram(f"engine.apply.table_s.{fam}")
+            for fam in _TABLE_FAMILIES}
+        #: tid -> (family, histogram) cache for the apply attribution
+        self._fam_cache: Dict[int, tuple] = {}
+        #: locally-dominant lifecycle phase of the last stamped window,
+        #: encoded as its ENGINE_PHASES index (-1 = none yet). A LOCAL
+        #: proxy only — the cross-rank binding verdict needs every
+        #: rank's dump (telemetry/critpath.py); the handler serving
+        #: this stays never-collective.
+        self._t_binding = tmetrics.gauge("engine.binding_phase")
+        self._t_binding.set(-1.0)
+        self.last_binding_phase = ""
+        #: single-process window counter for the 1-in-N full-stamp
+        #: sampling + the current window's stamp decision (read by
+        #: _local_window for the per-table attribution gating)
+        self._ph_tick = 0
+        self._ph_stamp_this = False
         self._ex_stage: Optional[_ExchangeStage] = None
         self._apply_since = 0.0   # apply interval start (overlap calc)
         self._overlap_s = 0.0
@@ -566,6 +677,10 @@ class Server(Actor):
     def RegisterTable(self, server_table) -> int:
         table_id = len(self.store_)
         self.store_.append(server_table)
+        # the id on the table itself: the perf-forensics surfaces
+        # (apply attribution, row-skew sketch metrics) name tables by
+        # family+id without walking the store
+        server_table.table_id = table_id
         return table_id
 
     def Stop(self) -> None:
@@ -613,6 +728,111 @@ class Server(Actor):
             if busy > 0:
                 self._t_overlap_pct.set(
                     min(100.0, 100.0 * self._overlap_s / busy))
+
+    # -- perf forensics: phase stamping (round 11) --------------------------
+
+    def _phases_on(self) -> bool:
+        """The phase-stamping gate: two cached flag reads (the flight
+        recorder's listener-cached capacity + -mv_phase_stamps)."""
+        return _phase_stamps_flag() and tflight.enabled()
+
+    def _ph_emit(self, ph: dict, nverbs: int) -> None:
+        """Emit one window's phase record: the ``window.phases`` flight
+        event (keyed by (mepoch, SEQ); durations in integer
+        microseconds) + the engine.phase.*_s histograms + the local
+        binding-phase gauge. Offsets in the detail re-anchor the
+        window's monotonic landmarks to the event's OWN ``tm`` stamp:
+
+        * ``xd`` — microseconds from exchange-done back to the event's
+          ``tm`` (so exchange-done's wall time = the event's ``t`` -
+          xd/1e6, which is the cross-rank rendezvous critpath aligns
+          clocks on);
+        * ``ax`` — microseconds from exchange-done to apply-start (the
+          decode + depth-queue gap).
+
+        Single-process windows carry only ``a`` (there is no exchange);
+        their seq stays -1, which keeps them out of the cross-rank
+        stream alignment by construction — and they take the fast path
+        below, because they ARE the blocking hot loop the tier-1
+        overhead guard times."""
+        apply_s = ph.get("apply", 0.0)
+        if "x" not in ph:
+            # apply-only window: one observe + one flight record (the
+            # gauge only moves when the binding phase CHANGES)
+            if apply_s > 0.0:
+                self._t_phase["apply"].observe(apply_s)
+                if self.last_binding_phase != "apply":
+                    self.last_binding_phase = "apply"
+                    self._t_binding.set(
+                        float(ENGINE_PHASES.index("apply")))
+            tflight.record("window.phases", seq=ph.get("seq", -1),
+                           epoch=self.window_epoch,
+                           mepoch=ph.get("mepoch", 0),
+                           detail=f"v={nverbs};a={int(apply_s * 1e6)}")
+            return
+        durs = {"form": ph.get("form", 0.0), "pack": ph.get("pack", 0.0),
+                "encode": ph.get("encode", 0.0),
+                "exchange": ph.get("x", 0.0),
+                "exchange_wait": ph.get("xw", 0.0),
+                "decode": ph.get("dec", 0.0),
+                "apply": ph.get("apply", 0.0)}
+        for name, secs in durs.items():
+            if secs > 0.0:
+                self._t_phase[name].observe(secs)
+        # local binding proxy: the phase that dominated this window's
+        # wall locally (exchange_wait stands in for "a peer bound us")
+        cand = {k: v for k, v in durs.items() if k != "exchange"}
+        binding = max(cand, key=cand.get) if any(cand.values()) else ""
+        if binding and binding != self.last_binding_phase:
+            self.last_binding_phase = binding
+            self._t_binding.set(float(ENGINE_PHASES.index(binding)))
+        parts = [f"v={nverbs}"]
+        for tag, key in (("f", "form"), ("p", "pack"), ("e", "encode"),
+                         ("x", "exchange"), ("xw", "exchange_wait"),
+                         ("d", "decode"), ("a", "apply")):
+            if durs[key] > 0.0:
+                parts.append(f"{tag}={int(durs[key] * 1e6)}")
+        x_done_m = ph.get("x_done_m", 0.0)
+        if x_done_m:
+            # anchor offsets vs a mono stamp taken JUST before record()
+            # samples its own (the gap is the record call itself, ~us —
+            # inside the documented alignment error bound)
+            now_m = _time.perf_counter()
+            parts.append(f"xd={int((now_m - x_done_m) * 1e6)}")
+            a_start = ph.get("a_start_m", 0.0)
+            if a_start:
+                parts.append(f"ax={int((a_start - x_done_m) * 1e6)}")
+        tflight.record("window.phases", seq=ph.get("seq", -1),
+                       epoch=self.window_epoch,
+                       mepoch=ph.get("mepoch", 0),
+                       detail=";".join(parts))
+
+    def _ph_tables(self, tbl: dict, seq: int, mepoch: int) -> None:
+        """Apply-time attribution per (table, verb): one
+        ``window.tables`` flight event (``<family><tid>:<A|G>=<us>``)
+        + the per-family engine.apply.table_s.* histograms — the
+        dataset that names WHICH table's ProcessAddRun is the
+        depth-fence culprit."""
+        parts = []
+        items = (tbl.items() if len(tbl) == 1 else sorted(tbl.items()))
+        for (tid, verb), secs in items:
+            cached = self._fam_cache.get(tid)
+            if cached is None:
+                try:
+                    fam = _table_family(self.store_[tid])
+                except Exception:
+                    fam = "table"
+                hist = self._t_apply_fam.get(
+                    fam) or tmetrics.histogram(
+                        f"engine.apply.table_s.{fam}")
+                cached = self._fam_cache[tid] = (fam, hist)
+            fam, hist = cached
+            hist.observe(secs)
+            parts.append(f"{fam}{tid}:{verb}={int(secs * 1e6)}")
+        if parts:
+            tflight.record("window.tables", seq=seq,
+                           epoch=self.window_epoch, mepoch=mepoch,
+                           detail=";".join(parts))
 
     # -- elastic plane hooks (round 10, elastic/) ---------------------------
 
@@ -822,13 +1042,31 @@ class Server(Actor):
             self._mh_windows(batch)
             return
         _t0 = _time.perf_counter()
+        phases = self._phases_on()
+        if phases:
+            self._ph_tick += 1
+            self._ph_stamp_this = (self._ph_tick
+                                   & (_PH_SP_SAMPLE - 1)) == 1
+        else:
+            self._ph_stamp_this = False
         with ttrace.span("server.window", cat="server",
                          args={"verbs": len(batch)}):
             self._local_window(batch)
         self.window_epoch += 1     # worker get-cache staleness clock
         tflight.record("window.applied", epoch=self.window_epoch,
                        detail=f"{len(batch)}v")
-        self._t_window_s.observe(_time.perf_counter() - _t0)
+        _win_s = _time.perf_counter() - _t0
+        self._t_window_s.observe(_win_s)
+        if phases:
+            # single-process window: the whole body is apply (there is
+            # no exchange); seq stays -1 so these never enter the
+            # cross-rank stream alignment. The apply histogram sees
+            # EVERY window; the flight record rides the 1-in-N sample
+            # (see the -mv_phase_stamps help text)
+            if self._ph_stamp_this:
+                self._ph_emit({"apply": _win_s}, len(batch))
+            else:
+                self._t_phase["apply"].observe(_win_s)
         # count Add/Get verbs only, like the mh path's prefix count —
         # the counter must mean the same thing in every topology
         self._t_verbs.inc(sum(1 for m in batch if m.msg_type in
@@ -851,6 +1089,11 @@ class Server(Actor):
                 segments.append([])
         pending = []   # (finalize, [msgs]) in dispatch order
         seen: Dict[tuple, int] = {}
+        # perf forensics: per-(table, verb) apply seconds — only on the
+        # 1-in-N sampled windows (_get_entry decides; the elastic
+        # post-transition drain path leaves the flag wherever the last
+        # window set it, which is fine for a sampled surface)
+        tbl = {} if self._ph_stamp_this else None
         for seg in segments:
             if not isinstance(seg, list):
                 # barrier: runs its normal handler in order, with
@@ -874,7 +1117,13 @@ class Server(Actor):
                 if m.msg_type is MsgType.Request_Add:
                     if m.table_id not in applied:
                         applied.add(m.table_id)
+                        _tt = (_time.perf_counter() if tbl is not None
+                               else 0.0)
                         self._process_add_run(add_runs[m.table_id])
+                        if tbl is not None:
+                            k = (m.table_id, "A")
+                            tbl[k] = (tbl.get(k, 0.0)
+                                      + _time.perf_counter() - _tt)
                         # a Get queued after this Add must not join a
                         # gather dispatched before it (it would observe
                         # LESS progress than was enqueued ahead of it) —
@@ -888,6 +1137,8 @@ class Server(Actor):
                     if key is not None and key in seen:
                         pending[seen[key]][1].append(m)
                         continue
+                    _tt = (_time.perf_counter() if tbl is not None
+                           else 0.0)
                     with monitor_region("SERVER_PROCESS_GET"):
                         try:
                             table = self.store_[m.table_id]
@@ -906,19 +1157,32 @@ class Server(Actor):
                             Log.Error("table ProcessGet dispatch failed: "
                                       "%r", exc)
                             m.reply(exc)
+                    if tbl is not None:
+                        k = (m.table_id, "G")
+                        tbl[k] = (tbl.get(k, 0.0)
+                                  + _time.perf_counter() - _tt)
         for finalize, msgs in pending:
+            _tt = _time.perf_counter() if tbl is not None else 0.0
+            err = None
             try:
                 result = finalize()
             except Exception as exc:
                 Log.Error("table %d Get finalize failed: %r",
                           msgs[0].table_id, exc)
+                err = exc
+            if tbl is not None:
+                k = (msgs[0].table_id, "G")
+                tbl[k] = tbl.get(k, 0.0) + _time.perf_counter() - _tt
+            if err is not None:
                 for m in msgs:
-                    m.reply(exc)
+                    m.reply(err)
                 continue
             msgs[0].reply(result)
             for m in msgs[1:]:
                 # each deduped caller owns its result arrays
                 m.reply(copy_result(result))
+        if tbl:
+            self._ph_tables(tbl, -1, 0)
 
     # -- multi-process WINDOWED protocol (round 5) --------------------------
     # The r4 design took the strict path: every table verb ran its own
@@ -1097,8 +1361,10 @@ class Server(Actor):
                     self._t_splits.inc()
                     self._dispatch(head)
                 else:
-                    _, mine, windows, prefix, descs0, t0, win_ctx = item
-                    self._pl_apply(mine, windows, prefix, descs0, win_ctx)
+                    (_, mine, windows, prefix, descs0, t0, win_ctx,
+                     ph) = item
+                    self._pl_apply(mine, windows, prefix, descs0,
+                                   win_ctx, ph)
                     for m in mine:
                         CHECK(fed.popleft() is m,
                               "pipeline completion order desync "
@@ -1129,15 +1395,21 @@ class Server(Actor):
         else:
             stage.feed_barrier(m)
 
-    def _pl_apply(self, verbs, windows, prefix, descs0, win_ctx) -> None:
+    def _pl_apply(self, verbs, windows, prefix, descs0, win_ctx,
+                  ph=None) -> None:
         """Apply one exchanged window on the actor thread, recording
-        the apply interval for the overlap telemetry."""
+        the apply interval for the overlap telemetry (and closing the
+        window's phase record — ``ph`` rode the stage's out queue from
+        the exchange thread)."""
         t0 = _time.perf_counter()
         self._apply_since = t0
+        if ph is not None:
+            ph["a_start_m"] = t0
         try:
             with ttrace.span("server.window.apply", cat="server",
                              parent=win_ctx, args={"verbs": prefix}):
-                self._mh_apply_window(verbs, windows, prefix, descs0)
+                self._mh_apply_window(verbs, windows, prefix, descs0,
+                                      seq=(ph or {}).get("seq", -1))
         finally:
             now = _time.perf_counter()
             self._apply_since = 0.0
@@ -1149,6 +1421,9 @@ class Server(Actor):
                 # the symmetric case when its exchange ends first)
                 self._note_overlap(max(0.0, now - max(b0, t0)))
             self.window_epoch += 1
+            if ph is not None:
+                ph["apply"] = now - t0
+                self._ph_emit(ph, prefix)
             tflight.record("window.applied", seq=self._mh_seq,
                            epoch=self.window_epoch,
                            mepoch=multihost.membership_epoch(),
@@ -1290,10 +1565,19 @@ class Server(Actor):
     #: decoded garbage.
     MH_WIRE_RETRIES = 2
 
-    def _mh_exchange_decode(self, local, my_rank: int) -> list:
+    def _mh_exchange_decode(self, local, my_rank: int,
+                            ph: Optional[dict] = None) -> list:
         """Encode + exchange + decode one window, deadline-bounded,
         retrying the full (collective) exchange when a received frame
-        fails its CRC32 trailer. Returns every rank's verb list."""
+        fails its CRC32 trailer. Returns every rank's verb list.
+
+        ``ph`` (perf forensics, round 11): accumulates this window's
+        encode/exchange/decode phase seconds — exchange split into
+        total wall vs time BLOCKED IN THE COLLECTIVE
+        (multihost.last_exchange_stats), whose done-stamps anchor the
+        cross-rank clock alignment. CRC retries accumulate into the
+        same phases (the retry cost is real window cost); the stamps
+        kept are the SUCCESSFUL exchange's."""
         last_exc = None
         for attempt in range(1 + self.MH_WIRE_RETRIES):
             # flat binary codec (parallel/wire.py): pickle's object-
@@ -1303,7 +1587,10 @@ class Server(Actor):
             # (bench compares it against the pickled baseline)
             _t0 = _time.perf_counter()
             blob = wire.encode_window(local, seq=self._mh_seq)
-            self._t_encode_s.observe(_time.perf_counter() - _t0)
+            _enc_s = _time.perf_counter() - _t0
+            self._t_encode_s.observe(_enc_s)
+            if ph is not None:
+                ph["encode"] = ph.get("encode", 0.0) + _enc_s
             cz = chaos.get()
             if cz is not None:
                 bad = cz.corrupt_blob(blob)
@@ -1314,12 +1601,21 @@ class Server(Actor):
             # head is the same global verb on every rank (FIFO + common-
             # prefix processing), and per-head payload sizes are stable
             # in steady loops — so the exchange stays on the 1-round path
+            _tx = _time.perf_counter()
             with ttrace.span("server.window.exchange", cat="server",
                              args={"bytes": len(blob)}):
                 blobs = self._bounded_collective(
                     lambda: multihost.capped_exchange(
                         blob, self._mh_caps, (local[0][0], local[0][1])),
                     "window exchange")
+            if ph is not None:
+                ph["x"] = ph.get("x", 0.0) + _time.perf_counter() - _tx
+                xs = multihost.last_exchange_stats()
+                ph["xw"] = ph.get("xw", 0.0) + xs["coll_s"]
+                # rendezvous anchor: every rank leaves this allgather
+                # at ~the same instant (critpath's clock-offset source)
+                ph["x_done_m"] = xs["done_m"]
+                ph["x_done_w"] = xs["done_w"]
             _t0 = _time.perf_counter()
             try:
                 windows: list = []
@@ -1354,7 +1650,10 @@ class Server(Actor):
                           "%d/%d): %r — re-exchanging", attempt + 1,
                           1 + self.MH_WIRE_RETRIES, exc)
                 continue
-            self._t_decode_s.observe(_time.perf_counter() - _t0)
+            _dec_s = _time.perf_counter() - _t0
+            self._t_decode_s.observe(_dec_s)
+            if ph is not None:
+                ph["dec"] = ph.get("dec", 0.0) + _dec_s
             self._mh_seq += 1
             self.mh_window_exchanges += 1
             self._t_exchanges.inc()
@@ -1432,8 +1731,12 @@ class Server(Actor):
 
     def _mh_collective_window_inner(self, verbs) -> int:
         my_rank = multihost.world_rank()
+        ph = {} if self._phases_on() else None
+        _tp = _time.perf_counter()
         local, used = self._mh_pack_window(verbs)
-        windows = self._mh_exchange_decode(local, my_rank)
+        if ph is not None:
+            ph["pack"] = _time.perf_counter() - _tp
+        windows = self._mh_exchange_decode(local, my_rank, ph)
         prefix = min(len(w) for w in windows)
         descs = [[(k, t) for k, t, _ in w[:prefix]] for w in windows]
         self._flight_exchanged(descs, my_rank)
@@ -1441,23 +1744,45 @@ class Server(Actor):
               f"multi-process verb streams diverge inside a window: "
               f"{descs} — every process must issue the same table-verb "
               f"sequence (the SPMD collective contract)")
-        self._mh_apply_window(used[:prefix], windows, prefix, descs[0])
+        seq = self._mh_seq - 1
+        if ph is not None:
+            ph["seq"] = seq
+            ph["mepoch"] = multihost.membership_epoch()
+            ph["a_start_m"] = _time.perf_counter()
+        self._mh_apply_window(used[:prefix], windows, prefix, descs[0],
+                              seq=seq)
         self.window_epoch += 1
+        if ph is not None:
+            ph["apply"] = _time.perf_counter() - ph["a_start_m"]
+            self._ph_emit(ph, prefix)
         tflight.record("window.applied", seq=self._mh_seq,
                        epoch=self.window_epoch,
                        mepoch=multihost.membership_epoch(),
                        detail=f"{prefix}v")
         return prefix
 
-    def _mh_apply_window(self, verbs, windows, prefix, descs0) -> None:
+    def _mh_apply_window(self, verbs, windows, prefix, descs0,
+                         seq: int = -1) -> None:
         """Apply one exchanged window's agreed prefix: cross-rank
         coalesced add runs + deduped get groups, replies to this rank's
         own messages. Shared by the serial engine and the pipelined
         apply stage — the semantics (ordering, grouping, error routing)
-        are identical in both."""
+        are identical in both. ``seq`` is this window's exchange SEQ
+        (perf forensics: keys the per-table apply attribution; -1 when
+        phases are off)."""
         my_rank = multihost.world_rank()
         self.mh_window_verbs += prefix
         self._t_verbs.inc(prefix)
+        # chaos rehearsal: a per-site APPLY delay on this rank only — a
+        # perf fault, not a correctness one (the stream stays lockstep;
+        # the delay models a slow apply stage, the straggler the
+        # critpath drill must attribute). Consulted once per window.
+        cz = chaos.get()
+        if cz is not None:
+            _delay = cz.apply_delay()
+            if _delay > 0.0:
+                _time.sleep(_delay)
+        tbl = {} if self._phases_on() else None
         # group per table: Add positions, and Get positions split into
         # the before/after segment around the table's one add-run
         add_pos: Dict[int, list] = {}
@@ -1477,20 +1802,30 @@ class Server(Actor):
                 if tid in applied:
                     continue
                 applied.add(tid)
+                _tt = _time.perf_counter() if tbl is not None else 0.0
                 with ttrace.span("server.window.add_run", cat="server",
                                  args={"table_id": tid,
                                        "positions": len(add_pos[tid])}):
                     self._mh_add_run(tid, add_pos[tid], parts_at, verbs,
                                      my_rank)
+                if tbl is not None:
+                    tbl[(tid, "A")] = (tbl.get((tid, "A"), 0.0)
+                                       + _time.perf_counter() - _tt)
             else:
                 seg = 0 if (tid not in add_pos or i < add_pos[tid][0]) else 1
                 if (tid, seg) in served:
                     continue
                 served.add((tid, seg))
+                _tt = _time.perf_counter() if tbl is not None else 0.0
                 with ttrace.span("server.window.get_group", cat="server",
                                  args={"table_id": tid}):
                     self._mh_get_group(tid, get_groups[(tid, seg)],
                                        parts_at, verbs, my_rank)
+                if tbl is not None:
+                    tbl[(tid, "G")] = (tbl.get((tid, "G"), 0.0)
+                                       + _time.perf_counter() - _tt)
+        if tbl:
+            self._ph_tables(tbl, seq, multihost.membership_epoch())
 
     def _mh_add_run(self, tid: int, positions, parts_at, verbs,
                     my_rank: int) -> None:

@@ -51,6 +51,7 @@ from multiverso_tpu.parallel.mesh import (SERVER_AXIS, ceil_block_rows,
                                           shard_map,
                                           storage_partition_server)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
+from multiverso_tpu.telemetry import sketch as tsketch
 from multiverso_tpu.updaters.base import AddOption, CreateUpdater, GetOption
 from multiverso_tpu.utils.log import CHECK
 
@@ -188,6 +189,14 @@ class MatrixServerTable(ServerTable):
             data = jnp.zeros((self.padded_rows, self.store_cols), self.dtype)
         aux = self.updater.init_aux((self.padded_rows, self.store_cols),
                                     self.dtype, zoo.num_workers)
+        # round 11 — access-skew measurement (-mv_row_sketch): a
+        # bounded Space-Saving top-K over Get row ids, created lazily
+        # when the flag arms (telemetry/sketch.py; the off path is one
+        # cached int read per Get). The groundwork for the ROADMAP's
+        # giant-table hot-row cache: /metrics carries the top-share
+        # gauge, the Dashboard [RowSkew] line + /perf carry the rows.
+        self._row_sketch = None
+        self._row_sketch_notes = 0
         # CPU-backend native host mirror (native/src/host_store.cc): the
         # GIL-free threaded C++ store applies/serves the HOST-plane verbs
         # for linear aux-free updaters; exactly one side is authoritative
@@ -1196,6 +1205,27 @@ class MatrixServerTable(ServerTable):
                                    None)
         return self._from_storage(self._zoo.mesh_ctx.fetch(data))
 
+    def _note_row_access(self, ids) -> None:
+        """Feed one Get's row ids to the ``-mv_row_sketch`` access-skew
+        sketch (telemetry/sketch.py; the off path is ONE cached int
+        read). Engine-thread updates; the /metrics top-share gauge
+        refreshes every 32 notes, not per Get."""
+        cap = tsketch.row_sketch_capacity()
+        if cap <= 0:
+            return
+        sk = self._row_sketch
+        if sk is None:
+            sk = self._row_sketch = tsketch.SpaceSaving(cap)
+        sk.update_ids(ids)
+        self._row_sketch_notes += 1
+        if self._row_sketch_notes & 31 == 1:
+            from multiverso_tpu.telemetry import metrics as tmetrics
+            fam = ("sparse" if "sparse" in type(self).__name__.lower()
+                   else "matrix")
+            tmetrics.gauge(
+                f"table.{fam}{getattr(self, 'table_id', 0)}"
+                f".row_skew_top_share").set(sk.top_share())
+
     def ProcessGetWindowParts(self, positions, my_rank: int):
         """Cross-rank get-dedup: serve a window segment's Gets from ONE
         merged read. Mirror-backed tables serve locally; otherwise one
@@ -1212,6 +1242,7 @@ class MatrixServerTable(ServerTable):
                     else:
                         ids = np.asarray(p["row_ids"], np.int32).ravel()
                         self._check_ids(ids)
+                        self._note_row_access(ids)
                         results.append(nat.get_rows(ids))
                 except Exception as exc:
                     results.append(exc)
@@ -1234,6 +1265,10 @@ class MatrixServerTable(ServerTable):
                 pos_ids.append(rank_ids)
             except Exception as exc:
                 pos_ids.append(exc)
+        for rank_ids in pos_ids:
+            if (not isinstance(rank_ids, Exception)
+                    and rank_ids[my_rank] is not None):
+                self._note_row_access(rank_ids[my_rank])
         if any_whole:
             full = self._full_logical()
             for parts, rank_ids in zip(positions, pos_ids):
@@ -1273,6 +1308,7 @@ class MatrixServerTable(ServerTable):
                 return nat.get_all()
             ids = np.asarray(p["row_ids"], np.int32).ravel()
             self._check_ids(ids)
+            self._note_row_access(ids)
             return nat.get_rows(ids)
         if any(q.get("row_ids") is None for q in parts):
             full = self._full_logical()
@@ -1305,6 +1341,7 @@ class MatrixServerTable(ServerTable):
             return self._full_logical()
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        self._note_row_access(ids)
         if nat is not None:
             # the store serves locally (multi-process: it is REPLICATED
             # per rank since round 5) — no union round needed
@@ -1345,6 +1382,7 @@ class MatrixServerTable(ServerTable):
             else:
                 ids = np.asarray(row_ids, np.int32).ravel()
                 self._check_ids(ids)
+                self._note_row_access(ids)
                 out = nat.get_rows(ids)
             return lambda: out
         if row_ids is None:
@@ -1360,6 +1398,7 @@ class MatrixServerTable(ServerTable):
             return lambda: self._from_storage(np.asarray(data))
         ids = np.asarray(row_ids, np.int32).ravel()
         self._check_ids(ids)
+        self._note_row_access(ids)
         padded_ids = _pad_id_batch(jnp.asarray(ids), next_bucket(len(ids)))
         rows = self._gather_rows(self.state["data"], self.state["aux"],
                                  padded_ids)

@@ -11,9 +11,17 @@ in production (one lock + tuple append per event; the 2% tier-1
 overhead guard in tests/test_opsplane.py holds it to that).
 
 Recording is allocation-cheap by construction: an event is one small
-tuple ``(t_wall, kind, seq, epoch, detail)`` appended to a
-``deque(maxlen=N)`` — no dicts, no formatting, no I/O on the hot path.
-Formatting happens only at dump/inspection time.
+tuple ``(t_wall, t_mono, kind, seq, epoch, detail, mepoch)`` appended
+to a ``deque(maxlen=N)`` — no dicts, no formatting, no I/O on the hot
+path. Formatting happens only at dump/inspection time.
+
+Every event is DUAL-STAMPED (round 11): ``time.time()`` (wall) for
+cross-rank alignment and ``time.perf_counter()`` (monotonic) for
+interval math — wall-clock alone corrupted phase durations whenever an
+NTP step landed mid-window. The dump header carries BOTH clocks
+sampled back to back (``dumped_at`` / ``dumped_at_mono``), so offline
+tools can convert any event's monotonic stamp into that rank's wall
+timeline: ``wall(tm) = dumped_at - (dumped_at_mono - tm)``.
 
 ``-mv_flight_events=0`` disables recording through the same
 listener-cached no-op gate pattern as the ``-telemetry``/``-trace``
@@ -75,13 +83,19 @@ class FlightRecorder:
 
     def record(self, cap: int, kind: str, seq: int, epoch: int,
                detail: str, mepoch: int = 0) -> None:
+        # dual stamp OUTSIDE the lock (back-to-back, so the pair is
+        # coherent): wall for cross-rank alignment, monotonic for
+        # NTP-step-proof interval math (telemetry/critpath.py)
+        t_wall = time.time()
+        t_mono = time.perf_counter()
         with self._lock:
             ring = self._ring
             if ring.maxlen != cap:
                 # capacity flag changed: keep the newest events that fit
                 ring = collections.deque(ring, maxlen=cap)
                 self._ring = ring
-            ring.append((time.time(), kind, seq, epoch, detail, mepoch))
+            ring.append((t_wall, t_mono, kind, seq, epoch, detail,
+                         mepoch))
             self._recorded += 1
 
     def stats(self) -> Tuple[int, int]:
@@ -96,23 +110,25 @@ class FlightRecorder:
         with self._lock:
             events = list(self._ring)
         for ev in reversed(events):
-            if ev[1] == kind:
-                return ev[4]
+            if ev[2] == kind:
+                return ev[5]
         return None
 
     def events(self, n: Optional[int] = None) -> List[dict]:
         """The newest ``n`` events (all when None) as dicts, oldest
-        first — the /flight endpoint + bundle tail shape. ``mepoch`` is
-        the membership epoch the event was recorded under (0 = boot
-        world; the elastic plane re-bases the exchange SEQ per
-        membership epoch, so forensics aligns by (mepoch, seq))."""
+        first — the /flight endpoint + bundle tail shape. ``t`` is the
+        wall clock, ``tm`` the monotonic stamp taken with it (interval
+        math rides ``tm``; cross-rank alignment rides ``t``).
+        ``mepoch`` is the membership epoch the event was recorded under
+        (0 = boot world; the elastic plane re-bases the exchange SEQ
+        per membership epoch, so forensics aligns by (mepoch, seq))."""
         with self._lock:
             raw = list(self._ring)
         if n is not None and n > 0:
             raw = raw[-n:]
-        return [{"t": ev[0], "kind": ev[1], "seq": ev[2],
-                 "epoch": ev[3], "detail": ev[4],
-                 "mepoch": ev[5] if len(ev) > 5 else 0}
+        return [{"t": ev[0], "tm": ev[1], "kind": ev[2], "seq": ev[3],
+                 "epoch": ev[4], "detail": ev[5],
+                 "mepoch": ev[6] if len(ev) > 6 else 0}
                 for ev in raw]
 
     def tail_text(self, n: int = 40) -> str:
@@ -180,9 +196,13 @@ def dump(path: str) -> str:
     ``path``. Local-only — never collective (each rank dumps its own
     ring; forensics.correlate aligns them offline)."""
     recorded, dropped = RECORDER.stats()
+    # BOTH clocks, sampled back to back: offline tools re-anchor any
+    # event's monotonic stamp onto this rank's wall timeline with
+    # wall(tm) = dumped_at - (dumped_at_mono - tm)
     header = {"flight_header": 1, "rank": _rank(), "pid": os.getpid(),
               "recorded": recorded, "dropped": dropped,
-              "dumped_at": time.time()}
+              "dumped_at": time.time(),
+              "dumped_at_mono": time.perf_counter()}
     with open(path, "w") as f:
         f.write(json.dumps(header) + "\n")
         for e in RECORDER.events():

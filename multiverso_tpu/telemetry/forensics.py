@@ -48,48 +48,18 @@ streams agree — useful in drills).
 
 from __future__ import annotations
 
-import json
-from typing import Dict, List, Optional
+from typing import List, Optional
+
+from multiverso_tpu.telemetry import align
 
 #: event kinds that are stream positions (collective-clock events)
 _STREAM_KINDS = ("window.exchanged", "barrier")
 
-
-def load(path: str) -> dict:
-    """Read one flight JSONL dump -> {"rank": r, "header": {...},
-    "events": [...]} (events oldest first)."""
-    header: dict = {}
-    events: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            if rec.get("flight_header"):
-                header = rec
-            else:
-                events.append(rec)
-    return {"rank": int(header.get("rank", -1)), "header": header,
-            "events": events, "path": path}
-
-
-def _stream(events: List[dict]) -> Dict[tuple, List[dict]]:
-    """(mepoch, seq) -> ordered stream events at that position.
-
-    The membership epoch joined the alignment key in round 10: the
-    elastic plane RE-BASES the exchange SEQ to 0 at every epoch
-    transition, so two healthy ranks legally both record seq 0 once
-    per epoch — keying on the (mepoch, seq) pair aligns streams across
-    an epoch boundary instead of flagging the re-base as a divergence.
-    Dumps from pre-elastic worlds carry no mepoch field and read as
-    epoch 0 throughout."""
-    out: Dict[tuple, List[dict]] = {}
-    for e in events:
-        if e.get("kind") in _STREAM_KINDS and e.get("seq", -1) >= 0:
-            key = (int(e.get("mepoch", 0) or 0), int(e["seq"]))
-            out.setdefault(key, []).append(e)
-    return out
+#: one flight JSONL dump -> {"rank", "header", "events", "path"} —
+#: shared with telemetry/critpath.py (telemetry/align.py owns the
+#: loader AND the (mepoch, seq) keying + ragged-tail rules, so the two
+#: tools cannot drift on epoch re-basing or hole classification)
+load = align.load
 
 
 def _desc(evs: Optional[List[dict]]) -> Optional[str]:
@@ -119,31 +89,21 @@ def correlate(paths: List[str]) -> dict:
     dropped nothing (a front-missing seq then cannot be eviction).
     """
     dumps = [load(p) for p in paths]
-    streams = {}
-    dropped = {}
-    for d in dumps:
-        rank = d["rank"] if d["rank"] >= 0 else len(streams)
-        streams[rank] = _stream(d["events"])
-        dropped[rank] = int(d["header"].get("dropped", 0))
+    streams, dropped = align.by_rank(dumps, _STREAM_KINDS)
     ranks = sorted(streams)
-    all_pos = sorted(set().union(*[set(s) for s in streams.values()])
-                     if streams else set())
+    all_pos = align.all_positions(streams)
     agreed: Optional[tuple] = None
     for pos in all_pos:
         mepoch, seq = pos
         descs = {r: _desc(streams[r].get(pos)) for r in ranks}
         present = {r: d for r, d in descs.items() if d is not None}
         missing = [r for r, d in descs.items() if d is None]
-        # a missing position only diverges when that rank recorded
-        # activity on BOTH sides of it (a hole). A dump that merely
-        # ends earlier (rank died/dumped first) covers a shorter range,
-        # not a divergent stream — and so does one that STARTS later
-        # because the bounded ring evicted its oldest events
-        # (dropped > 0 in the header); a front-missing position on a
-        # rank that dropped NOTHING really is a hole.
-        holes = [r for r in missing if streams[r]
-                 and pos < max(streams[r])
-                 and (pos > min(streams[r]) or dropped.get(r, 0) == 0)]
+        # the hole-vs-shorter-covered-range rule lives in align.is_hole
+        # (shared with critpath): a dump may legally end earlier (rank
+        # died / dumped first) or start later (bounded ring evicted its
+        # oldest events, dropped > 0) — only a genuine gap diverges
+        holes = [r for r in missing
+                 if align.is_hole(streams[r], pos, dropped.get(r, 0))]
         vals = set(present.values())
         if len(vals) > 1 or holes:
             per_rank = {r: descs[r] for r in ranks}

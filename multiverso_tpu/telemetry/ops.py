@@ -14,6 +14,11 @@ multi-world processes) starts one daemon thread at MV_Init running a
   200 while healthy, 503 once the engine is poisoned / its exchange
   stage died / the world stopped.
 * ``GET /flight`` — the recent flight-recorder events as JSON.
+* ``GET /perf`` — the LOCAL performance-forensics snapshot (round 11):
+  engine.phase.* histograms, per-family apply seconds, the local
+  binding-phase proxy and the ``-mv_row_sketch`` row-skew summaries.
+  The cross-rank binding verdict needs every rank's dump through
+  ``python -m multiverso_tpu.telemetry.critpath`` — the body says so.
 
 THE HANDLER NEVER ISSUES COLLECTIVES — same rule as the PR 2 periodic
 reporter: a scrape thread running allgathers would interleave with the
@@ -170,6 +175,52 @@ def health_report() -> dict:
     return out
 
 
+def perf_report() -> dict:
+    """LOCAL performance-forensics snapshot (the /perf body): phase
+    histograms, per-family apply seconds, the local binding-phase
+    proxy, last fence cause and the row-skew sketches. Never
+    collective — the cross-rank binding verdict needs every rank's
+    flight dump through ``python -m multiverso_tpu.telemetry.critpath``
+    (which this body says, so an operator scraping one rank is not
+    misled)."""
+    snap = metrics.snapshot()
+
+    def _hist(rec):
+        return {"count": rec.get("count", 0),
+                "sum_s": rec.get("sum", 0.0),
+                "p50_s": rec.get("p50", 0.0),
+                "p99_s": rec.get("p99", 0.0)}
+
+    out = {"phases": {}, "apply_tables": {}, "binding_phase": None,
+           "last_fence_cause": None, "row_skew": [],
+           "note": ("local rank only — cross-rank critical path: dump "
+                    "flight rings on every rank and run python -m "
+                    "multiverso_tpu.telemetry.critpath")}
+    for name, rec in snap.items():
+        if (name.startswith("engine.phase.")
+                and rec.get("type") == "histogram"):
+            out["phases"][name[len("engine.phase."):-2]] = _hist(rec)
+        elif (name.startswith("engine.apply.table_s.")
+                and rec.get("type") == "histogram"):
+            out["apply_tables"][name.rsplit(".", 1)[-1]] = _hist(rec)
+    try:
+        from multiverso_tpu.zoo import Zoo
+        eng = Zoo.Get().server_engine
+        if eng is not None:
+            out["binding_phase"] = (getattr(eng, "last_binding_phase",
+                                            "") or None)
+            out["last_fence_cause"] = (getattr(eng, "last_fence_cause",
+                                               "") or None)
+            for tid, table in enumerate(getattr(eng, "store_", [])):
+                sk = getattr(table, "_row_sketch", None)
+                if sk is not None:
+                    out["row_skew"].append(dict(sk.summary(),
+                                                table_id=tid))
+    except Exception:           # engine torn down mid-scrape
+        pass
+    return out
+
+
 class _OpsHandler(BaseHTTPRequestHandler):
     # one scrape per connection is the expected pattern; keep-alive off
     # so a dangling scraper can't pin handler threads across Zoo.Stop
@@ -203,9 +254,13 @@ class _OpsHandler(BaseHTTPRequestHandler):
                     {"recorded": rec, "dropped": drop,
                      "events": flight.events(512)}),
                     "application/json")
+            elif path == "/perf":
+                self._send(200, json.dumps(perf_report(), indent=1,
+                                           sort_keys=True),
+                           "application/json")
             else:
                 self._send(404, "unknown path (know /metrics /healthz "
-                                "/flight)\n", "text/plain")
+                                "/flight /perf)\n", "text/plain")
         except Exception as exc:    # never kill the handler thread
             try:
                 self._send(500, f"ops handler failed: {exc!r}\n",

@@ -183,13 +183,30 @@ def flow_end(ctx: Optional[SpanContext], name: str = "mv.msg") -> None:
              "tid": threading.get_ident()})
 
 
+def chrome_trace(events: list, process_names: Optional[dict] = None,
+                 thread_names: Optional[dict] = None) -> dict:
+    """Wrap prepared trace events as a Chrome trace-event object
+    (Perfetto / chrome://tracing loadable) — THE one writer both the
+    live span dump below and offline reconstructions
+    (telemetry/critpath.py's merged cross-rank timeline) ride, so the
+    export schema cannot fork. ``process_names``: {pid: label};
+    ``thread_names``: {(pid, tid): label}."""
+    meta = []
+    for pid, name in sorted((process_names or {}).items()):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": name}})
+    for (pid, tid), name in sorted((thread_names or {}).items()):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": name}})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def to_chrome_trace() -> dict:
     """The buffered events as a Chrome trace-event object (JSON-ready)."""
     with _events_lock:
         events = list(_events)
-    meta = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
-             "tid": 0, "args": {"name": _process_label()}}]
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    return chrome_trace(events,
+                        process_names={os.getpid(): _process_label()})
 
 
 def _process_label() -> str:

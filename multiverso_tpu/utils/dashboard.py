@@ -195,11 +195,31 @@ class Dashboard:
             eng = Zoo.Get().server_engine
             last_fence = (getattr(eng, "last_fence_cause", "")
                           if eng is not None else "")
+            last_binding = (getattr(eng, "last_binding_phase", "")
+                            if eng is not None else "")
             lines = [
                 f"[Ops] flight_events = {recorded} recorded / "
                 f"{dropped} dropped, ops_port = "
                 f"{port if port is not None else 'off'}, "
-                f"last_fence = {last_fence or '-'}"]
+                f"last_fence = {last_fence or '-'}, "
+                f"last_binding_phase = {last_binding or '-'}"]
+            # round 11 — the -mv_row_sketch access-skew measurement:
+            # one [RowSkew] line per armed table (top rows + share)
+            if eng is not None:
+                for tid, table in enumerate(getattr(eng, "store_", [])):
+                    sk = getattr(table, "_row_sketch", None)
+                    if sk is None:
+                        continue
+                    # top_share over the same TOP_N the /metrics gauge
+                    # and /perf use — one name, one number everywhere;
+                    # only the hottest-rows PREVIEW is truncated
+                    s = sk.summary()
+                    top = ", ".join(f"{r['key']}x{r['count']}"
+                                    for r in s["top"][:4])
+                    lines.append(
+                        f"[RowSkew] table {tid}: top_share = "
+                        f"{100 * s['top_share']:.1f}% of "
+                        f"{s['total']} gets, hottest = [{top}]")
             from multiverso_tpu import elastic
             el = elastic.state_report()
             if el is not None:

@@ -1,0 +1,562 @@
+"""Perf forensics (round 11): cross-rank critical-path reconstruction,
+phase stamping, the row-skew sketch and the phase-stamp overhead guard.
+
+* critpath synthetic matrix — skewed wall clocks recovered from the
+  exchange-done rendezvous, a deliberate straggler named as the
+  binding rank with phase ``apply``, ragged/evicted tails shrinking
+  coverage without false verdicts, single-rank dumps degrading
+  gracefully, Chrome-trace export schema;
+* live phase stamping — ``window.phases``/``window.tables`` events +
+  ``engine.phase.*_s`` histograms + the ``/perf`` endpoint;
+* 2-proc drills — a clean run whose per-window phase sums account for
+  the window wall within the documented bound, and a chaos
+  ``apply.delay`` straggler on rank 0 that the report must attribute;
+* overhead guard — phase stamping must stay within the same
+  ``max(2%, 2x noise)`` blocking-round budget as the flight recorder.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.telemetry import align, critpath, flight, metrics, ops
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+from tests.test_multihost import run_two_process
+
+
+# -- synthetic dump builder ---------------------------------------------
+
+
+def write_phase_dump(path, rank, windows, dropped=0, wall_off=0.0,
+                     mono_off=0.0, tables=()):
+    """Write a synthetic flight dump whose ``window.phases`` events
+    describe ``windows``: dicts with ``seq``, ``x_done`` (true wall
+    seconds of the exchange-done rendezvous) and phase durations in
+    microseconds (``f p e x xw d a ax``). ``wall_off``/``mono_off``
+    skew this rank's clocks — critpath must undo the wall skew."""
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "flight_header": 1, "rank": rank, "pid": 1,
+            "recorded": len(windows) + dropped, "dropped": dropped,
+            "dumped_at": 1e9 + wall_off,
+            "dumped_at_mono": 1e5 + mono_off}) + "\n")
+        for w in windows:
+            xd = w.get("xd", 120)           # event recorded xd us later
+            t = w["x_done"] + wall_off + xd * 1e-6
+            tm = w["x_done"] + mono_off + xd * 1e-6
+            parts = [f"v={w.get('v', 2)}"]
+            for tag in ("f", "p", "e", "x", "xw", "d", "a", "ax"):
+                if tag in w:
+                    parts.append(f"{tag}={w[tag]}")
+            parts.append(f"xd={xd}")
+            f.write(json.dumps({
+                "t": t, "tm": tm, "kind": "window.phases",
+                "seq": w["seq"], "epoch": -1,
+                "detail": ";".join(parts),
+                "mepoch": w.get("mepoch", 0)}) + "\n")
+        for seq, detail in tables:
+            f.write(json.dumps({
+                "t": 1.0, "tm": 1.0, "kind": "window.tables",
+                "seq": seq, "epoch": -1, "detail": detail,
+                "mepoch": 0}) + "\n")
+
+
+def straggler_windows(n, straggler: bool):
+    """``n`` windows 60ms apart: the straggler rank enters each
+    exchange last (tiny collective wait, 50ms applies); the healthy
+    rank sits 55ms blocked in the allgather waiting for it."""
+    out = []
+    for i in range(n):
+        base = 10.0 + 0.060 * i
+        common = dict(f=50, p=200, e=100, d=150, ax=300)
+        if straggler:
+            out.append(dict(seq=i, x_done=base, x=2_000, xw=1_500,
+                            a=50_000, **common))
+        else:
+            out.append(dict(seq=i, x_done=base, x=55_000, xw=54_000,
+                            a=1_000, **common))
+    return out
+
+
+class TestCritpathSynthetic:
+    def test_skewed_clocks_recovered_and_straggler_attributed(
+            self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        p1 = str(tmp_path / "r1.jsonl")
+        write_phase_dump(p0, 0, straggler_windows(8, False),
+                         tables=[(0, "matrix0:A=1000")])
+        # rank 1: wall clock 17s ahead (an NTP step), mono unrelated
+        write_phase_dump(p1, 1, straggler_windows(8, True),
+                         wall_off=17.0, mono_off=-3.0,
+                         tables=[(0, "matrix0:A=2500;kv1:G=400")])
+        rep = critpath.correlate([p0, p1])
+        assert rep["degraded"] is None
+        assert abs(rep["clock_offsets_s"][1] - 17.0) < 1e-3, rep
+        assert rep["align_err_s"] < 1e-3
+        assert rep["n_windows"] == 8
+        # the straggler binds (it enters every exchange last)...
+        assert rep["binding_rank_hist"] == {1: 8}
+        # ...and its slow APPLY is the attributed cause (the first
+        # window has no predecessor gap — 'exchange' there is correct)
+        assert rep["binding_phase_hist"].get("apply", 0) >= 7, rep
+        # wait asymmetry: the HEALTHY rank accumulated the blocked time
+        assert (rep["exchange_wait_excess_s"][0]
+                > rep["exchange_wait_excess_s"][1] + 0.1)
+        # table attribution merged across ranks, hottest first
+        assert rep["tables_top"][0]["table"] == "matrix0"
+        assert rep["tables_top"][0]["seconds"] > 0.003 - 1e-9
+        text = critpath.report_text(rep)
+        assert "rank 1 binds 8/8" in text
+        assert "apply" in text
+
+    def test_ragged_tail_and_evicted_head_shrink_coverage(
+            self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        p1 = str(tmp_path / "r1.jsonl")
+        write_phase_dump(p0, 0, straggler_windows(10, False))
+        # rank 1's ring evicted seqs 0-1 (dropped>0) and it dumped
+        # before seqs 8-9 — the overlap 2..7 must still correlate
+        write_phase_dump(p1, 1, straggler_windows(10, True)[2:8],
+                         dropped=5)
+        rep = critpath.correlate([p0, p1])
+        assert rep["degraded"] is None
+        assert rep["n_windows"] == 6
+        assert rep["coverage"], rep
+        assert "evicted" in rep["coverage"]
+        assert rep["binding_rank_hist"] == {1: 6}
+
+    def test_single_rank_dump_degrades_to_local_totals(self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        write_phase_dump(p0, 0, straggler_windows(4, True))
+        rep = critpath.correlate([p0])
+        assert rep["degraded"] and "single-rank" in rep["degraded"]
+        assert rep["binding_rank_hist"] == {}
+        # local phase totals still present (4 x 50ms applies)
+        assert abs(rep["phase_totals_s"][0]["apply"] - 0.2) < 1e-6
+        assert critpath.main([p0]) == 2
+
+    def test_single_proc_only_records_degrade_with_totals(
+            self, tmp_path):
+        # a 1-proc world stamps seq=-1 records: no stream positions to
+        # align, but the LOCAL phase totals are real and must be kept
+        p0 = str(tmp_path / "r0.jsonl")
+        with open(p0, "w") as f:
+            f.write(json.dumps({"flight_header": 1, "rank": 0,
+                                "pid": 1, "recorded": 2,
+                                "dropped": 0}) + "\n")
+            for _ in range(2):
+                f.write(json.dumps({"t": 1.0, "tm": 1.0,
+                                    "kind": "window.phases", "seq": -1,
+                                    "epoch": 1, "detail": "v=1;a=5000",
+                                    "mepoch": 0}) + "\n")
+        rep = critpath.correlate([p0])
+        assert rep["degraded"] and "single-process" in rep["degraded"]
+        assert abs(rep["phase_totals_s"][0]["apply"] - 0.01) < 1e-9
+
+    def test_no_phase_events_degrades(self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        with open(p0, "w") as f:
+            f.write(json.dumps({"flight_header": 1, "rank": 0,
+                                "pid": 1, "recorded": 1,
+                                "dropped": 0}) + "\n")
+            f.write(json.dumps({"t": 1.0, "tm": 1.0,
+                                "kind": "window.exchanged", "seq": 0,
+                                "epoch": -1, "detail": "A0"}) + "\n")
+        rep = critpath.correlate([p0])
+        assert rep["degraded"] and "no window.phases" in rep["degraded"]
+
+    def test_mepoch_keys_streams_apart(self, tmp_path):
+        # same seqs under two membership epochs must NOT collide: 4
+        # windows per epoch yield 8 alignable positions
+        p0 = str(tmp_path / "r0.jsonl")
+        p1 = str(tmp_path / "r1.jsonl")
+        wins0, wins1 = [], []
+        for me in (0, 1):
+            for w in straggler_windows(4, False):
+                wins0.append(dict(w, mepoch=me,
+                                  x_done=w["x_done"] + me * 10))
+            for w in straggler_windows(4, True):
+                wins1.append(dict(w, mepoch=me,
+                                  x_done=w["x_done"] + me * 10))
+        write_phase_dump(p0, 0, wins0)
+        write_phase_dump(p1, 1, wins1)
+        rep = critpath.correlate([p0, p1])
+        assert rep["n_windows"] == 8
+        assert [w["pos"] for w in rep["windows"]] == sorted(
+            [w["pos"] for w in rep["windows"]])
+
+    def test_chrome_trace_schema(self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        p1 = str(tmp_path / "r1.jsonl")
+        write_phase_dump(p0, 0, straggler_windows(4, False))
+        write_phase_dump(p1, 1, straggler_windows(4, True),
+                         wall_off=5.0)
+        obj = critpath.to_chrome_trace([p0, p1])
+        evs = obj["traceEvents"]
+        assert obj["displayTimeUnit"] == "ms"
+        procs = [e for e in evs if e.get("ph") == "M"
+                 and e["name"] == "process_name"]
+        assert {e["pid"] for e in procs} == {0, 1}
+        threads = [e for e in evs if e.get("ph") == "M"
+                   and e["name"] == "thread_name"]
+        # one track per rank x stage
+        stages = {e["args"]["name"] for e in threads}
+        assert stages == set(critpath._TRACKS)
+        slices = [e for e in evs if e.get("ph") == "X"]
+        assert slices
+        for e in slices:
+            assert e["dur"] > 0 and e["ts"] >= 0
+            assert e["pid"] in (0, 1)
+            assert "seq" in e["args"]
+        # apply slices exist on both ranks and exchange slices of one
+        # window overlap across ranks after alignment
+        assert any(e["name"].startswith("apply") for e in slices)
+
+    def test_cli_writes_trace_json(self, tmp_path):
+        p0 = str(tmp_path / "r0.jsonl")
+        p1 = str(tmp_path / "r1.jsonl")
+        write_phase_dump(p0, 0, straggler_windows(4, False))
+        write_phase_dump(p1, 1, straggler_windows(4, True))
+        out = str(tmp_path / "merged.json")
+        assert critpath.main([p0, p1, "--trace", out]) == 0
+        obj = json.loads(open(out).read())
+        assert obj["traceEvents"]
+        assert critpath.main([p0, p1, "--json"]) == 0
+
+    def test_detail_parser_tolerates_garbage(self):
+        assert critpath._parse_detail("") == {}
+        assert critpath._parse_detail("nonsense;;x=;a=12")["a"] == 12.0
+        rec = critpath._window_record(
+            {"t": 1.0, "tm": 2.0, "detail": "v=1;a=100"})
+        assert rec["x_done_m"] is None
+        assert abs(rec["apply"] - 100e-6) < 1e-12
+
+
+class TestAlignRules:
+    def test_hole_vs_tail_vs_eviction(self):
+        stream = {(0, 0): [{}], (0, 1): [{}], (0, 3): [{}]}
+        # tail: beyond the last covered position is never a hole
+        assert not align.is_hole(stream, (0, 4), dropped=0)
+        # middle gap: always a hole
+        assert align.is_hole(stream, (0, 2), dropped=7)
+        # front-missing: eviction explains it only when drops occurred
+        stream2 = {(0, 2): [{}], (0, 3): [{}]}
+        assert align.is_hole(stream2, (0, 0), dropped=0)
+        assert not align.is_hole(stream2, (0, 0), dropped=3)
+
+    def test_common_positions_and_coverage(self):
+        streams = {0: {(0, i): [{}] for i in range(5)},
+                   1: {(0, i): [{}] for i in range(2, 5)}}
+        assert align.common_positions(streams) == [(0, 2), (0, 3),
+                                                   (0, 4)]
+        note = align.coverage_note(streams, {0: 0, 1: 4})
+        assert note and "rank 1" in note and "3/5" in note
+
+
+# -- live phase stamping -------------------------------------------------
+
+
+class TestPhaseStampingLive:
+    def setup_method(self):
+        # the ring is process-global: events from a previous test's
+        # world must not satisfy (or violate) this test's assertions
+        flight._reset_for_tests()
+
+    def test_single_proc_windows_stamp_phases_and_tables(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init([])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            table.GetRows(ids)
+            kinds = [e["kind"] for e in flight.events()]
+            assert "window.phases" in kinds
+            assert "window.tables" in kinds
+            # single-proc: apply-only records, never stream positions
+            for e in flight.events():
+                if e["kind"] in ("window.phases", "window.tables"):
+                    assert e["seq"] == -1
+                    assert "tm" in e
+            snap = metrics.snapshot()
+            assert snap["engine.phase.apply_s"]["count"] >= 1
+            assert snap["engine.apply.table_s.matrix"]["count"] >= 1
+            # eager registration: the whole taxonomy visible at zero
+            for p in ("form", "pack", "encode", "exchange",
+                      "exchange_wait", "decode"):
+                assert snap[f"engine.phase.{p}_s"]["type"] == "histogram"
+            assert snap["engine.binding_phase"]["value"] == float(
+                list(("form", "pack", "encode", "exchange",
+                      "exchange_wait", "decode", "apply")).index("apply"))
+        finally:
+            mv.MV_ShutDown()
+
+    def test_phase_stamps_flag_gates_events_off(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init(["-mv_phase_stamps=false"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            table.GetRows(ids)
+            kinds = {e["kind"] for e in flight.events()}
+            assert "window.phases" not in kinds
+            assert "window.tables" not in kinds
+            assert "window.applied" in kinds    # base events untouched
+        finally:
+            mv.MV_ShutDown()
+
+    def test_perf_endpoint_serves_local_snapshot(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        mv.MV_Init(["-mv_ops_port=0", "-mv_row_sketch=8"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            for _ in range(3):
+                table.GetRows(ids)
+            port = ops.port()
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/perf", timeout=10).read()
+            rep = json.loads(body)
+            assert rep["phases"]["apply"]["count"] >= 1
+            assert "matrix" in rep["apply_tables"]
+            assert rep["binding_phase"] == "apply"
+            assert rep["row_skew"] and rep["row_skew"][0]["total"] > 0
+            assert "critpath" in rep["note"]
+        finally:
+            mv.MV_ShutDown()
+
+
+class TestRowSketch:
+    def test_space_saving_bounds_and_heavy_hitters(self):
+        from multiverso_tpu.telemetry.sketch import SpaceSaving
+        sk = SpaceSaving(8)
+        rng = np.random.default_rng(0)
+        truth = {}
+        # two heavy hitters over a long uniform tail
+        for _ in range(200):
+            for key in (7, 13):
+                sk.update(key, 5)
+                truth[key] = truth.get(key, 0) + 5
+            for key in rng.integers(100, 10_000, size=4).tolist():
+                sk.update(key)
+                truth[key] = truth.get(key, 0) + 1
+        # bounded
+        assert len(sk._counts) <= 8
+        top = sk.top(2)
+        assert {k for k, _, _ in top} == {7, 13}
+        for key, count, err in top:
+            assert count >= truth[key]             # never undercounts
+            assert count - err <= truth[key]       # bound is honest
+        assert 0.0 < sk.top_share(2) < 1.0
+        s = sk.summary(2)
+        assert s["total"] == sk.total and len(s["top"]) == 2
+
+    def test_update_ids_counts_duplicates(self):
+        from multiverso_tpu.telemetry.sketch import SpaceSaving
+        sk = SpaceSaving(4)
+        sk.update_ids(np.array([3, 3, 3, 9], np.int64))
+        assert dict((k, c) for k, c, _ in sk.top()) == {3: 3, 9: 1}
+
+    def test_live_sketch_off_by_default_and_gauge_when_armed(self):
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        mv.MV_Init([])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.arange(8, dtype=np.int32)
+            table.AddRows(ids, np.ones((8, 4), np.float32))
+            table.GetRows(ids)
+            srv = Zoo.Get().server_engine.store_[0]
+            assert srv._row_sketch is None      # off = no sketch at all
+        finally:
+            mv.MV_ShutDown()
+        mv.MV_Init(["-mv_row_sketch=16"])
+        try:
+            table = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                        num_cols=4))
+            ids = np.array([5, 5, 5, 6], np.int32)
+            table.AddRows(np.arange(8, dtype=np.int32),
+                          np.ones((8, 4), np.float32))
+            table.GetRows(ids)
+            srv = Zoo.Get().server_engine.store_[0]
+            assert srv._row_sketch is not None
+            assert srv._row_sketch.top()[0][0] == 5
+            snap = metrics.snapshot()
+            assert snap["table.matrix0.row_skew_top_share"]["value"] > 0
+            from multiverso_tpu.utils.dashboard import Dashboard
+            lines = Dashboard._ops_lines()
+            assert any(ln.startswith("[RowSkew]") for ln in lines), lines
+        finally:
+            mv.MV_ShutDown()
+
+
+# -- phase-stamp overhead guard (tier-1) ---------------------------------
+
+
+class TestPhaseStampOverheadGuard:
+    def test_blocking_round_overhead_within_budget(self):
+        """Phase stamping (on by default) must cost <= max(2%, 2x
+        measured baseline noise) on the blocking host round vs
+        -mv_phase_stamps=0 — the flight recorder's own tier-1 budget,
+        extended to the round-11 stamping. Off/on worlds interleave
+        with best-per-side so scheduler jitter can't flake a healthy
+        build."""
+        from multiverso_tpu.tables import MatrixTableOption
+
+        k, rounds = 512, 15
+        rng = np.random.default_rng(11)
+
+        def measure(argv):
+            mv.MV_Init(list(argv))
+            try:
+                table = mv.MV_CreateTable(MatrixTableOption(
+                    num_rows=8192, num_cols=8))
+                ids = rng.choice(8192, size=k,
+                                 replace=False).astype(np.int32)
+                deltas = rng.standard_normal((k, 8)).astype(np.float32)
+                table.AddRows(ids, deltas)      # warm the jit caches
+                table.GetRows(ids)
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        table.AddRows(ids, deltas)
+                        table.GetRows(ids)
+                    best = min(best, time.perf_counter() - t0)
+            finally:
+                mv.MV_ShutDown()
+            return best / rounds
+
+        # 3 interleaved worlds per side (one more than the flight
+        # guard): the stamping's true cost sits near the 2% bar, so
+        # the min must converge below the ±20% per-world session
+        # noise. A failure must REPRODUCE on a second independent
+        # measurement — this box shows occasional whole-world slow
+        # patches that alternate-world interleaving cannot launder
+        # out, and a genuine regression past the bar fails both.
+        last = None
+        for _attempt in range(2):
+            offs, ons = [], []
+            for _ in range(3):
+                offs.append(measure(["-mv_phase_stamps=0"]))
+                ons.append(measure([]))
+            base, on = min(offs), min(ons)
+            noise_pct = 100.0 * (max(offs) - base) / base
+            overhead_pct = 100.0 * (on - base) / base
+            allowed = max(2.0, 2.0 * noise_pct)
+            if overhead_pct <= allowed:
+                return
+            last = (f"phase stamping overhead {overhead_pct:.2f}% "
+                    f"exceeds {allowed:.2f}% (baseline noise "
+                    f"{noise_pct:.2f}%; "
+                    f"off={[round(o * 1e6) for o in offs]}us, "
+                    f"on={[round(o * 1e6) for o in ons]}us per round)")
+        raise AssertionError(last)
+
+
+# -- 2-proc drills -------------------------------------------------------
+
+_HDR = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+'''
+
+_DRILL_CHILD = _HDR + r'''
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.telemetry import flight
+
+diag, mode = sys.argv[3], sys.argv[4]
+args = [f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+        "-dist_size=2", "-mv_deadline_s=60"]
+if mode == "straggle" and rank == 0:
+    # THE deliberate straggler: rank 0's every window apply stalls
+    # 30ms (a perf fault — the verb stream stays lockstep)
+    args.append("-chaos_spec=apply.delay:1.0@0.03")
+mv.MV_Init(args)
+tab0 = mv.MV_CreateTable(MatrixTableOption(num_rows=4096, num_cols=32))
+tab1 = mv.MV_CreateTable(MatrixTableOption(num_rows=4096, num_cols=32))
+ids = np.arange(4000, dtype=np.int32)
+d = np.ones((4000, 32), np.float32)        # ~512KB per add
+tab0.AddRows(ids, d)                                    # warm
+tab1.AddRows(ids, d)
+mv.MV_Barrier()
+# lockstep windows: SUSTAINED fire-and-forget bursts. Alternating
+# tables defeats worker-side combining and half-MB payloads keep
+# windows byte-limited (~8 verbs under the 4MB budget), so a stalled
+# apply can't merge the whole burst into one giant window — the run
+# yields ENOUGH windows that the steady pipelined regime (where a
+# slow apply genuinely gates the next exchange through the depth
+# fence) dominates the depth-2 runahead at burst start
+for _ in range(3):
+    for _ in range(16):
+        tab0.AddFireForget(d, row_ids=ids)
+        tab1.AddFireForget(d, row_ids=ids)
+    tab0.Wait(tab0.GetAsyncHandle(row_ids=ids[:16]))
+mv.MV_Barrier()
+flight.dump(os.path.join(diag, f"flight_rank{rank}.jsonl"))
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} CRITPATH DRILL OK", flush=True)
+'''
+
+
+class TestCritpathDrill:
+    def _run(self, tmp_path, mode):
+        run_two_process(_DRILL_CHILD, tmp_path, str(tmp_path), mode,
+                        expect="CRITPATH DRILL OK")
+        p0 = str(tmp_path / "flight_rank0.jsonl")
+        p1 = str(tmp_path / "flight_rank1.jsonl")
+        assert os.path.exists(p0) and os.path.exists(p1)
+        return critpath.correlate([p0, p1])
+
+    def test_chaos_straggler_is_named_binding_with_apply(self, tmp_path):
+        """Acceptance (round 11): a chaos apply.delay on rank 0's apply
+        path makes the straggler report name rank 0 as binding for the
+        majority of windows, attributed to the apply phase."""
+        rep = self._run(tmp_path, "straggle")
+        assert rep["degraded"] is None, rep
+        total = sum(rep["binding_rank_hist"].values())
+        assert total >= 4, rep
+        assert rep["binding_rank_hist"].get(0, 0) > total / 2, rep
+        phases = rep["binding_phase_hist"]
+        assert phases.get("apply", 0) > sum(phases.values()) / 2, rep
+        # the healthy rank accumulated the exchange wait
+        assert (rep["exchange_wait_excess_s"][1]
+                > rep["exchange_wait_excess_s"][0]), rep
+
+    def test_clean_run_phase_sums_account_for_window_wall(
+            self, tmp_path):
+        """Acceptance (round 11): on a clean lockstep run the
+        per-window phase sums account for the window wall within the
+        documented bound (alignment error + 2x the apply-stage poll
+        granularity + scheduler jitter — DESIGN.md §13)."""
+        rep = self._run(tmp_path, "clean")
+        assert rep["degraded"] is None, rep
+        assert rep["n_windows"] >= 4, rep
+        # alignment error on one host is the collective exit skew —
+        # small, but assert only the documented order of magnitude
+        assert rep["align_err_s"] < 0.05, rep
+        gaps = [w["unaccounted_s"] for w in rep["windows"]
+                if w["unaccounted_s"] is not None]
+        assert gaps, rep
+        bound = rep["align_err_s"] + 2 * 0.002 + 0.010
+        med = sorted(gaps)[len(gaps) // 2]
+        assert med <= bound, (med, bound, rep["windows"])
+        assert rep["accounted_pct"] is not None

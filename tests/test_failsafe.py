@@ -404,9 +404,11 @@ class TestBlockingPathLint:
         # bounded) so a future restructuring can't silently drop it
         assert any(rel.startswith(("serving/", "serving\\"))
                    for rel in scanned), sorted(scanned)
-        # ...and the ops-plane modules (round 9): the HTTP server stop
-        # and every dump path must stay bounded too
-        for need in ("flight.py", "ops.py", "forensics.py"):
+        # ...and the ops-plane modules (round 9) + the perf-forensics
+        # modules (round 11): the HTTP server stop and every dump path
+        # must stay bounded too
+        for need in ("flight.py", "ops.py", "forensics.py",
+                     "critpath.py", "align.py", "sketch.py"):
             assert any(rel.endswith(need)
                        and rel.startswith(("telemetry/", "telemetry\\"))
                        for rel in scanned), sorted(scanned)

@@ -309,9 +309,11 @@ class TestNoBarePrintLint:
         # the logger like everything else
         assert any(rel.startswith("serving") for rel in scanned), \
             sorted(scanned)
-        # ...and the ops-plane modules (round 9): the forensics CLI and
-        # the HTTP handler both emit text and must ride the logger too
-        for need in ("flight.py", "ops.py", "forensics.py"):
+        # ...and the ops-plane modules (round 9) + the perf-forensics
+        # modules (round 11): the forensics/critpath CLIs and the HTTP
+        # handler all emit text and must ride the logger too
+        for need in ("flight.py", "ops.py", "forensics.py",
+                     "critpath.py", "align.py", "sketch.py"):
             assert os.path.join("telemetry", need) in scanned, \
                 sorted(scanned)
         assert not offenders, (
