@@ -69,6 +69,33 @@ MV_DEFINE_string("dist_coordinator", "",
                  "coordinator address host:port (jax.distributed)")
 MV_DEFINE_int("dist_rank", -1, "this process index (jax.distributed)")
 MV_DEFINE_int("dist_size", -1, "total process count (jax.distributed)")
+# Round 12 — pluggable host wire (the reference's ZMQ-vs-MPI backend
+# split, PAPER.md L2: transports are deployment choices, not protocol
+# changes). "auto": same-host worlds ride the shared-memory wire
+# (parallel/shm_wire.py — gloo measured ~410 MB/s between two
+# processes of ONE machine; shm is a memcpy), cross-host worlds stay
+# on gloo. "gloo" forces the socket allgather; "shm" REQUIRES the
+# shared-memory wire and CHECK-fails when ranks span hosts.
+MV_DEFINE_string("mv_wire", "auto",
+                 "windowed-engine host wire: auto (shm when every rank "
+                 "shares a host, else gloo) / shm (require) / gloo")
+MV_DEFINE_int("mv_shm_ring_bytes", 4 << 20,
+              "shared-memory wire: per-(channel, rank) data area bytes "
+              "(frames larger than this chunk through it)")
+# Round 12 — elastic follow-on 4 (ROADMAP): the PJRT coordination
+# service declares a silent member dead after ~100s of missed
+# heartbeats (10s interval x 10 misses) and then tears the survivors
+# down — a long-lived SHRUNK world (elastic plane, the dead member
+# never returns) must outlive that corpse detection. MV_Init plumbs
+# this budget into jax.distributed.initialize's heartbeat knobs when
+# the installed jax exposes them (signature-checked; older/newer jax
+# without the kwargs logs and keeps runtime defaults). 0 = leave the
+# runtime defaults; -mv_elastic worlds default to 600s.
+MV_DEFINE_int("mv_pjrt_heartbeat_s", 0,
+              "PJRT coordination-service liveness budget in seconds "
+              "(missed-heartbeat window before a silent member is "
+              "declared dead); 0 = runtime default (~100s), or 600 "
+              "when -mv_elastic is on")
 
 _initialized = False
 _owns_runtime = False   # True only when WE called jax.distributed.initialize
@@ -189,6 +216,158 @@ class Group:
 
 
 _group: Optional[Group] = None
+
+# -- pluggable same-host wire (round 12, parallel/shm_wire.py) -----------
+#: the installed transport behind capped_exchange (None = gloo). Boot
+#: world only: elastic groups (installed above) take precedence, and a
+#: membership transition never routes through a wire the dead member
+#: still owns segments of.
+_wire = None
+
+
+def active_wire():
+    """The installed same-host wire (parallel/shm_wire.ShmWire), or
+    None when exchanges ride gloo."""
+    return _wire
+
+
+def wire_name() -> str:
+    """Label of the transport capped_exchange currently rides —
+    dashboards/healthz; 'relay' while an elastic group is installed."""
+    if _group is not None and _group.size > 1:
+        return "relay"
+    if _wire is not None:
+        return "shm"
+    return "gloo" if (_initialized and process_count() > 1) else "local"
+
+
+def wire_channels() -> int:
+    """Independent exchange channels the active transport offers. The
+    gloo allgather is ONE globally-ordered collective stream (channel
+    0 only); the shm wire offers one stream per channel — what lets
+    engine shards exchange concurrently in a multi-process world."""
+    return _wire.channels if _wire is not None else 1
+
+
+def maybe_install_wire(channels: int) -> str:
+    """Select + install the host wire for this world (Zoo.Start, after
+    jax.distributed is up, BEFORE the engine starts). One gloo
+    rendezvous exchanges (hostname, nonce) across the boot world; when
+    every rank shares a host and ``-mv_wire`` allows it, each rank
+    creates its shm segments, attaches its peers' after a barrier, and
+    a smoke exchange proves the wiring before anything trusts it. ANY
+    setup failure falls back to gloo loudly (CHECK-fails only under
+    ``-mv_wire=shm``, where the fallback was explicitly refused).
+    Returns the active transport name."""
+    global _wire
+    mode = str(GetFlag("mv_wire")).lower()
+    CHECK(mode in ("auto", "shm", "gloo"),
+          f"-mv_wire must be auto/shm/gloo, got {mode!r}")
+    if not _initialized or process_count() <= 1 or mode == "gloo":
+        return wire_name()
+    if _wire is not None:
+        return "shm"
+    import secrets
+    import socket
+    info = host_allgather_objects(
+        (socket.gethostname(), secrets.token_hex(4)))
+    hosts = [h for h, _ in info]
+    if any(h != hosts[0] for h in hosts):
+        CHECK(mode != "shm",
+              f"-mv_wire=shm but ranks span hosts: {hosts}")
+        Log.Debug("multihost: ranks span hosts (%s) — staying on gloo",
+                  hosts)
+        return "gloo"
+    token = info[0][1]          # rank 0's nonce names the session
+    from multiverso_tpu.parallel import shm_wire
+
+    # Every rank runs the IDENTICAL gloo collective sequence below —
+    # a local failure becomes an ok=False VOTE instead of a skipped
+    # round, because a rank that raises past a matched collective
+    # leaves its peers permanently off-by-one on the gloo stream (an
+    # asymmetric create failure must degrade the WHOLE world to gloo,
+    # not desync it). A failed vote at any step: everyone cleans up
+    # and returns gloo; the vote round itself realigned the world.
+    # payload_crc=False: every engine blob already carries the
+    # failsafe wire's CRC32 trailer (parallel/wire.py, verified before
+    # parsing) — a second full-blob CRC pass would halve the wire's
+    # bandwidth to guard what is already guarded. The frame headers
+    # stay CRC'd and truncation stays structurally detected
+    # (shm_wire.py docstring).
+    state = {"wire": None, "exc": None}
+    try:
+        state["wire"] = shm_wire.ShmWire(
+            token, process_index(), process_count(),
+            max(1, int(channels)), int(GetFlag("mv_shm_ring_bytes")),
+            payload_crc=False)
+    except Exception as e:
+        state["exc"] = e
+
+    def _vote(step: str) -> bool:
+        votes = host_allgather_objects(state["exc"] is None)
+        if all(votes):
+            return True
+        if state["wire"] is not None:
+            state["wire"].close()
+        CHECK(mode != "shm",
+              f"-mv_wire=shm but the wire failed to come up at "
+              f"{step}: {state['exc']!r} (votes {votes})")
+        Log.Error("multihost: shm wire setup failed at %s on rank(s) "
+                  "%s (%r here) — falling back to gloo", step,
+                  [i for i, v in enumerate(votes) if not v],
+                  state["exc"])
+        return False
+
+    if not _vote("segment create"):
+        return "gloo"
+    try:        # segments exist on every rank (the vote proved it)
+        state["wire"].attach_peers()
+    except Exception as e:
+        state["exc"] = e
+    if not _vote("peer attach"):
+        return "gloo"
+    try:
+        hello = b"mv-shm-hello-%d" % process_index()
+        got = state["wire"].exchange(hello, 0)
+        CHECK(got == [b"mv-shm-hello-%d" % r
+                      for r in range(process_count())],
+              f"shm wire smoke exchange returned {got!r}")
+    except Exception as e:
+        state["exc"] = e
+    if not _vote("smoke exchange"):
+        return "gloo"
+    _wire = state["wire"]
+    Log.Info("multihost: same-host shared-memory wire up — %d channels "
+             "x %d MiB (token %s)", _wire.channels, _wire.cap >> 20,
+             token)
+    return "shm"
+
+
+def close_wire() -> None:
+    """Tear the installed wire down (Zoo.Stop / net_reset). Idempotent;
+    own segments are unlinked."""
+    global _wire
+    w, _wire = _wire, None
+    if w is not None:
+        w.close()
+
+
+class wire_bypass:
+    """Bench/drill helper: run the body on the RAW gloo collective
+    path while a same-host wire is installed (the A/B the shm-vs-gloo
+    bench rows need). COLLECTIVE discipline applies: every rank must
+    enter and exit at the same stream position, or the two transports'
+    streams interleave divergently."""
+
+    def __enter__(self):
+        global _wire
+        self._saved = _wire
+        _wire = None
+        return self
+
+    def __exit__(self, *exc):
+        global _wire
+        _wire = self._saved
 
 #: collective isolation (elastic rebuild_world): the host-byte exchange
 #: layer answers as a single-member world while a transition fence
@@ -356,6 +535,7 @@ def net_reset() -> None:
     _net_rank = _net_endpoint = _net_world = None
     _group = None
     _OBJ_CAPS.clear()
+    close_wire()    # a new world re-selects (and re-tokens) its wire
 
 
 def net_finalize() -> None:
@@ -485,6 +665,75 @@ def _enable_cpu_collectives() -> None:
         Log.Debug("multihost: CPU gloo collectives unavailable (%r)", exc)
 
 
+def pjrt_heartbeat_kwargs() -> dict:
+    """The coordination-service heartbeat kwargs MV_Init plumbs into
+    ``jax.distributed.initialize`` (ROADMAP elastic follow-on 4): the
+    ``-mv_pjrt_heartbeat_s`` liveness budget split into an interval and
+    a missed-heartbeat count, for BOTH the service and client sides.
+    Empty when the budget is 0 (runtime defaults); an -mv_elastic world
+    with the flag unset defaults to 600s — a long-lived shrunk world
+    must outlive the runtime's ~100s corpse detection."""
+    try:
+        secs = int(GetFlag("mv_pjrt_heartbeat_s"))
+    except Exception:
+        secs = 0
+    if secs <= 0:
+        try:
+            if bool(GetFlag("mv_elastic")):
+                secs = 600
+        except Exception:
+            pass
+    if secs <= 0:
+        return {}
+    interval = max(10, secs // 10)
+    missing = max(2, -(-secs // interval))
+    return {"service_heartbeat_interval_seconds": interval,
+            "service_max_missing_heartbeats": missing,
+            "client_heartbeat_interval_seconds": interval,
+            "client_max_missing_heartbeats": missing}
+
+
+def _supported_heartbeat_kwargs(params) -> dict:
+    """The subset of :func:`pjrt_heartbeat_kwargs` this jax's
+    state-level initializer actually accepts (param-name filtered, so
+    a jax that renamed or dropped the knobs degrades to {})."""
+    return {k: v for k, v in pjrt_heartbeat_kwargs().items()
+            if k in params}
+
+
+def _dist_initialize(**kw) -> None:
+    """``jax.distributed.initialize`` with the heartbeat budget plumbed
+    through when this jax exposes the knobs (the public wrapper hides
+    them; the state-level initializer the wrapper delegates to takes
+    them). Any plumbing surprise falls back to the plain public call —
+    heartbeat tuning must never break bring-up."""
+    import jax
+    hb = pjrt_heartbeat_kwargs()
+    if hb:
+        try:
+            import inspect
+
+            from jax._src import distributed as _jdist
+            from jax._src import xla_bridge as _xb
+            supported = _supported_heartbeat_kwargs(
+                inspect.signature(_jdist.State.initialize).parameters)
+            if supported and not _xb.backends_are_initialized():
+                _jdist.global_state.initialize(**kw, **supported)
+                Log.Info("multihost: PJRT coordination-service "
+                         "heartbeats raised (%s)",
+                         ", ".join(f"{k}={v}"
+                                   for k, v in sorted(supported.items())))
+                return
+            if not supported:
+                Log.Info("multihost: this jax exposes no heartbeat "
+                         "knobs — -mv_pjrt_heartbeat_s ignored, "
+                         "runtime defaults kept")
+        except Exception as exc:
+            Log.Error("multihost: PJRT heartbeat plumbing failed (%r) "
+                      "— plain initialize", exc)
+    jax.distributed.initialize(**kw)
+
+
 def maybe_initialize() -> bool:
     """Initialize jax.distributed per flags/env. Returns True when a
     multi-process runtime is (already or newly) up. Idempotent.
@@ -528,10 +777,10 @@ def maybe_initialize() -> bool:
     try:
         _enable_cpu_collectives()
         if explicit:
-            jax.distributed.initialize(coordinator_address=coordinator,
-                                       num_processes=size, process_id=rank)
+            _dist_initialize(coordinator_address=coordinator,
+                             num_processes=size, process_id=rank)
         else:
-            jax.distributed.initialize()
+            _dist_initialize()
         _initialized = True
         _owns_runtime = True
         Log.Info("multihost: jax.distributed up — process %d of %d",
@@ -632,7 +881,7 @@ def host_allgather_bytes(data: bytes) -> list:
             for i in range(process_count())]
 
 
-def capped_exchange(blob: bytes, caps: dict, key) -> list:
+def capped_exchange(blob: bytes, caps: dict, key, channel: int = 0) -> list:
     """Every process's byte blob in ONE collective round (steady state).
 
     The 2-round shape of host_allgather_bytes (lengths first, then the
@@ -651,7 +900,13 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
     returns ``[blob]``. In an elastic epoch the exchange rides the
     group transport instead (the gloo boot-world allgather cannot
     subset the world); ``caps`` are not consulted there — the relay is
-    length-framed by construction."""
+    length-framed by construction.
+
+    ``channel`` (round 12) selects an INDEPENDENT exchange stream on a
+    transport that offers them (the shm wire: one per engine shard).
+    The gloo path is one globally-ordered collective stream — callers
+    must stay on channel 0 there (the engine clamps its shard count to
+    the transport's channel count for exactly this reason)."""
     if _isolated:
         return [blob]
     if _group is not None:
@@ -664,6 +919,20 @@ def capped_exchange(blob: bytes, caps: dict, key) -> list:
         return out
     if process_count() <= 1:
         return [blob]
+    if _wire is not None:
+        # same-host shared-memory wire: length-framed by construction
+        # (caps unused); the whole call is the collective for the
+        # phase split — local staging inside it is memcpy-bounded
+        note_collective()
+        _t0 = _time.perf_counter()
+        out = _wire.exchange(blob, channel)
+        _done_m, _done_w = _time.perf_counter(), _time.time()
+        _stamp_exchange(_t0, _done_m - _t0, _done_m, _done_w)
+        STATS["exchange_seconds"] += _done_m - _t0
+        return out
+    CHECK(channel == 0,
+          "gloo host wire has ONE collective stream — channel "
+          f"{channel} needs the shm wire (-mv_wire)")
     from jax.experimental import multihost_utils
 
     from multiverso_tpu.parallel.mesh import next_bucket

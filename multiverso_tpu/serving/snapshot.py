@@ -246,7 +246,9 @@ def _capture_all(engine, store) -> Snapshot:
             tables[tid] = ts
     snap = Snapshot(version=store.alloc_version(),
                     created_wall=time.time(),
-                    window_epoch=engine.window_epoch,
+                    # cross-stream position: total windows applied
+                    # over every engine shard stream (round 12)
+                    window_epoch=engine.cut_epoch(),
                     tables=tables)
     store.install(snap)
     tmetrics.gauge("serving.snapshot_bytes").set(snap.nbytes())

@@ -45,7 +45,8 @@ from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
                                             MV_DEFINE_int, MV_DEFINE_string,
-                                            cached_bool_flag)
+                                            cached_bool_flag,
+                                            cached_int_flag)
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.utils.mt_queue import MtQueue
@@ -94,6 +95,40 @@ MV_DEFINE_bool("mv_pipeline", True,
                "pipelined windowed engine: overlap window N's apply "
                "with window N+1's host exchange (false = serial engine)")
 _pipeline_flag = cached_bool_flag("mv_pipeline", True)
+# Round 12 — the three measured walls (PR 8 critpath: binding phase
+# `apply` 22/47 windows, every fence `depth`, host_scaling flat because
+# ONE actor serializes every table) attacked through one refactor:
+# engine SHARDS (per-table-group actors, each with its own window
+# stream / exchange stage / SEQ counter), a tunable pipeline DEPTH,
+# and a parallel APPLY pool for different tables of one window.
+MV_DEFINE_int("mv_engine_shards", 0,
+              "engine shards: per-table-group engine actors, each "
+              "owning its own window stream, exchange stage and SEQ "
+              "counter; tables route by table_id %% shards (rank-"
+              "agreed, no negotiation). 0 = auto: single-process "
+              "worlds use min(tables, cores/4) via lazy shard spawn, "
+              "multi-process worlds stay at 1 unless set explicitly "
+              "(>1 there needs the shm wire's per-shard channels — "
+              "-mv_wire — because gloo is one globally-ordered "
+              "collective stream). 1 = today's single engine byte-for-"
+              "byte. Clamped to 1 under -sync (the BSP vector clocks "
+              "count verbs across ALL tables) and -mv_elastic (the "
+              "epoch relay is single-channel).")
+MV_DEFINE_int("mv_pipeline_depth", 2,
+              "pipelined engine depth cap: max exchanged-but-unapplied "
+              "windows before the exchange stage fences (PR 6/8 "
+              "measured every burst fence as `depth` — a transiently "
+              "slow apply stops fencing the exchange at higher "
+              "depths, at the cost of pinning more decoded windows)")
+_pipeline_depth_flag = cached_int_flag("mv_pipeline_depth", 2)
+MV_DEFINE_int("mv_apply_workers", 4,
+              "apply-stage worker pool: apply DIFFERENT tables' "
+              "segments of one exchanged window concurrently (per-"
+              "table apply order stays serial, so determinism is "
+              "untouched; only host-local windows parallelize — a "
+              "collective apply keeps the strict position order). "
+              "<=1 = serial apply, today's engine")
+_apply_workers_flag = cached_int_flag("mv_apply_workers", 4)
 # Worker-side fast paths (tables/base.py reads these through listener
 # caches; they are DEFINED here so zoo's eager `import
 # multiverso_tpu.sync.server` registers them before MV_Init's
@@ -207,6 +242,42 @@ _INF = float("inf")
 FENCE_CAUSES = ("barrier", "nonlocal_table", "device_wire", "depth")
 
 
+class _ApplyPool:
+    """Daemon-thread worker pool for the parallel apply
+    (-mv_apply_workers). Deliberately NOT concurrent.futures: its
+    worker threads are non-daemon and joined at interpreter exit, so
+    one apply job wedged in a native call would turn a clean fatal
+    shutdown into a process that never exits. These workers are
+    daemons draining an MtQueue; jobs signal completion through a
+    per-job box + event, and shutdown just closes the queue."""
+
+    def __init__(self, workers: int, name: str):
+        self._q: MtQueue = MtQueue()
+        for i in range(max(1, workers)):
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"mv-apply-{name}-{i}").start()
+
+    def submit(self, fn) -> dict:
+        box = {"done": threading.Event()}
+        self._q.Push((fn, box))
+        return box
+
+    def _loop(self) -> None:
+        while True:
+            ok, item = self._q.Pop()
+            if not ok:
+                return
+            fn, box = item
+            try:
+                box["result"] = fn()
+            except BaseException as exc:    # re-raised by the waiter
+                box["error"] = exc
+            box["done"].set()
+
+    def shutdown(self) -> None:
+        self._q.Exit()
+
+
 class _StageKilled(Exception):
     """Internal: the apply stage killed the exchange stage after a
     fatal engine error — exit quietly, the actor already failed every
@@ -302,12 +373,15 @@ class _ExchangeStage:
     poisons itself, exactly the serial engine's fatal contract.
     """
 
-    #: max exchanged-but-not-yet-applied items: bounds how far the
-    #: exchange runs ahead (decoded windows pin their blobs in memory)
-    DEPTH = 2
-
     def __init__(self, srv: "Server"):
         self._srv = srv
+        #: max exchanged-but-not-yet-applied items (-mv_pipeline_depth,
+        #: default 2): bounds how far the exchange runs ahead (decoded
+        #: windows pin their blobs in memory). Read once per stage
+        #: life — a window stream never changes depth mid-flight, and
+        #: every rank's stage reads the same flag value at the same
+        #: stream position (creation).
+        self.depth_cap = max(1, _pipeline_depth_flag())
         self._in: MtQueue = MtQueue()
         self.out: MtQueue = MtQueue()
         self._pending: Deque[Message] = collections.deque()
@@ -398,7 +472,7 @@ class _ExchangeStage:
         the stall is classified (the explicit fence's recorded cause,
         or ``depth`` when only the DEPTH cap holds it) and its seconds
         observed — this is the dataset behind raising overlap_pct."""
-        depth_target = self._emitted - self.DEPTH + 1
+        depth_target = self._emitted - self.depth_cap + 1
         target = max(self._fence_at, depth_target)
         # advisory read (GIL-atomic int): only classifies; correctness
         # stays with the cv wait below
@@ -524,15 +598,28 @@ class _ExchangeStage:
             ph["seq"] = srv._mh_seq - 1
             ph["mepoch"] = multihost.membership_epoch()
         self.out.Push(("window", used[:prefix], windows, prefix, descs[0],
-                       t0, win_ctx, ph))
+                       t0, win_ctx, ph, fence_cause))
 
 
 class Server(Actor):
     """Async server engine (reference server.cpp:23-58)."""
 
-    def __init__(self):
-        super().__init__(actor_names.kServer)
+    def __init__(self, name: str = actor_names.kServer):
+        super().__init__(name)
         self.store_: List = []  # ServerTable list (reference server.h:24)
+        #: round 12 — sharded engine: which wire channel this engine's
+        #: window stream exchanges on, and the matching flight-event
+        #: stream id ((mepoch, stream, SEQ) keying). 0 for the
+        #: unsharded engine and shard 0; sub-shards override both.
+        self.mh_channel = 0
+        self.mh_stream = 0
+        #: lazy apply-stage worker pool (-mv_apply_workers)
+        self._apply_pool = None
+        #: True while this engine is the ONLY window stream issuing
+        #: collectives in a multi-process world (a ShardedServer with
+        #: live sub-shards sets False on every shard: collective
+        #: applies then CHECK-fail loudly — see _mh_fence_cause)
+        self.mh_single_collective_stream = True
         #: windows split by a non-Get/Add barrier message (observability +
         #: lets tests assert the barrier path actually engaged)
         self.window_barrier_splits = 0
@@ -686,7 +773,49 @@ class Server(Actor):
     def Stop(self) -> None:
         if self._ex_stage is not None:
             self._ex_stage.stop()
+        pool, self._apply_pool = self._apply_pool, None
+        if pool is not None:
+            # no join: the actor drain above already applied every
+            # window, and the workers are daemons — a wedged job can
+            # never hold the interpreter's exit hostage
+            pool.shutdown()
         super().Stop()
+
+    # -- round 12: sharded-engine facade points (the unsharded engine
+    # IS shard 0 of a 1-shard world; ShardedServer overrides these) ----------
+
+    def epoch_for_table(self, table_id: int) -> int:
+        """Window epoch of the stream applying ``table_id``'s verbs —
+        the worker-side Get cache's staleness clock (tables/base.py).
+        Per-shard in a sharded engine: a busy NEIGHBOR shard must not
+        age another table's cache entries."""
+        return self.window_epoch
+
+    def cut_epoch(self) -> int:
+        """Total windows applied across every stream — the stream
+        position a cross-stream cut (snapshot/checkpoint) is taken at
+        (serving/snapshot.py stamps it into the published version)."""
+        return self.window_epoch
+
+    def shard_states(self) -> List[dict]:
+        """Per-shard live state for /healthz and the dashboard
+        [Engine] line (LOCAL, never collective)."""
+        st = self._ex_stage
+        return [{
+            "shard": self.mh_stream,
+            "actor": self.name,
+            "poisoned": repr(self._poison) if self._poison is not None
+            else None,
+            "mailbox_depth": self.mailbox.Size(),
+            "window_epoch": self.window_epoch,
+            "window_exchanges": self.mh_window_exchanges,
+            "stage": None if st is None else {
+                "depth": st.depth(),
+                "pending_verbs": st.pending_verbs(),
+                "mid_exchange": bool(st.busy_since),
+                "dead": repr(st.dead) if st.dead is not None else None,
+            },
+        }]
 
     def _flight_exchanged(self, descs, my_rank: int) -> None:
         """Flight event for one completed exchange: THIS rank's verbs
@@ -701,6 +830,7 @@ class Server(Actor):
             tflight.record("window.exchanged", seq=self._mh_seq - 1,
                            epoch=self.window_epoch,
                            mepoch=multihost.membership_epoch(),
+                           stream=self.mh_stream,
                            detail=",".join(f"{k}{t}"
                                            for k, t in descs[my_rank]))
 
@@ -713,7 +843,8 @@ class Server(Actor):
         self.last_fence_cause = cause
         tflight.record("fence", seq=self._mh_seq,
                        epoch=self.window_epoch,
-                       mepoch=multihost.membership_epoch(), detail=cause)
+                       mepoch=multihost.membership_epoch(),
+                       stream=self.mh_stream, detail=cause)
 
     def _note_overlap(self, s: float) -> None:
         """Record ``s`` seconds of exchange/apply concurrency (called by
@@ -768,6 +899,7 @@ class Server(Actor):
             tflight.record("window.phases", seq=ph.get("seq", -1),
                            epoch=self.window_epoch,
                            mepoch=ph.get("mepoch", 0),
+                           stream=self.mh_stream,
                            detail=f"v={nverbs};a={int(apply_s * 1e6)}")
             return
         durs = {"form": ph.get("form", 0.0), "pack": ph.get("pack", 0.0),
@@ -805,6 +937,7 @@ class Server(Actor):
         tflight.record("window.phases", seq=ph.get("seq", -1),
                        epoch=self.window_epoch,
                        mepoch=ph.get("mepoch", 0),
+                       stream=self.mh_stream,
                        detail=";".join(parts))
 
     def _ph_tables(self, tbl: dict, seq: int, mepoch: int) -> None:
@@ -832,6 +965,7 @@ class Server(Actor):
         if parts:
             tflight.record("window.tables", seq=seq,
                            epoch=self.window_epoch, mepoch=mepoch,
+                           stream=self.mh_stream,
                            detail=";".join(parts))
 
     # -- elastic plane hooks (round 10, elastic/) ---------------------------
@@ -871,6 +1005,7 @@ class Server(Actor):
             self.window_epoch += 1
             tflight.record("window.applied", epoch=self.window_epoch,
                            mepoch=multihost.membership_epoch(),
+                           stream=self.mh_stream,
                            detail=f"{len(batch)}v")
         return True
 
@@ -1054,6 +1189,7 @@ class Server(Actor):
             self._local_window(batch)
         self.window_epoch += 1     # worker get-cache staleness clock
         tflight.record("window.applied", epoch=self.window_epoch,
+                       stream=self.mh_stream,
                        detail=f"{len(batch)}v")
         _win_s = _time.perf_counter() - _t0
         self._t_window_s.observe(_win_s)
@@ -1101,6 +1237,7 @@ class Server(Actor):
                 self.window_barrier_splits += 1
                 self._t_splits.inc()
                 tflight.record("barrier", epoch=self.window_epoch,
+                               stream=self.mh_stream,
                                detail=MsgType(seg.msg_type).name)
                 self._dispatch(seg)
                 seen.clear()
@@ -1294,6 +1431,7 @@ class Server(Actor):
             tflight.record("engine.fatal", seq=self._mh_seq,
                            epoch=self.window_epoch,
                            mepoch=multihost.membership_epoch(),
+                           stream=self.mh_stream,
                            detail=f"{type(exc).__name__}: "
                                   f"{exc}"[:200])
             tflight.dump_failure(
@@ -1362,9 +1500,14 @@ class Server(Actor):
                     self._dispatch(head)
                 else:
                     (_, mine, windows, prefix, descs0, t0, win_ctx,
-                     ph) = item
+                     ph, fcause) = item
+                    # a fence-free window is host-local on EVERY rank
+                    # (the same rank-agreed decision that allowed the
+                    # overlap) — exactly the windows whose tables may
+                    # apply concurrently without reordering collectives
                     self._pl_apply(mine, windows, prefix, descs0,
-                                   win_ctx, ph)
+                                   win_ctx, ph,
+                                   parallel_ok=fcause is None)
                     for m in mine:
                         CHECK(fed.popleft() is m,
                               "pipeline completion order desync "
@@ -1396,7 +1539,7 @@ class Server(Actor):
             stage.feed_barrier(m)
 
     def _pl_apply(self, verbs, windows, prefix, descs0, win_ctx,
-                  ph=None) -> None:
+                  ph=None, parallel_ok: bool = False) -> None:
         """Apply one exchanged window on the actor thread, recording
         the apply interval for the overlap telemetry (and closing the
         window's phase record — ``ph`` rode the stage's out queue from
@@ -1409,7 +1552,8 @@ class Server(Actor):
             with ttrace.span("server.window.apply", cat="server",
                              parent=win_ctx, args={"verbs": prefix}):
                 self._mh_apply_window(verbs, windows, prefix, descs0,
-                                      seq=(ph or {}).get("seq", -1))
+                                      seq=(ph or {}).get("seq", -1),
+                                      parallel_ok=parallel_ok)
         finally:
             now = _time.perf_counter()
             self._apply_since = 0.0
@@ -1427,6 +1571,7 @@ class Server(Actor):
             tflight.record("window.applied", seq=self._mh_seq,
                            epoch=self.window_epoch,
                            mepoch=multihost.membership_epoch(),
+                           stream=self.mh_stream,
                            detail=f"{prefix}v")
 
     def _mh_windows_inner(self, pending: "Deque[Message]") -> None:
@@ -1483,7 +1628,8 @@ class Server(Actor):
         marker = wire.encode_head_barrier(int(head.msg_type))
         blobs = self._bounded_collective(
             lambda: multihost.capped_exchange(marker, self._mh_caps,
-                                              "HEAD_B"),
+                                              "HEAD_B",
+                                              channel=self.mh_channel),
             "window head-marker exchange")
         # seq of the NEXT exchange: barriers do not advance the SEQ
         # counter, so forensics aligns a barrier against the verbs a
@@ -1491,6 +1637,7 @@ class Server(Actor):
         tflight.record("barrier", seq=self._mh_seq,
                        epoch=self.window_epoch,
                        mepoch=multihost.membership_epoch(),
+                       stream=self.mh_stream,
                        detail=MsgType(head.msg_type).name)
         kinds = [wire.decode_head_kind(b) for b in blobs]
         CHECK(all(k == kinds[0] for k in kinds),
@@ -1606,7 +1753,8 @@ class Server(Actor):
                              args={"bytes": len(blob)}):
                 blobs = self._bounded_collective(
                     lambda: multihost.capped_exchange(
-                        blob, self._mh_caps, (local[0][0], local[0][1])),
+                        blob, self._mh_caps, (local[0][0], local[0][1]),
+                        channel=self.mh_channel),
                     "window exchange")
             if ph is not None:
                 ph["x"] = ph.get("x", 0.0) + _time.perf_counter() - _tx
@@ -1645,6 +1793,7 @@ class Server(Actor):
                 tflight.record("wire.crc_retry", seq=self._mh_seq,
                                epoch=self.window_epoch,
                                mepoch=multihost.membership_epoch(),
+                               stream=self.mh_stream,
                                detail=f"attempt{attempt + 1}")
                 Log.Error("window exchange frame corrupt (attempt "
                           "%d/%d): %r — re-exchanging", attempt + 1,
@@ -1699,6 +1848,7 @@ class Server(Actor):
         tflight.record("window.admitted", seq=self._mh_seq,
                        epoch=self.window_epoch,
                        mepoch=multihost.membership_epoch(),
+                       stream=self.mh_stream,
                        detail=f"{len(used)}v/{packed}B")
         return local, used
 
@@ -1713,6 +1863,7 @@ class Server(Actor):
         collective on one rank with an exchange-thread allgather on
         another."""
         tables_ok: Dict[int, bool] = {}
+        cause = None
         for kind, tid in descs0:
             ok = tables_ok.get(tid)
             if ok is None:
@@ -1722,12 +1873,34 @@ class Server(Actor):
                     ok = False   # bad table id: per-position error path
                 tables_ok[tid] = ok
             if not ok:
-                return "nonlocal_table"
-        for w in windows:
-            for _, _, payload in w[:prefix]:
-                if wire.payload_has_deferred(payload):
-                    return "device_wire"   # device values: collective
-        return None
+                cause = "nonlocal_table"
+                break
+        if cause is None:
+            for w in windows:
+                for _, _, payload in w[:prefix]:
+                    if wire.payload_has_deferred(payload):
+                        cause = "device_wire"  # device values: collective
+                        break
+                if cause is not None:
+                    break
+        # round 12 — sharded multi-process worlds: a COLLECTIVE apply
+        # (device program / gloo round inside the apply) is only sound
+        # when ONE stream exists to order it. With N shard streams
+        # live, shard A's collective apply could interleave with shard
+        # B's in a different order on different ranks — loud CHECK
+        # (with advice) instead of a silent rank-divergent deadlock.
+        # (Cross-stream CUT payloads are exempt by construction: every
+        # stream is fenced while they run.)
+        if cause is not None:
+            CHECK(self.mh_single_collective_stream,
+                  f"window requires a collective apply ({cause}) but "
+                  f"the engine runs {getattr(self, '_shard_cap', '>1')}"
+                  f" shard streams in a multi-process world — "
+                  f"collective applies need ONE ordered stream: run "
+                  f"-mv_engine_shards=1, or keep every table's apply "
+                  f"host-local (-window_transport=host + host-backed "
+                  f"tables)")
+        return cause
 
     def _mh_collective_window_inner(self, verbs) -> int:
         my_rank = multihost.world_rank()
@@ -1758,18 +1931,29 @@ class Server(Actor):
         tflight.record("window.applied", seq=self._mh_seq,
                        epoch=self.window_epoch,
                        mepoch=multihost.membership_epoch(),
+                       stream=self.mh_stream,
                        detail=f"{prefix}v")
         return prefix
 
     def _mh_apply_window(self, verbs, windows, prefix, descs0,
-                         seq: int = -1) -> None:
+                         seq: int = -1,
+                         parallel_ok: bool = False) -> None:
         """Apply one exchanged window's agreed prefix: cross-rank
         coalesced add runs + deduped get groups, replies to this rank's
         own messages. Shared by the serial engine and the pipelined
         apply stage — the semantics (ordering, grouping, error routing)
         are identical in both. ``seq`` is this window's exchange SEQ
         (perf forensics: keys the per-table apply attribution; -1 when
-        phases are off)."""
+        phases are off).
+
+        ``parallel_ok`` (round 12): DIFFERENT tables' segments of this
+        window apply concurrently on the -mv_apply_workers pool. Only
+        set for windows whose apply is host-local on every rank (the
+        pipelined overlap gate's rank-agreed decision): per-table op
+        order stays serial — determinism untouched — while a window
+        that fenced (collective applies) keeps the strict interleaved
+        position order below, because collective device/host programs
+        must issue in one agreed order."""
         my_rank = multihost.world_rank()
         self.mh_window_verbs += prefix
         self._t_verbs.inc(prefix)
@@ -1795,6 +1979,30 @@ class Server(Actor):
                 seg = 0 if (tid not in add_pos or i < add_pos[tid][0]) else 1
                 get_groups.setdefault((tid, seg), []).append(i)
         parts_at = [[w[i][2] for w in windows] for i in range(prefix)]
+        # ONE ordered op list (first-position order, per-table dedup +
+        # before/after-add get segmentation) feeds BOTH branches, so
+        # the serial and parallel engines cannot drift on the window
+        # grammar. The serial branch executes it in strict position
+        # order — collective applies (fenced windows) must issue in
+        # one agreed order; the parallel branch regroups per table.
+        ops = self._mh_window_ops(descs0, add_pos, get_groups)
+        n_tables = len({tid for _, tid, _ in ops})
+        if (parallel_ok and n_tables > 1 and _apply_workers_flag() > 1):
+            self._mh_apply_parallel(ops, parts_at, verbs, my_rank, tbl)
+        else:
+            self._mh_run_ops(ops, parts_at, verbs, my_rank, tbl)
+        if tbl:
+            self._ph_tables(tbl, seq, multihost.membership_epoch())
+
+    @staticmethod
+    def _mh_window_ops(descs0, add_pos, get_groups) -> list:
+        """The window's op list in first-position order:
+        ``("A", tid, positions)`` once per table's merged add run,
+        ``("G", tid, positions)`` once per (table, before/after-add
+        segment) get group. Within a table the order is its serial
+        apply order (seg-0 gets precede the add run precede seg-1
+        gets, because their first positions do)."""
+        ops = []
         applied: set = set()
         served: set = set()
         for i, (kind, tid) in enumerate(descs0):
@@ -1802,30 +2010,83 @@ class Server(Actor):
                 if tid in applied:
                     continue
                 applied.add(tid)
-                _tt = _time.perf_counter() if tbl is not None else 0.0
-                with ttrace.span("server.window.add_run", cat="server",
-                                 args={"table_id": tid,
-                                       "positions": len(add_pos[tid])}):
-                    self._mh_add_run(tid, add_pos[tid], parts_at, verbs,
-                                     my_rank)
-                if tbl is not None:
-                    tbl[(tid, "A")] = (tbl.get((tid, "A"), 0.0)
-                                       + _time.perf_counter() - _tt)
+                ops.append(("A", tid, add_pos[tid]))
             else:
-                seg = 0 if (tid not in add_pos or i < add_pos[tid][0]) else 1
+                seg = (0 if (tid not in add_pos
+                             or i < add_pos[tid][0]) else 1)
                 if (tid, seg) in served:
                     continue
                 served.add((tid, seg))
-                _tt = _time.perf_counter() if tbl is not None else 0.0
-                with ttrace.span("server.window.get_group", cat="server",
+                ops.append(("G", tid, get_groups[(tid, seg)]))
+        return ops
+
+    def _mh_run_ops(self, ops, parts_at, verbs, my_rank: int,
+                    tbl) -> dict:
+        """Execute window ops in the given order (the shared worker
+        body of the serial branch and each parallel job); accumulates
+        per-(table, verb) apply seconds into ``tbl`` when given and
+        also returns them (parallel jobs pass a private dict)."""
+        for kind, tid, positions in ops:
+            _tt = _time.perf_counter() if tbl is not None else 0.0
+            if kind == "A":
+                with ttrace.span("server.window.add_run", cat="server",
+                                 args={"table_id": tid,
+                                       "positions": len(positions)}):
+                    self._mh_add_run(tid, positions, parts_at, verbs,
+                                     my_rank)
+            else:
+                with ttrace.span("server.window.get_group",
+                                 cat="server",
                                  args={"table_id": tid}):
-                    self._mh_get_group(tid, get_groups[(tid, seg)],
-                                       parts_at, verbs, my_rank)
-                if tbl is not None:
-                    tbl[(tid, "G")] = (tbl.get((tid, "G"), 0.0)
-                                       + _time.perf_counter() - _tt)
-        if tbl:
-            self._ph_tables(tbl, seq, multihost.membership_epoch())
+                    self._mh_get_group(tid, positions, parts_at,
+                                       verbs, my_rank)
+            if tbl is not None:
+                k = (tid, kind)
+                tbl[k] = tbl.get(k, 0.0) + _time.perf_counter() - _tt
+        return tbl
+
+    def _mh_apply_parallel(self, ops, parts_at, verbs, my_rank: int,
+                           tbl) -> None:
+        """Round 12 — the parallel apply: the shared op list regrouped
+        into per-table ordered jobs (a table's serial order is kept)
+        run concurrently across tables on the worker pool. Only
+        reached for host-local windows (see _mh_apply_window), where
+        different tables share no state and issue no collectives, so
+        the cross-table interleaving the serial branch produces was
+        never observable."""
+        jobs: Dict[int, list] = {}
+        for op in ops:
+            jobs.setdefault(op[1], []).append(op)
+        pool = self._apply_pool
+        if pool is None:
+            pool = self._apply_pool = _ApplyPool(
+                max(2, min(_apply_workers_flag(), 16)), self.name)
+        job_lists = list(jobs.values())
+        # the LAST job runs inline on the actor thread: one fewer
+        # handoff, and the pool only ever carries n_tables - 1 jobs
+        boxes = [pool.submit(lambda j=j: self._mh_run_ops(
+            j, parts_at, verbs, my_rank,
+            {} if tbl is not None else None))
+            for j in job_lists[:-1]]
+        results = [self._mh_run_ops(job_lists[-1], parts_at, verbs,
+                                    my_rank,
+                                    {} if tbl is not None else None)]
+        deadline = fdeadline.timeout_or_none()
+        t0 = _time.perf_counter()
+        for box in boxes:
+            left = (None if deadline is None
+                    else max(0.0, deadline - (_time.perf_counter() - t0)))
+            if not box["done"].wait(left):
+                fdeadline.raise_deadline(
+                    "parallel window apply (a table's apply job never "
+                    "finished)", fatal=True)
+            if "error" in box:
+                raise box["error"]
+            results.append(box.get("result"))
+        if tbl is not None:
+            for local in results:
+                for k, v in (local or {}).items():
+                    tbl[k] = tbl.get(k, 0.0) + v
 
     def _mh_add_run(self, tid: int, positions, parts_at, verbs,
                     my_rank: int) -> None:
@@ -2036,12 +2297,346 @@ class Server(Actor):
 
     @staticmethod
     def GetServer(num_workers: int) -> "Server":
-        """Factory mirroring reference server.cpp:224-232."""
-        if not GetFlag("sync"):
-            Log.Debug("Create an async server")
-            return Server()
-        Log.Debug("Create a sync server")
-        return SyncServer(num_workers)
+        """Factory mirroring reference server.cpp:224-232 — extended
+        (round 12) with the sharded engine: ``-mv_engine_shards``
+        resolves through :func:`engine_shard_cap`, and a cap > 1
+        builds the router-fronted ShardedServer (1 = today's single
+        engine byte-for-byte)."""
+        if GetFlag("sync"):
+            Log.Debug("Create a sync server")
+            return SyncServer(num_workers)
+        cap = engine_shard_cap()
+        if cap > 1:
+            Log.Debug("Create a sharded async server (%d shard slots)",
+                      cap)
+            return ShardedServer(cap)
+        Log.Debug("Create an async server")
+        return Server()
+
+
+def requested_engine_channels() -> int:
+    """How many independent wire channels the engine WANTS for this
+    world — consulted by Zoo.Start BEFORE transport selection (the shm
+    wire pre-creates its channel segments). The explicit
+    ``-mv_engine_shards`` value; clamping modes (sync/elastic) and the
+    multi-process auto default want one."""
+    try:
+        flag = int(GetFlag("mv_engine_shards"))
+    except Exception:
+        flag = 0
+    if flag <= 1 or bool(GetFlag("sync")):
+        return 1
+    try:
+        if bool(GetFlag("mv_elastic")):
+            return 1
+    except Exception:
+        pass
+    return flag
+
+
+def engine_shard_cap() -> int:
+    """Resolved engine shard-slot count for a NEW engine (see the
+    ``-mv_engine_shards`` help text). The reference's actor runtime
+    gives EVERY actor its own thread + mailbox (PAPER.md L1 — nothing
+    forces one server actor); the clamps below are where this build's
+    collective protocols genuinely do:
+
+    * BSP (-sync): the vector clocks count verbs across all tables;
+    * elastic epochs: the coordinator relay is one ordered channel;
+    * multi-process on gloo: ONE globally-ordered collective stream —
+      per-shard streams need the shm wire's channels (-mv_wire)."""
+    try:
+        flag = int(GetFlag("mv_engine_shards"))
+    except Exception:
+        flag = 0
+    if bool(GetFlag("sync")):
+        return 1
+    try:
+        if bool(GetFlag("mv_elastic")):
+            if flag > 1:
+                Log.Info("engine: -mv_engine_shards=%d clamped to 1 "
+                         "under -mv_elastic (the epoch relay is a "
+                         "single ordered channel)", flag)
+            return 1
+    except Exception:
+        pass
+    if multihost.world_size() > 1:
+        if flag <= 1:
+            return 1        # auto: multi-process worlds opt in explicitly
+        channels = multihost.wire_channels()
+        if channels < flag:
+            Log.Error("engine: -mv_engine_shards=%d needs %d "
+                      "independent exchange channels but the active "
+                      "wire offers %d (gloo is one ordered collective "
+                      "stream — run same-host worlds with -mv_wire="
+                      "auto/shm) — clamped to 1", flag, flag, channels)
+            return 1
+        return flag
+    if flag >= 1:
+        return flag
+    # auto, single-process: min(tables, cores/4) — the table bound
+    # falls out of LAZY shard spawn (ShardedServer.RegisterTable)
+    import os
+    return max(1, min(8, (os.cpu_count() or 4) // 4))
+
+
+#: non-verb message types the sharded router turns into CROSS-STREAM
+#: CUTS (every shard fences at one agreed stream position, the payload
+#: runs once, every shard releases): checkpoint/StoreLoad, serving
+#: publish, the barrier drain ping, and FinishTrain. Any OTHER
+#: non-verb type dispatches on shard 0 only (unknown types have no
+#: cross-shard ordering to preserve).
+_CUT_TYPES = (MsgType.Request_StoreLoad, MsgType.Request_Publish,
+              MsgType.Request_Barrier, MsgType.Server_Finish_Train)
+
+
+class _CutFence:
+    """One cross-stream cut rendezvous (round 12).
+
+    Every sub-shard's stream carries a fence message at the cut's
+    position; its dispatch parks the shard here (``hold``). The head
+    shard (the router, = shard 0) waits for every sub to arrive
+    (``arrive_head``), runs the cut payload with ALL streams fenced —
+    every verb admitted before the cut applied, none after, on every
+    shard — then ``release``s the subs. All waits are poll-sliced and
+    honour ``-mv_deadline_s``; a poisoned shard converts the wait into
+    the typed ActorDied instead of a hang."""
+
+    _POLL_S = 0.05
+
+    def __init__(self, head: "Server", n_subs: int):
+        self._head = head
+        self._need = n_subs
+        self._cv = threading.Condition()
+        self._arrived = 0
+        self._released = False
+        self._abort: Optional[BaseException] = None
+
+    def hold(self) -> None:
+        """Sub-shard side: arrive, then block until the head releases
+        the cut (or aborts / dies / the deadline expires)."""
+        deadline = fdeadline.timeout_or_none()
+        t0 = _time.perf_counter()
+        with self._cv:
+            self._arrived += 1
+            self._cv.notify_all()
+            while not self._released and self._abort is None:
+                head_poison = getattr(self._head, "_poison", None)
+                if head_poison is not None:
+                    from multiverso_tpu.failsafe.errors import ActorDied
+                    raise ActorDied(self._head.name, head_poison)
+                self._cv.wait(self._POLL_S)
+                if (deadline is not None
+                        and _time.perf_counter() - t0 > deadline):
+                    fdeadline.raise_deadline(
+                        "cross-stream cut (the head shard never ran "
+                        "the cut payload)", fatal=True)
+            if self._abort is not None:
+                raise self._abort
+
+    def arrive_head(self, subs) -> None:
+        """Head side: block until every sub-shard fenced. A dead sub
+        (or an expired deadline) aborts the cut on every waiter."""
+        deadline = fdeadline.timeout_or_none()
+        t0 = _time.perf_counter()
+        with self._cv:
+            while self._arrived < self._need:
+                for sub in subs:
+                    if sub._poison is not None:
+                        from multiverso_tpu.failsafe.errors import \
+                            ActorDied
+                        exc = ActorDied(sub.name, sub._poison)
+                        self._abort = exc
+                        self._cv.notify_all()
+                        raise exc
+                self._cv.wait(self._POLL_S)
+                if (deadline is not None
+                        and _time.perf_counter() - t0 > deadline):
+                    try:
+                        fdeadline.raise_deadline(
+                            "cross-stream cut (a shard never fenced)",
+                            fatal=True)
+                    except BaseException as exc:
+                        self._abort = exc
+                        self._cv.notify_all()
+                        raise
+
+    def release(self) -> None:
+        with self._cv:
+            self._released = True
+            self._cv.notify_all()
+
+
+class _EngineShard(Server):
+    """Sub-shard k of a :class:`ShardedServer`: a full engine actor —
+    own thread, mailbox, window stream, exchange stage, SEQ counter,
+    dedup window — whose ``store_`` is the SHARED table list and whose
+    exchanges ride wire channel k (flight events stamped stream k).
+    Non-verb messages only ever reach it as cut fences from the
+    router."""
+
+    def __init__(self, parent: "ShardedServer", slot: int):
+        super().__init__(name=f"{actor_names.kServer}_shard{slot}")
+        self.store_ = parent.store_     # ONE table list, router-owned
+        self.mh_channel = slot
+        self.mh_stream = slot
+        for mt in _CUT_TYPES:
+            self.RegisterHandler(mt, self._fence_entry)
+
+    def _fence_entry(self, msg: Message) -> None:
+        """Cut-fence dispatch: park this shard's stream until the head
+        releases the cut. Failures reply typed (never hang the cut
+        caller); a fatal abort (head death / deadline) re-raises so
+        this shard poisons like any other desynced stream."""
+        fence = (msg.payload or {}).get("_mv_fence")
+        if fence is None:       # defensive: not a router fence
+            msg.reply(None)
+            return
+        try:
+            fence.hold()
+        except Exception as exc:
+            msg.reply(exc)
+            if getattr(exc, "mv_fatal", False):
+                raise
+            return
+        except BaseException as exc:
+            # SystemExit & friends keep base-actor semantics: reply,
+            # then let the escape kill + poison this shard's loop
+            msg.reply(exc)
+            raise
+        msg.reply(None)
+
+
+class ShardedServer(Server):
+    """Round 12 — the sharded engine: this actor IS shard 0 and the
+    router. Verbs route to a shard by ``table_id % shard_slots`` (rank-
+    agreed arithmetic, so SPMD ranks agree on routing without
+    negotiation); each shard owns an independent window stream with
+    its own exchange stage, SEQ counter and wire channel, so different
+    tables' windows form, exchange and apply CONCURRENTLY — the fix
+    for the flat ``host_scaling_Melem_s`` wall (ONE actor serialized
+    every table). Sub-shards spawn LAZILY at table registration, so
+    the effective shard count is min(tables, slots).
+
+    Non-verb messages (checkpoint StoreLoad, serving Publish, barrier
+    pings, FinishTrain) become CROSS-STREAM CUTS: every shard fences
+    at the cut's position in ITS stream (in a multi-process world each
+    fence is a barrier head-marker exchange on the shard's own
+    channel, lockstep per shard by the SPMD contract), the payload
+    runs ONCE with all streams fenced, then every shard releases. Every
+    verb admitted before the cut is applied before the payload runs
+    and none after — on every shard — which is exactly the PR 5
+    publish-barrier soundness argument lifted to N streams (DESIGN.md
+    §14)."""
+
+    def __init__(self, shard_cap: int):
+        super().__init__()
+        CHECK(shard_cap >= 2,
+              f"ShardedServer needs >= 2 shard slots, got {shard_cap}")
+        self._shard_cap = shard_cap
+        self._subs: Dict[int, _EngineShard] = {}
+        #: cross-stream cuts processed (the sharded sibling of
+        #: window_barrier_splits, which counts shard 0's stream only)
+        self.cut_count = 0
+        for mt in _CUT_TYPES:
+            self.RegisterHandler(mt, self._wrap_cut(self._handlers[mt]))
+
+    def _wrap_cut(self, base):
+        def entry(msg: Message) -> None:
+            fence = getattr(msg, "_mv_cut", None)
+            if fence is None:       # no subs were live at routing time
+                return base(msg)
+            fence.arrive_head(list(self._subs.values()))
+            try:
+                base(msg)
+            finally:
+                fence.release()
+        return entry
+
+    def RegisterTable(self, server_table) -> int:
+        table_id = super().RegisterTable(server_table)
+        if multihost.world_size() > 1:
+            # pre-warm the table's host mirror at THIS lockstep
+            # position: a multi-stream engine cannot order collective
+            # applies, so the mirror bootstrap the single engine did
+            # in the first fenced window must happen here instead
+            # (tables/base.py mh_prepare_local_apply contract)
+            try:
+                server_table.mh_prepare_local_apply()
+            except Exception as exc:
+                Log.Error("engine: table %d local-apply pre-warm "
+                          "failed (%r) — its first window will need a "
+                          "collective apply", table_id, exc)
+        slot = table_id % self._shard_cap
+        if slot and slot not in self._subs:
+            sub = _EngineShard(self, slot)
+            self._subs[slot] = sub
+            if multihost.world_size() > 1:
+                # N live streams in a multi-process world: no shard may
+                # issue collective APPLIES any more (loud CHECK in
+                # _mh_fence_cause; cut payloads stay exempt — every
+                # stream is fenced while they run)
+                self.mh_single_collective_stream = False
+                sub.mh_single_collective_stream = False
+                for other in self._subs.values():
+                    other.mh_single_collective_stream = False
+            sub.Start()
+            Log.Debug("engine: shard %d spawned (table %d; %d/%d "
+                      "slots live)", slot, table_id,
+                      1 + len(self._subs), self._shard_cap)
+        return table_id
+
+    def Receive(self, msg: Message) -> None:
+        if msg.msg_type in (MsgType.Request_Get, MsgType.Request_Add):
+            slot = (msg.table_id % self._shard_cap
+                    if msg.table_id >= 0 else 0)
+            sub = self._subs.get(slot)
+            if sub is not None:
+                sub.Receive(msg)    # chaos/poison apply there
+            else:
+                super().Receive(msg)
+            return
+        subs = list(self._subs.values())
+        if not subs or msg.msg_type not in _CUT_TYPES:
+            super().Receive(msg)
+            return
+        # CROSS-STREAM CUT: fence every sub-shard's stream, then send
+        # the head message to shard 0. Per-shard mailbox order is the
+        # caller's program order restricted to that shard, so SPMD
+        # ranks place every fence at the same per-shard stream
+        # position — the cut is one agreed multi-stream position.
+        self.cut_count += 1
+        fence = _CutFence(self, len(subs))
+        for sub in subs:
+            sub.Receive(Message(msg_type=msg.msg_type,
+                                payload={"_mv_fence": fence}))
+        msg._mv_cut = fence
+        super().Receive(msg)
+
+    # -- facade points -------------------------------------------------------
+
+    def epoch_for_table(self, table_id: int) -> int:
+        slot = table_id % self._shard_cap if table_id >= 0 else 0
+        sub = self._subs.get(slot)
+        return (sub or self).window_epoch
+
+    def cut_epoch(self) -> int:
+        return self.window_epoch + sum(s.window_epoch
+                                       for s in self._subs.values())
+
+    def shard_states(self) -> List[dict]:
+        out = super().shard_states()
+        for slot in sorted(self._subs):
+            out.extend(self._subs[slot].shard_states())
+        return out
+
+    def Stop(self) -> None:
+        # shard 0 (the router) first: its drain may still dispatch a
+        # queued cut, which needs the subs alive to fence; the subs'
+        # own drains then flush any released fences
+        super().Stop()
+        for sub in self._subs.values():
+            sub.Stop()
 
 
 class SyncServer(Server):

@@ -173,6 +173,22 @@ class ServerTable:
         ProcessGetParts then runs)."""
         return None
 
+    def mh_prepare_local_apply(self) -> None:
+        """Round 12 — called at table REGISTRATION in sharded
+        multi-process worlds (sync/server.py ShardedServer), a
+        lockstep program position BEFORE any verb reaches the table's
+        shard stream: eagerly create whatever host mirror makes
+        :meth:`mh_apply_is_local` true, so the table's very first
+        window is already host-local. A multi-stream engine cannot
+        order collective applies across its live streams, so a
+        nonlocal window there CHECK-fails loudly (_mh_fence_cause) —
+        without this hook the mirror-bootstrap window itself (the
+        single-engine design lets the FIRST fenced window create the
+        mirror) would be that nonlocal window. Collective reads are
+        safe here: every rank registers the table at the same program
+        position. Default no-op: the table then stays nonlocal and
+        the CHECK's advice applies."""
+
     def mh_apply_is_local(self) -> bool:
         """True when EVERY windowed-engine apply/serve path of this
         table for already-exchanged parts runs entirely on the host —
@@ -436,7 +452,7 @@ class WorkerTable:
         if isinstance(result, Exception):
             raise result
         if fill is not None:
-            self._gc_store(fill[0], result, fill[1])
+            self._gc_store(fill[0], result, fill[1], fill[2])
         return result
 
     # -- public verbs (concrete tables wrap these with typed signatures) ----
@@ -468,7 +484,18 @@ class WorkerTable:
                 # arbitrarily stale data as fresh).
                 eng = self._zoo.server_engine
                 with self._lock:
-                    self._gc_fill[handle] = (key, eng.window_epoch)
+                    # BOTH clocks captured at SUBMIT: the window epoch
+                    # (see above) AND this process's write epoch — a
+                    # concurrent worker thread's Add landing between
+                    # submit and Wait must invalidate the entry, but a
+                    # Wait-time read would stamp the entry with the
+                    # post-Add epoch and launder the stale value as
+                    # fresh (unmasked by the round-12 per-shard
+                    # staleness clock; the old global clock usually
+                    # aged such entries out by accident)
+                    self._gc_fill[handle] = (
+                        key, eng.epoch_for_table(self.table_id),
+                        self._write_epoch)
             return handle
 
     def AddAsync(self, payload: Dict[str, Any],
@@ -623,8 +650,12 @@ class WorkerTable:
             ent = self._gc_cache.get(key)
             if ent is not None:
                 fill_epoch, fill_wep, result = ent
+                # per-shard epoch (round 12): the staleness clock is
+                # the stream applying THIS table's verbs — a busy
+                # neighbour shard must not age this entry
                 if (fill_wep == self._write_epoch
-                        and eng.window_epoch - fill_epoch <= staleness):
+                        and (eng.epoch_for_table(self.table_id)
+                             - fill_epoch) <= staleness):
                     tmetrics.counter("worker.get_cache_hits").inc()
                     self._gc_next_hit -= 1
                     hid = self._gc_next_hit
@@ -633,13 +664,15 @@ class WorkerTable:
                 del self._gc_cache[key]   # expired: drop, refill below
         return None, key
 
-    def _gc_store(self, key, result, fill_epoch: int) -> None:
+    def _gc_store(self, key, result, fill_epoch: int,
+                  fill_wep: int) -> None:
         """File one fetched result under its request key, dated at the
-        SUBMIT-time window epoch (GetAsync captured it — see there)."""
+        SUBMIT-time window AND write epochs (GetAsync captured both —
+        see there)."""
         with self._lock:
             if len(self._gc_cache) >= _GET_CACHE_ENTRIES:
                 self._gc_cache.pop(next(iter(self._gc_cache)))
-            self._gc_cache[key] = (fill_epoch, self._write_epoch,
+            self._gc_cache[key] = (fill_epoch, fill_wep,
                                    copy_result(result))
 
 

@@ -402,6 +402,14 @@ class KVServerTable(ServerTable):
             return out
         return _finalize
 
+    def mh_prepare_local_apply(self) -> None:
+        """Sharded-engine pre-warm (tables/base.py contract): force the
+        replicated f32 mirror live at registration (the fetch is a
+        lockstep collective there). Host-backed values already ARE
+        host state — nothing to warm."""
+        if not self._host_backed and self._host_values_ok:
+            self._np_values()
+
     def mh_apply_is_local(self) -> bool:
         """Pipelined-engine overlap gate (tables/base.py contract):
         host-backed (64-bit) values ARE host state, and a live

@@ -573,6 +573,14 @@ class MatrixServerTable(ServerTable):
             self._nat_store = store
         return self._nat_store
 
+    def mh_prepare_local_apply(self) -> None:
+        """Sharded-engine pre-warm (tables/base.py contract): force the
+        native mirror live at registration — the collective ``raw()``
+        read inside ``_host_store()`` is lockstep there, exactly like
+        the first fenced window's would have been."""
+        if self._native_host_ok:
+            self._host_store()
+
     def mh_apply_is_local(self) -> bool:
         """Pipelined-engine overlap gate (tables/base.py contract): with
         the replicated native mirror LIVE, every exchanged-parts apply

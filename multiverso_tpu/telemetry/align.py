@@ -1,25 +1,30 @@
-"""Shared (membership epoch, exchange SEQ) stream alignment.
+"""Shared (membership epoch, stream, exchange SEQ) stream alignment.
 
 Two offline tools read per-rank flight dumps and line their events up
 by stream position: ``telemetry/forensics.py`` (divergence hunting)
 and ``telemetry/critpath.py`` (cross-rank critical-path
 reconstruction). Both must apply IDENTICAL rules for
 
-* the alignment key — the ``(mepoch, seq)`` pair, because the elastic
-  plane re-bases the exchange SEQ to 0 at every membership epoch
-  transition (two healthy ranks legally both record seq 0 once per
-  epoch; a dump from a pre-elastic world carries no ``mepoch`` field
-  and reads as epoch 0 throughout);
-* ragged tails — a dump that merely ENDS earlier than its peers'
-  (the rank died or dumped first) covers a shorter range and is NOT
-  a hole at the uncovered positions;
+* the alignment key — the ``(mepoch, stream, seq)`` triple: the
+  elastic plane re-bases the exchange SEQ to 0 at every membership
+  epoch transition, and the SHARDED engine (round 12) runs one
+  independent window stream per shard, each with its own SEQ counter —
+  two healthy ranks legally record seq 0 once per (epoch, stream). A
+  dump from an older world carries neither field and reads as epoch 0,
+  stream 0 throughout;
+* ragged tails — a dump whose ``(mepoch, stream)`` sub-stream merely
+  ENDS earlier than its peers' (the rank died or dumped first) covers
+  a shorter range and is NOT a hole at the uncovered positions; the
+  rule is applied PER sub-stream, because shards drain independently
+  (shard 1 legally runs far ahead of shard 0);
 * evicted heads — a dump that STARTS later because the bounded ring
   aged out its oldest events (``dropped > 0`` in the header) is NOT a
   hole at the front either; a front-missing position on a rank that
   dropped NOTHING cannot be eviction and IS one.
 
 This module is that single rule set — factored out in round 11 so the
-two tools cannot drift on epoch re-basing or ragged-tail handling.
+two tools cannot drift on epoch re-basing, shard-stream keying or
+ragged-tail handling.
 """
 
 from __future__ import annotations
@@ -27,8 +32,8 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Tuple
 
-#: an alignment key: (membership epoch, exchange SEQ)
-Pos = Tuple[int, int]
+#: an alignment key: (membership epoch, engine shard stream, SEQ)
+Pos = Tuple[int, int, int]
 
 
 def load(path: str) -> dict:
@@ -51,14 +56,15 @@ def load(path: str) -> dict:
 
 
 def stream(events: List[dict], kinds) -> Dict[Pos, List[dict]]:
-    """``(mepoch, seq) -> ordered events of ``kinds`` at that stream
-    position`` (ring order preserved within a position). Events with a
-    negative seq — e.g. single-process ``window.phases`` records —
-    are not stream positions and are skipped."""
+    """``(mepoch, stream, seq) -> ordered events of ``kinds`` at that
+    stream position`` (ring order preserved within a position). Events
+    with a negative seq — e.g. single-process ``window.phases``
+    records — are not stream positions and are skipped."""
     out: Dict[Pos, List[dict]] = {}
     for e in events:
         if e.get("kind") in kinds and e.get("seq", -1) >= 0:
-            key = (int(e.get("mepoch", 0) or 0), int(e["seq"]))
+            key = (int(e.get("mepoch", 0) or 0),
+                   int(e.get("stream", 0) or 0), int(e["seq"]))
             out.setdefault(key, []).append(e)
     return out
 
@@ -95,22 +101,46 @@ def common_positions(streams: Dict[int, Dict[Pos, List[dict]]]) -> List[Pos]:
     return sorted(covered or ())
 
 
+def stream_bounds(rank_stream: Dict[Pos, List[dict]]) -> Dict[tuple,
+                                                              Tuple[Pos,
+                                                                    Pos]]:
+    """Per-``(mepoch, stream)`` (min, max) covered positions of one
+    rank's keyed stream — computed in ONE pass so repeated
+    :func:`is_hole` calls over a large dump stay linear (callers
+    checking many positions pass this in)."""
+    out: Dict[tuple, Tuple[Pos, Pos]] = {}
+    for p in rank_stream:
+        sub = p[:2]
+        b = out.get(sub)
+        out[sub] = ((p, p) if b is None
+                    else (min(b[0], p), max(b[1], p)))
+    return out
+
+
 def is_hole(rank_stream: Dict[Pos, List[dict]], pos: Pos,
-            dropped: int) -> bool:
+            dropped: int, bounds=None) -> bool:
     """True when ``pos`` missing from ``rank_stream`` is a HOLE — a
     genuine stream gap — rather than a legal shorter covered range.
 
-    A missing position only counts as a hole when the rank recorded
-    activity on BOTH sides of it, or ahead of it while its header says
-    it dropped nothing (a front-missing position then cannot be ring
-    eviction). A dump that merely ends earlier (rank died / dumped
-    first), or starts later because the bounded ring evicted its oldest
-    events, covers a shorter range — not a divergent stream."""
+    Evaluated WITHIN ``pos``'s own ``(mepoch, stream)`` sub-stream:
+    shard streams drain independently, so shard 1 being far ahead of
+    shard 0 must not turn shard 0's ragged tail into a "gap". A rank
+    that never recorded the sub-stream at all covers none of it —
+    shorter coverage, not a hole. Within the sub-stream, a missing
+    position only counts as a hole when the rank recorded activity on
+    BOTH sides of it, or ahead of it while its header says it dropped
+    nothing (a front-missing position then cannot be ring eviction).
+    ``bounds`` (optional): this rank's precomputed
+    :func:`stream_bounds`, for callers probing many positions."""
     if not rank_stream or pos in rank_stream:
         return False
-    if pos >= max(rank_stream):
-        return False            # ragged tail: the dump just ends here
-    if pos > min(rank_stream):
+    b = (bounds if bounds is not None
+         else stream_bounds(rank_stream)).get(pos[:2])
+    if b is None:
+        return False            # this (mepoch, stream) never recorded
+    if pos >= b[1]:
+        return False            # ragged tail: the sub-stream ends here
+    if pos > b[0]:
         return True             # activity on both sides: a real gap
     return dropped == 0         # front-missing without eviction
 

@@ -6,11 +6,13 @@ tool answers WHERE each window's wall time actually went and WHICH
 rank bound it. Every rank's engine stamps its window lifecycle phases
 — form, pack, encode, exchange (with the time blocked in the
 collective split from local codec work), decode, apply — as compact
-``window.phases`` flight events keyed by ``(mepoch, SEQ)``
-(sync/server.py), plus per-(table, verb) apply seconds as
-``window.tables``. :func:`correlate` merges the per-rank dumps into
-ONE cross-rank timeline and names the binding rank and binding phase
-per window.
+``window.phases`` flight events keyed by ``(mepoch, stream, SEQ)``
+(sync/server.py; ``stream`` is the engine shard, round 12 — each
+shard owns an independent window stream), plus per-(table, verb)
+apply seconds as ``window.tables``. :func:`correlate` merges the
+per-rank dumps into ONE cross-rank timeline and names the binding
+rank and binding phase per window — per stream, with a cross-stream
+summary in ``report["streams"]``.
 
 Clock alignment
 ===============
@@ -175,6 +177,7 @@ def correlate(paths: List[str]) -> dict:
               "clock_offsets_s": {r: 0.0 for r in ranks},
               "align_err_s": 0.0,
               "binding_rank_hist": {}, "binding_phase_hist": {},
+              "streams": {},
               "phase_totals_s": {r: {p: round(s, 6)
                                      for p, s in phase_totals[r].items()}
                                  for r in ranks},
@@ -231,11 +234,17 @@ def correlate(paths: List[str]) -> dict:
     phase_hist: Dict[str, int] = {}
     wait_excess = {r: 0.0 for r in ranks}
     accounted = []
+    # the binding gap is between CONSECUTIVE windows of the SAME
+    # (mepoch, stream) sub-stream: engine shards drain independently,
+    # so "previous window" must never cross shard streams
     prev_common: Dict[tuple, tuple] = {}
-    last = None
+    last_by_sub: Dict[tuple, tuple] = {}
     for pos in common:
-        prev_common[pos] = last
-        last = pos
+        prev_common[pos] = last_by_sub.get(pos[:2])
+        last_by_sub[pos[:2]] = pos
+    #: per engine shard stream: binding verdicts (round 12 — the
+    #: sharded engine's per-stream report + cross-stream summary)
+    per_stream: Dict[int, dict] = {}
     windows_out = []
     for pos in common:
         enters = {r: win[r][pos]["x_done_w"] - offsets[r]
@@ -283,6 +292,14 @@ def correlate(paths: List[str]) -> dict:
         phase_hist[phase] = phase_hist.get(phase, 0) + 1
         if period is not None and period > 0:
             accounted.append(100.0 * (period - unacc) / period)
+        ps = per_stream.setdefault(pos[1], {
+            "n_windows": 0, "binding_rank_hist": {},
+            "binding_phase_hist": {}})
+        ps["n_windows"] += 1
+        ps["binding_rank_hist"][binding] = (
+            ps["binding_rank_hist"].get(binding, 0) + 1)
+        ps["binding_phase_hist"][phase] = (
+            ps["binding_phase_hist"].get(phase, 0) + 1)
         windows_out.append({
             "pos": list(pos), "binding_rank": binding,
             "binding_phase": phase,
@@ -298,6 +315,15 @@ def correlate(paths: List[str]) -> dict:
     report["windows"] = windows_out
     report["binding_rank_hist"] = rank_hist
     report["binding_phase_hist"] = phase_hist
+    # cross-stream summary: the flat hists above AGGREGATE every shard
+    # stream; per_stream carries each stream's own verdicts so a
+    # straggling shard is visible as such
+    for s in per_stream.values():
+        bp = s["binding_phase_hist"]
+        br = s["binding_rank_hist"]
+        s["dominant_phase"] = max(bp, key=bp.get)
+        s["dominant_rank"] = max(br, key=br.get)
+    report["streams"] = per_stream
     report["exchange_wait_excess_s"] = {r: round(s, 6)
                                         for r, s in wait_excess.items()}
     if accounted:
@@ -305,8 +331,10 @@ def correlate(paths: List[str]) -> dict:
             sum(accounted) / len(accounted), 1)
     top_rank = max(rank_hist, key=rank_hist.get)
     top_phase = max(phase_hist, key=phase_hist.get)
+    multi = (f" across {len(per_stream)} engine streams"
+             if len(per_stream) > 1 else "")
     report["note"] = (
-        f"{len(common)} windows: rank {top_rank} binds "
+        f"{len(common)} windows{multi}: rank {top_rank} binds "
         f"{rank_hist[top_rank]}/{len(common)}, dominant binding phase "
         f"'{top_phase}' ({phase_hist[top_phase]}/{len(common)}); "
         f"alignment error <= {report['align_err_s'] * 1e3:.3f} ms")
@@ -330,6 +358,14 @@ def report_text(report: dict) -> str:
             f"{p}: {n}" for p, n in
             sorted(report["binding_phase_hist"].items(),
                    key=lambda kv: -kv[1])))
+        if len(report.get("streams", {})) > 1:
+            for sid, s in sorted(report["streams"].items()):
+                lines.append(
+                    f"  stream {sid}: {s['n_windows']} windows, "
+                    f"binding rank {s['dominant_rank']} "
+                    f"({s['binding_rank_hist'][s['dominant_rank']]}"
+                    f"/{s['n_windows']}), dominant phase "
+                    f"'{s['dominant_phase']}'")
         lines.append("exchange-wait excess (blocked waiting on a "
                      "slower peer): " + ", ".join(
                          f"rank {r}: {s * 1e3:.1f}ms" for r, s in
@@ -404,11 +440,14 @@ def to_chrome_trace(paths: List[str],
                 slices.append((r, stage, start, dur, pos))
                 t0 = start if t0 is None else min(t0, start)
     for r, stage, start, dur, pos in slices:
-        events.append({"name": f"{stage} s{pos[1]}", "cat": "critpath",
+        st = f" st{pos[1]}" if pos[1] else ""
+        events.append({"name": f"{stage}{st} s{pos[2]}",
+                       "cat": "critpath",
                        "ph": "X", "ts": (start - (t0 or 0.0)) * 1e6,
                        "dur": dur * 1e6, "pid": r,
                        "tid": _TRACKS[stage],
-                       "args": {"mepoch": pos[0], "seq": pos[1]}})
+                       "args": {"mepoch": pos[0], "stream": pos[1],
+                                "seq": pos[2]}})
     suffix = " (UNALIGNED CLOCK)" if unaligned else ""
     process_names = {r: f"rank {r}{suffix}" for r in streams}
     thread_names = {(r, tid): stage for r in streams
@@ -424,9 +463,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from multiverso_tpu.utils.log import Log
     parser = argparse.ArgumentParser(
         prog="python -m multiverso_tpu.telemetry.critpath",
-        description="merge per-rank flight dumps by (mepoch, SEQ), "
-                    "align clocks on exchange-done rendezvous points, "
-                    "and report each window's binding rank + phase")
+        description="merge per-rank flight dumps by (mepoch, stream, "
+                    "SEQ), align clocks on exchange-done rendezvous "
+                    "points, and report each window's binding rank + "
+                    "phase (per engine shard stream)")
     parser.add_argument("paths", nargs="+",
                         help="per-rank flight_rank<R>.jsonl dumps")
     parser.add_argument("--trace", default="",

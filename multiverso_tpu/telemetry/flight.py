@@ -82,7 +82,7 @@ class FlightRecorder:
         self._recorded = 0
 
     def record(self, cap: int, kind: str, seq: int, epoch: int,
-               detail: str, mepoch: int = 0) -> None:
+               detail: str, mepoch: int = 0, stream: int = 0) -> None:
         # dual stamp OUTSIDE the lock (back-to-back, so the pair is
         # coherent): wall for cross-rank alignment, monotonic for
         # NTP-step-proof interval math (telemetry/critpath.py)
@@ -95,7 +95,7 @@ class FlightRecorder:
                 ring = collections.deque(ring, maxlen=cap)
                 self._ring = ring
             ring.append((t_wall, t_mono, kind, seq, epoch, detail,
-                         mepoch))
+                         mepoch, stream))
             self._recorded += 1
 
     def stats(self) -> Tuple[int, int]:
@@ -121,14 +121,20 @@ class FlightRecorder:
         math rides ``tm``; cross-rank alignment rides ``t``).
         ``mepoch`` is the membership epoch the event was recorded under
         (0 = boot world; the elastic plane re-bases the exchange SEQ
-        per membership epoch, so forensics aligns by (mepoch, seq))."""
+        per membership epoch). ``stream`` (round 12) is the engine
+        shard's window stream the event belongs to (0 = the unsharded
+        engine / shard 0): each shard owns an independent exchange
+        stream with its own SEQ counter, so the offline tools align by
+        (mepoch, stream, seq) — telemetry/align.py is the one rule
+        set."""
         with self._lock:
             raw = list(self._ring)
         if n is not None and n > 0:
             raw = raw[-n:]
         return [{"t": ev[0], "tm": ev[1], "kind": ev[2], "seq": ev[3],
                  "epoch": ev[4], "detail": ev[5],
-                 "mepoch": ev[6] if len(ev) > 6 else 0}
+                 "mepoch": ev[6] if len(ev) > 6 else 0,
+                 "stream": ev[7] if len(ev) > 7 else 0}
                 for ev in raw]
 
     def tail_text(self, n: int = 40) -> str:
@@ -136,8 +142,9 @@ class FlightRecorder:
         lines = []
         for e in self.events(n):
             me = f" mepoch={e['mepoch']}" if e.get("mepoch") else ""
+            st = f" stream={e['stream']}" if e.get("stream") else ""
             lines.append(f"{e['t']:.6f} {e['kind']} seq={e['seq']} "
-                         f"epoch={e['epoch']}{me} {e['detail']}")
+                         f"epoch={e['epoch']}{me}{st} {e['detail']}")
         return "\n".join(lines) or "<flight ring empty>"
 
     def _reset_for_tests(self) -> None:
@@ -150,16 +157,16 @@ RECORDER = FlightRecorder()
 
 
 def record(kind: str, seq: int = -1, epoch: int = -1,
-           detail: str = "", mepoch: int = 0) -> None:
+           detail: str = "", mepoch: int = 0, stream: int = 0) -> None:
     """Record one event. The disabled path (``-mv_flight_events=0``)
     is one cached int read and a return — the no-op gate pattern.
     ``mepoch`` stamps the membership epoch (elastic plane; 0 = boot
-    world): stream events under a re-based exchange SEQ align by
-    (mepoch, seq)."""
+    world) and ``stream`` the engine shard's window stream (round 12):
+    stream events align by (mepoch, stream, seq)."""
     cap = _cap()
     if cap <= 0:
         return
-    RECORDER.record(cap, kind, seq, epoch, detail, mepoch)
+    RECORDER.record(cap, kind, seq, epoch, detail, mepoch, stream)
 
 
 def enabled() -> bool:

@@ -34,9 +34,12 @@ rides the exchange SEQ, which is the collective clock.
 
 Elastic worlds (round 10): the engine re-bases the exchange SEQ to 0
 at every MEMBERSHIP epoch transition, and every stream event carries
-its membership epoch (``mepoch``) — alignment therefore keys on the
-``(mepoch, seq)`` pair, so a legal re-base never reads as a
-divergence while a real divergence *within* an epoch still does.
+its membership epoch (``mepoch``). Sharded engines (round 12) run one
+independent window stream per shard, each with its own SEQ counter,
+stamped as ``stream``. Alignment therefore keys on the ``(mepoch,
+stream, seq)`` triple (telemetry/align.py, shared with critpath), so
+a legal re-base or an independent shard stream never reads as a
+divergence while a real divergence *within* one stream still does.
 
 CLI::
 
@@ -92,9 +95,12 @@ def correlate(paths: List[str]) -> dict:
     streams, dropped = align.by_rank(dumps, _STREAM_KINDS)
     ranks = sorted(streams)
     all_pos = align.all_positions(streams)
+    # per-rank sub-stream bounds ONCE: is_hole over every missing
+    # position stays linear on large multi-shard dumps
+    bounds = {r: align.stream_bounds(streams[r]) for r in ranks}
     agreed: Optional[tuple] = None
     for pos in all_pos:
-        mepoch, seq = pos
+        mepoch, stream_id, seq = pos
         descs = {r: _desc(streams[r].get(pos)) for r in ranks}
         present = {r: d for r, d in descs.items() if d is not None}
         missing = [r for r, d in descs.items() if d is None]
@@ -103,7 +109,8 @@ def correlate(paths: List[str]) -> dict:
         # died / dumped first) or start later (bounded ring evicted its
         # oldest events, dropped > 0) — only a genuine gap diverges
         holes = [r for r in missing
-                 if align.is_hole(streams[r], pos, dropped.get(r, 0))]
+                 if align.is_hole(streams[r], pos, dropped.get(r, 0),
+                                  bounds=bounds[r])]
         vals = set(present.values())
         if len(vals) > 1 or holes:
             per_rank = {r: descs[r] for r in ranks}
@@ -111,21 +118,28 @@ def correlate(paths: List[str]) -> dict:
                 f"rank {r}: {descs[r] if descs[r] is not None else '<missing>'}"
                 for r in ranks)
             ep = f" (membership epoch {mepoch})" if mepoch else ""
+            st = f" (engine stream {stream_id})" if stream_id else ""
             return {"diverged": True, "seq": seq, "mepoch": mepoch,
+                    "stream": stream_id,
                     "ranks": ranks, "per_rank": per_rank,
-                    "agreed_through": (agreed[1] if agreed else None),
+                    "agreed_through": (agreed[2] if agreed else None),
                     "agreed_mepoch": (agreed[0] if agreed else None),
+                    "agreed_stream": (agreed[1] if agreed else None),
                     "note": (f"first diverging exchange SEQ {seq}"
-                             f"{ep}: {detail}")}
+                             f"{ep}{st}: {detail}")}
         if len(present) == len(ranks):
             agreed = pos
     return {"diverged": False, "seq": None, "mepoch": None,
+            "stream": None,
             "ranks": ranks, "per_rank": {},
-            "agreed_through": (agreed[1] if agreed else None),
+            "agreed_through": (agreed[2] if agreed else None),
             "agreed_mepoch": (agreed[0] if agreed else None),
-            "note": (f"streams agree through exchange SEQ {agreed[1]}"
+            "agreed_stream": (agreed[1] if agreed else None),
+            "note": (f"streams agree through exchange SEQ {agreed[2]}"
                      + (f" of membership epoch {agreed[0]}"
                         if agreed[0] else "")
+                     + (f" on engine stream {agreed[1]}"
+                        if agreed[1] else "")
                      if agreed is not None
                      else "no common stream events")}
 
@@ -136,7 +150,9 @@ def report_text(report: dict) -> str:
     if report["diverged"]:
         ep = (f" of membership epoch {report['mepoch']}"
               if report.get("mepoch") else "")
-        lines.append(f"DIVERGED at exchange SEQ {report['seq']}{ep} "
+        st = (f" on engine stream {report['stream']}"
+              if report.get("stream") else "")
+        lines.append(f"DIVERGED at exchange SEQ {report['seq']}{ep}{st} "
                      f"(streams agreed through "
                      f"{report['agreed_through']})")
         for r in report["ranks"]:

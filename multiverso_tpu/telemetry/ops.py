@@ -149,6 +149,31 @@ def health_report() -> dict:
                     out["healthy"] = False
                     out["reasons"].append(
                         f"exchange stage dead: {stage.dead!r}")
+            # round 12 — sharded engine: per-shard stream state, with a
+            # dead SHARD (poisoned actor or dead exchange stage on any
+            # stream) reported distinctly from the shard-0 probes above
+            shards_fn = getattr(eng, "shard_states", None)
+            if shards_fn is not None:
+                try:
+                    shards = shards_fn()
+                except Exception:   # engine torn down mid-scrape
+                    shards = []
+                if len(shards) > 1:
+                    out["engine"]["shards"] = shards
+                    from multiverso_tpu.parallel import multihost
+                    out["engine"]["transport"] = multihost.wire_name()
+                    for s in shards:
+                        st = s.get("stage") or {}
+                        if s.get("poisoned") is not None:
+                            out["healthy"] = False
+                            out["reasons"].append(
+                                f"engine shard {s['shard']} poisoned: "
+                                f"{s['poisoned']}")
+                        elif st.get("dead") is not None:
+                            out["healthy"] = False
+                            out["reasons"].append(
+                                f"engine shard {s['shard']} exchange "
+                                f"stage dead: {st['dead']}")
     except Exception as exc:    # health must never turn into a crash
         out["healthy"] = False
         out["reasons"].append(f"probe failed: {exc!r}")

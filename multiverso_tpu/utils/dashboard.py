@@ -203,6 +203,25 @@ class Dashboard:
                 f"{port if port is not None else 'off'}, "
                 f"last_fence = {last_fence or '-'}, "
                 f"last_binding_phase = {last_binding or '-'}"]
+            # round 12 — sharded engine: one [Engine] line naming the
+            # active transport and each shard stream's live depth/
+            # pending (a wedged shard shows up as a deep stream here
+            # long before /healthz flips)
+            if eng is not None:
+                from multiverso_tpu.parallel import multihost
+                shards = eng.shard_states()
+                parts = []
+                for s in shards:
+                    st = s.get("stage") or {}
+                    state = ("DEAD" if s.get("poisoned") is not None
+                             or st.get("dead") is not None else
+                             f"depth={st.get('depth', 0)}/"
+                             f"pending={st.get('pending_verbs', 0)}/"
+                             f"mbox={s.get('mailbox_depth', 0)}")
+                    parts.append(f"s{s['shard']}:{state}")
+                lines.append(
+                    f"[Engine] shards = {len(shards)}, transport = "
+                    f"{multihost.wire_name()}, " + ", ".join(parts))
             # round 11 — the -mv_row_sketch access-skew measurement:
             # one [RowSkew] line per armed table (top rows + share)
             if eng is not None:

@@ -104,6 +104,14 @@ class Zoo:
         # MPI/ZMQ transport's TPU equivalent is the cross-host mesh itself)
         self._multihost = multihost.maybe_initialize()
         self.mesh_ctx = MeshContext.create(devices)
+        if self._multihost:
+            # host-wire selection BEFORE the engine exists (round 12):
+            # same-host worlds ride the shared-memory wire (-mv_wire),
+            # whose per-shard channels are what permit a sharded
+            # engine's concurrent window streams in multi-process mode
+            from multiverso_tpu.sync.server import \
+                requested_engine_channels
+            multihost.maybe_install_wire(requested_engine_channels())
         rank = multihost.process_index() if self._multihost else 0
         self.node = Node(rank=rank, role=role,
                          worker_id=0 if role & Role.WORKER else -1,
@@ -157,6 +165,9 @@ class Zoo:
                           "continuing shutdown", exc)
             self.server_engine.Stop()
             self.server_engine = None
+        # the shm wire (when installed) outlives the engine — the
+        # drain above still exchanged on it — and dies with the world
+        multihost.close_wire()
         # membership plane down AFTER the engine drain: the drain's
         # final flushes must still route under the CURRENT epoch view
         # (restoring the boot-world group earlier would aim the drain's

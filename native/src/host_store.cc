@@ -45,7 +45,35 @@ class Pool {
   }
 
   // fn(tid, nthreads); blocks until every worker finished its slice.
+  // SINGLE OWNER at a time: cv_done_.wait releases m_, so without the
+  // owner lock a second concurrent caller (round 12: per-table-group
+  // engine SHARDS apply concurrently) would overwrite fn_/done_/gen_
+  // under the first call's workers — a use-after-scope crash. Callers
+  // that find the pool busy should run their slice inline instead
+  // (TryParallelFor): N shards each on their own core beat N shards
+  // convoying behind one pool.
   void ParallelFor(const std::function<void(int, int)>& fn) {
+    std::lock_guard<std::mutex> owner(owner_m_);
+    Dispatch(fn);
+  }
+
+  // ParallelFor when the pool is free; false (caller runs inline)
+  // when another apply currently owns it.
+  bool TryParallelFor(const std::function<void(int, int)>& fn) {
+    std::unique_lock<std::mutex> owner(owner_m_, std::try_to_lock);
+    if (!owner.owns_lock()) return false;
+    Dispatch(fn);
+    return true;
+  }
+
+  int size() const { return nthreads_; }
+
+ private:
+  // the one dispatch/wait body (owner_m_ held by the caller): any
+  // future change to the done_/gen_ handshake lands in exactly one
+  // place, so the Try/blocking entries cannot drift back into the
+  // concurrent-writer race the owner lock exists to prevent
+  void Dispatch(const std::function<void(int, int)>& fn) {
     std::unique_lock<std::mutex> l(m_);
     fn_ = &fn;
     done_ = 0;
@@ -55,7 +83,7 @@ class Pool {
     fn_ = nullptr;
   }
 
-  int size() const { return nthreads_; }
+ public:
 
  private:
   void Run(int tid) {
@@ -77,6 +105,7 @@ class Pool {
     }
   }
 
+  std::mutex owner_m_;  // serializes whole ParallelFor calls
   std::mutex m_;
   std::condition_variable cv_, cv_done_;
   std::vector<std::thread> threads_;
@@ -123,11 +152,17 @@ inline void ForRows(int64_t n, int64_t cols,
     return;
   }
   int64_t chunk = (n + nt - 1) / nt;
-  pool.ParallelFor([&](int tid, int) {
+  bool ran = pool.TryParallelFor([&](int tid, int) {
     int64_t lo = tid * chunk;
     int64_t hi = lo + chunk < n ? lo + chunk : n;
     if (lo < hi) body(lo, hi);
   });
+  if (!ran) {
+    // another engine shard owns the pool: run inline on THIS shard's
+    // actor thread — concurrent shards each saturate their own core
+    // instead of convoying behind one pool
+    body(0, n);
+  }
 }
 
 }  // namespace

@@ -40,6 +40,10 @@ GUARDED = {
     # single-threaded metrics'
     "serving_lookup_qps": 0.6,
     "serving_lookup_2proc_qps": 0.6,
+    # round 12 — the same-host shared-memory wire's 4MB-exchange
+    # bandwidth (vs ~0.3 GB/s gloo; the wire's whole point). Generous
+    # floor: a shared host's memory subsystem swings per session
+    "matrix_table_2proc_shm_wire_MB_s": 0.5,
 }
 
 #: metric -> worst acceptable multiple of the guard value (latency:

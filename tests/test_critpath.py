@@ -239,21 +239,38 @@ class TestCritpathSynthetic:
 
 class TestAlignRules:
     def test_hole_vs_tail_vs_eviction(self):
-        stream = {(0, 0): [{}], (0, 1): [{}], (0, 3): [{}]}
+        stream = {(0, 0, 0): [{}], (0, 0, 1): [{}], (0, 0, 3): [{}]}
         # tail: beyond the last covered position is never a hole
-        assert not align.is_hole(stream, (0, 4), dropped=0)
+        assert not align.is_hole(stream, (0, 0, 4), dropped=0)
         # middle gap: always a hole
-        assert align.is_hole(stream, (0, 2), dropped=7)
+        assert align.is_hole(stream, (0, 0, 2), dropped=7)
         # front-missing: eviction explains it only when drops occurred
-        stream2 = {(0, 2): [{}], (0, 3): [{}]}
-        assert align.is_hole(stream2, (0, 0), dropped=0)
-        assert not align.is_hole(stream2, (0, 0), dropped=3)
+        stream2 = {(0, 0, 2): [{}], (0, 0, 3): [{}]}
+        assert align.is_hole(stream2, (0, 0, 0), dropped=0)
+        assert not align.is_hole(stream2, (0, 0, 0), dropped=3)
+
+    def test_hole_rules_are_per_shard_stream(self):
+        # round 12: shard streams drain independently — shard 1 far
+        # ahead of shard 0 must not turn shard 0's ragged tail into a
+        # "gap", and a stream the rank never recorded is shorter
+        # coverage, not a hole
+        stream = {(0, 0, 0): [{}], (0, 0, 1): [{}],
+                  (0, 1, 0): [{}], (0, 1, 9): [{}]}
+        assert not align.is_hole(stream, (0, 0, 2), dropped=0)  # tail
+        assert align.is_hole(stream, (0, 1, 4), dropped=0)      # gap
+        assert not align.is_hole(stream, (0, 2, 0), dropped=0)  # absent
+        # stream keying: events without a stream field read stream 0
+        ev = [{"kind": "window.phases", "seq": 3},
+              {"kind": "window.phases", "seq": 4, "stream": 1,
+               "mepoch": 2}]
+        keyed = align.stream(ev, ("window.phases",))
+        assert set(keyed) == {(0, 0, 3), (2, 1, 4)}
 
     def test_common_positions_and_coverage(self):
-        streams = {0: {(0, i): [{}] for i in range(5)},
-                   1: {(0, i): [{}] for i in range(2, 5)}}
-        assert align.common_positions(streams) == [(0, 2), (0, 3),
-                                                   (0, 4)]
+        streams = {0: {(0, 0, i): [{}] for i in range(5)},
+                   1: {(0, 0, i): [{}] for i in range(2, 5)}}
+        assert align.common_positions(streams) == [(0, 0, 2), (0, 0, 3),
+                                                   (0, 0, 4)]
         note = align.coverage_note(streams, {0: 0, 1: 4})
         assert note and "rank 1" in note and "3/5" in note
 

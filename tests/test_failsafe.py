@@ -412,6 +412,11 @@ class TestBlockingPathLint:
             assert any(rel.endswith(need)
                        and rel.startswith(("telemetry/", "telemetry\\"))
                        for rel in scanned), sorted(scanned)
+        # ...and the round-12 shm wire: a transport with spin-waits is
+        # exactly where an unbounded block would hide
+        assert any(rel.endswith("shm_wire.py")
+                   and rel.startswith(("parallel/", "parallel\\"))
+                   for rel in scanned), sorted(scanned)
         assert not offenders, (
             "unbounded blocking calls without a timeout-capable path or "
             "an 'unbounded-ok:' justification:\n" + "\n".join(offenders))

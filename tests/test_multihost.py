@@ -776,3 +776,73 @@ class TestTwoProcessLogRegDevicePlane:
         W0 = np.load(tmp_path / "W_0.npy")
         W1 = np.load(tmp_path / "W_1.npy")
         np.testing.assert_array_equal(W0, W1)
+
+
+class TestPjrtHeartbeatPlumbing:
+    """Round 12 satellite (ROADMAP elastic follow-on 4): MV_Init plumbs
+    -mv_pjrt_heartbeat_s into the coordination-service heartbeat knobs
+    so long-lived shrunk worlds outlive the runtime's ~100s corpse
+    detection. The kwargs computation + signature filtering are the
+    plumbing under regression here (a live multi-host init is
+    environment-bound)."""
+
+    def _set(self, name, value):
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        SetCMDFlag(name, value)
+
+    def test_budget_splits_into_interval_and_misses(self):
+        from multiverso_tpu.parallel import multihost as mh
+        self._set("mv_pjrt_heartbeat_s", 600)
+        try:
+            kw = mh.pjrt_heartbeat_kwargs()
+            assert kw["service_heartbeat_interval_seconds"] == 60
+            assert kw["client_heartbeat_interval_seconds"] == 60
+            # interval x misses covers the requested budget
+            assert (kw["service_heartbeat_interval_seconds"]
+                    * kw["service_max_missing_heartbeats"]) >= 600
+            assert kw["client_max_missing_heartbeats"] == \
+                kw["service_max_missing_heartbeats"]
+        finally:
+            self._set("mv_pjrt_heartbeat_s", 0)
+
+    def test_zero_means_runtime_defaults_unless_elastic(self):
+        from multiverso_tpu.parallel import multihost as mh
+        assert mh.pjrt_heartbeat_kwargs() == {}
+        self._set("mv_elastic", True)
+        try:
+            kw = mh.pjrt_heartbeat_kwargs()
+            # elastic worlds default to a 600s budget
+            assert (kw["client_heartbeat_interval_seconds"]
+                    * kw["client_max_missing_heartbeats"]) >= 600
+        finally:
+            self._set("mv_elastic", False)
+
+    def test_small_budget_clamps_to_sane_interval(self):
+        from multiverso_tpu.parallel import multihost as mh
+        self._set("mv_pjrt_heartbeat_s", 30)
+        try:
+            kw = mh.pjrt_heartbeat_kwargs()
+            assert kw["service_heartbeat_interval_seconds"] >= 10
+            assert kw["service_max_missing_heartbeats"] >= 2
+        finally:
+            self._set("mv_pjrt_heartbeat_s", 0)
+
+    def test_signature_filter_drops_unknown_kwargs(self):
+        from multiverso_tpu.parallel import multihost as mh
+        self._set("mv_pjrt_heartbeat_s", 300)
+        try:
+            full = mh.pjrt_heartbeat_kwargs()
+            assert mh._supported_heartbeat_kwargs(full.keys()) == full
+            # a jax that renamed every knob -> nothing passed through
+            assert mh._supported_heartbeat_kwargs(
+                {"coordinator_address": None}) == {}
+            # the INSTALLED jax: whatever its state-level initializer
+            # accepts must be the subset actually plumbed
+            import inspect
+            from jax._src import distributed as _jdist
+            params = inspect.signature(
+                _jdist.State.initialize).parameters
+            sup = mh._supported_heartbeat_kwargs(params)
+            assert set(sup) <= set(full)
+        finally:
+            self._set("mv_pjrt_heartbeat_s", 0)

@@ -211,7 +211,11 @@ class TestGetCache:
         import multiverso_tpu as mv
         from multiverso_tpu.tables import MatrixTableOption
 
-        mv.MV_Init(["-mv_get_staleness=1"])
+        # shards=1 pins every table onto ONE window stream: the
+        # round-12 staleness clock is PER STREAM (epoch_for_table), so
+        # this test's "other-table windows age the entry" premise only
+        # holds when both tables share the stream
+        mv.MV_Init(["-mv_get_staleness=1", "-mv_engine_shards=1"])
         try:
             t1 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
                                                      num_cols=4))
@@ -227,6 +231,40 @@ class TestGetCache:
             h0 = _snap("worker.get_cache_hits")
             t1.GetRows(ids)                    # expired -> real Get
             assert _snap("worker.get_cache_hits") == h0
+        finally:
+            mv.MV_ShutDown()
+
+    def test_staleness_clock_is_per_shard_stream(self):
+        """Round 12: the staleness bound counts windows of the stream
+        applying THIS table's verbs — a busy NEIGHBOR shard must not
+        age another table's entries, while same-shard windows still
+        do."""
+        import multiverso_tpu as mv
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_get_staleness=1", "-mv_engine_shards=2"])
+        try:
+            t1 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                     num_cols=4))  # shard 0
+            t2 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                     num_cols=4))  # shard 1
+            t3 = mv.MV_CreateTable(MatrixTableOption(num_rows=32,
+                                                     num_cols=4))  # shard 0
+            ids = np.arange(8, dtype=np.int32)
+            t1.AddRows(ids, np.ones((8, 4), np.float32))
+            t1.GetRows(ids)                    # fill on shard 0
+            # neighbor-shard windows: t2 rides shard 1 — entry stays
+            for _ in range(3):
+                t2.AddRows(ids, np.ones((8, 4), np.float32))
+            h0 = _snap("worker.get_cache_hits")
+            t1.GetRows(ids)
+            assert _snap("worker.get_cache_hits") == h0 + 1
+            # same-shard windows: t3 shares shard 0 — entry expires
+            for _ in range(3):
+                t3.AddRows(ids, np.ones((8, 4), np.float32))
+            h1 = _snap("worker.get_cache_hits")
+            t1.GetRows(ids)
+            assert _snap("worker.get_cache_hits") == h1
         finally:
             mv.MV_ShutDown()
 

@@ -1,0 +1,331 @@
+"""Same-host shared-memory wire (round 12; parallel/shm_wire.py).
+
+Unit matrix over the ring protocol itself (two wire ends in one
+process — rank segments are independent, so threads stand in for
+processes), the CRC/truncation fault drills the satellite asks for,
+and 2-proc worlds proving selection (``-mv_wire`` auto/gloo), parity
+through the engine, and the counters.
+"""
+
+import secrets
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.failsafe.errors import WireCorruption
+from multiverso_tpu.parallel import shm_wire
+from tests.test_multihost import run_two_process
+
+
+def _pair(channels=1, cap=4096, payload_crc=True):
+    tok = secrets.token_hex(4)
+    w0 = shm_wire.ShmWire(tok, 0, 2, channels, cap,
+                          payload_crc=payload_crc)
+    w1 = shm_wire.ShmWire(tok, 1, 2, channels, cap,
+                          payload_crc=payload_crc)
+    w0.attach_peers()
+    w1.attach_peers()
+    return tok, w0, w1
+
+
+def _both(w0, w1, fn0, fn1, timeout=30):
+    out = {}
+    errs = {}
+
+    def run(key, fn):
+        try:
+            out[key] = fn()
+        except BaseException as exc:    # re-raised by the caller
+            errs[key] = exc
+
+    ts = [threading.Thread(target=run, args=(0, fn0)),
+          threading.Thread(target=run, args=(1, fn1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in ts), "wire exchange deadlocked"
+    return out, errs
+
+
+class TestShmWireProtocol:
+    def test_exchange_round_trip_and_multi_chunk(self):
+        _, w0, w1 = _pair(cap=1024)
+        try:
+            for i in range(12):
+                b0 = bytes([1]) * (i * 517 % 5000)   # spans chunking
+                b1 = bytes([2]) * ((i * 311 + 7) % 5000)
+                out, errs = _both(w0, w1,
+                                  lambda b=b0: w0.exchange(b, 0),
+                                  lambda b=b1: w1.exchange(b, 0))
+                assert not errs, errs
+                assert out[0] == [b0, b1] == out[1]
+        finally:
+            w0.close()
+            w1.close()
+
+    def test_channels_are_independent_streams(self):
+        # one driving thread PER (rank, channel) — exactly the sharded
+        # engine's shape (each shard's exchange stage owns one
+        # channel); different channels progress with no cross-channel
+        # ordering, including deliberately skewed round counts
+        _, w0, w1 = _pair(channels=3)
+        try:
+            out = {}
+
+            def drive(w, rank, c, rounds):
+                got = []
+                for i in range(rounds):
+                    got.append(w.exchange(b"%d:%d:%d" % (rank, c, i), c))
+                out[(rank, c)] = got
+
+            rounds = {0: 5, 1: 1, 2: 3}     # skewed per channel
+            ts = [threading.Thread(target=drive, args=(w, r, c, n))
+                  for r, w in ((0, w0), (1, w1))
+                  for c, n in rounds.items()]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert not any(t.is_alive() for t in ts), "deadlocked"
+            for c, n in rounds.items():
+                for r in (0, 1):
+                    assert out[(r, c)] == [
+                        [b"0:%d:%d" % (c, i), b"1:%d:%d" % (c, i)]
+                        for i in range(n)]
+        finally:
+            w0.close()
+            w1.close()
+
+    def test_empty_and_asymmetric_frames(self):
+        _, w0, w1 = _pair()
+        try:
+            out, errs = _both(w0, w1,
+                              lambda: w0.exchange(b"", 0),
+                              lambda: w1.exchange(b"xyz", 0))
+            assert not errs, errs
+            assert out[0] == [b"", b"xyz"] == out[1]
+        finally:
+            w0.close()
+            w1.close()
+
+    def test_stats_and_counters(self):
+        from multiverso_tpu.telemetry import metrics as tmetrics
+        c0 = tmetrics.snapshot().get("shm_wire.exchanges",
+                                     {}).get("value", 0)
+        _, w0, w1 = _pair()
+        try:
+            _both(w0, w1, lambda: w0.exchange(b"s", 0),
+                  lambda: w1.exchange(b"s", 0))
+            st = w0.stats()
+            assert st["rounds"] == [1]
+            assert tmetrics.snapshot()["shm_wire.exchanges"][
+                "value"] >= c0 + 2
+        finally:
+            w0.close()
+            w1.close()
+
+
+class TestShmWireFaults:
+    """The CRC/truncation fault drill: poke the writer's segment
+    between publish and consume; the reader must raise the TYPED
+    WireCorruption (never consume garbage, never hang)."""
+
+    #: attacker attachments pinned for the process lifetime (their
+    #: views live in corrupt closures; a GC'd SharedMemory.__del__
+    #: would log BufferError noise)
+    _PINNED = []
+
+    def _drill(self, corrupt, blob=b"Y" * 9000, cap=4096,
+               payload_crc=True):
+        from multiverso_tpu.utils.configure import SetCMDFlag
+        # bound the WRITER too: a victim that (correctly) aborts on a
+        # corrupt frame stops consuming, and the writer's multi-chunk
+        # flow control must fail typed instead of spinning forever
+        SetCMDFlag("mv_deadline_s", 5)
+        tok, w0, w1 = _pair(cap=cap, payload_crc=payload_crc)
+        try:
+            seg = shm_wire._attach(shm_wire.segment_name(tok, 0, 0))
+            self._PINNED.append(seg)
+            u64 = np.frombuffer(seg.buf, np.uint64, count=8)
+            base = int(u64[0])
+            got = {}
+
+            def writer():
+                try:
+                    got["w"] = w0.exchange(blob, 0)
+                except BaseException as exc:
+                    got["w"] = exc
+
+            def victim():
+                import time
+                t0 = time.time()
+                while int(u64[0]) == base and time.time() - t0 < 10:
+                    pass                        # wait for the publish
+                corrupt(seg)
+                try:
+                    got["v"] = w1.exchange(b"z", 0)
+                except BaseException as exc:
+                    got["v"] = exc
+
+            ts = [threading.Thread(target=writer),
+                  threading.Thread(target=victim)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+            assert not any(t.is_alive() for t in ts)
+            # the attacker's attachment is leaked deliberately: its
+            # numpy views may still be referenced by the corrupt
+            # closure, and _attach suppressed tracker registration
+            del u64
+            return got["v"]
+        finally:
+            SetCMDFlag("mv_deadline_s", 0)
+            w0.close()
+            w1.close()
+
+    def test_payload_bitflip_trips_crc(self):
+        def flip(seg):
+            off = shm_wire._HDR + 8 * 2 + 123
+            seg.buf[off] ^= 0xFF
+
+        exc = self._drill(flip)
+        assert isinstance(exc, WireCorruption), exc
+        assert "CRC32" in str(exc)
+
+    def test_header_truncation_trips_typed(self):
+        # shrink the advertised chunk length mid-flight: the header
+        # CRC (always on, payload CRC irrelevant) must trip
+        def truncate(seg):
+            u64 = np.frombuffer(seg.buf, np.uint64, count=8)
+            u64[shm_wire._OFF_CHUNK_LEN // 8] = 3
+            del u64
+
+        exc = self._drill(truncate, payload_crc=False)
+        assert isinstance(exc, WireCorruption), exc
+
+    def test_round_desync_trips_typed(self):
+        # a peer at the wrong exchange round (re-entered alone) must
+        # surface loudly, not pair silently — rewrite round AND redo
+        # the header CRC so only the round check can catch it
+        def desync(seg):
+            u64 = np.frombuffer(seg.buf, np.uint64, count=8)
+            u32 = np.frombuffer(seg.buf, np.uint32, count=16)
+            u64[shm_wire._OFF_ROUND // 8] = 7
+            u32[shm_wire._OFF_HCRC // 4] = shm_wire._header_crc(
+                int(u64[shm_wire._OFF_SEQ // 8]), 7,
+                int(u64[shm_wire._OFF_TOTAL // 8]),
+                int(u64[shm_wire._OFF_CHUNK_OFF // 8]),
+                int(u64[shm_wire._OFF_CHUNK_LEN // 8]),
+                int(u32[shm_wire._OFF_CRC // 4]))
+            del u64, u32
+
+        exc = self._drill(desync, blob=b"q" * 64)
+        assert isinstance(exc, WireCorruption), exc
+        assert "desync" in str(exc) or "round" in str(exc)
+
+
+_WIRE_WORLD_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+mode = sys.argv[3] if len(sys.argv) > 3 else "auto"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.parallel import multihost
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", f"-mv_wire={mode}"])
+want = "shm" if mode in ("auto", "shm") else "gloo"
+assert multihost.wire_name() == want, (multihost.wire_name(), want)
+R, C = 300, 8
+table = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+rng = np.random.default_rng(21 + rank)
+for i in range(8):
+    ids = np.sort(rng.choice(R, 20, replace=False)).astype(np.int32)
+    deltas = rng.standard_normal((20, C)).astype(np.float32)
+    table.AddRows(ids, deltas)
+got = table.GetRows(np.arange(R, dtype=np.int32))
+oracle = np.zeros((R, C), np.float32)
+for r in range(2):
+    orng = np.random.default_rng(21 + r)
+    for i in range(8):
+        oids = np.sort(orng.choice(R, 20, replace=False)).astype(np.int32)
+        od = orng.standard_normal((20, C)).astype(np.float32)
+        np.add.at(oracle, oids, od)
+np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-4)
+if want == "shm":
+    from multiverso_tpu.telemetry import metrics as tmetrics
+    snap = tmetrics.snapshot()
+    assert snap.get("shm_wire.exchanges", {}).get("value", 0) > 0, \
+        "engine exchanges never rode the shm wire"
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} WIREWORLD-{mode} OK", flush=True)
+'''
+
+
+class TestShmWireWorlds:
+    def test_auto_selects_shm_same_host_and_engine_rides_it(
+            self, tmp_path):
+        run_two_process(_WIRE_WORLD_CHILD, tmp_path, "auto",
+                        expect="WIREWORLD-auto OK")
+
+    def test_gloo_flag_forces_socket_wire(self, tmp_path):
+        run_two_process(_WIRE_WORLD_CHILD, tmp_path, "gloo",
+                        expect="WIREWORLD-gloo OK")
+
+
+_ASYM_FAIL_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import multihost
+
+if rank == 0:
+    # simulate /dev/shm exhaustion on ONE rank only: the whole world
+    # must agree to fall back to gloo (the vote protocol), never
+    # desync its collective stream
+    from multiverso_tpu.parallel import shm_wire
+
+    class _Boom(shm_wire.ShmWire):
+        def __init__(self, *a, **k):
+            raise OSError("simulated shm create failure")
+
+    shm_wire.ShmWire = _Boom
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2"])
+assert multihost.wire_name() == "gloo", multihost.wire_name()
+from multiverso_tpu.tables import MatrixTableOption
+t = mv.MV_CreateTable(MatrixTableOption(num_rows=32, num_cols=2))
+ids = np.arange(4, dtype=np.int32)
+for _ in range(4):
+    t.AddRows(ids, np.ones((4, 2), np.float32))
+np.testing.assert_array_equal(t.GetRows(ids), np.full((4, 2), 8.0))
+mv.MV_Barrier()
+mv.MV_ShutDown()
+print(f"child {rank} ASYM-FALLBACK OK", flush=True)
+'''
+
+
+class TestShmWireAsymmetricFallback:
+    def test_one_rank_create_failure_degrades_whole_world(self,
+                                                          tmp_path):
+        """A rank whose segment creation fails must not leave its
+        peers off-by-one on the gloo collective stream: the voted
+        setup sequence degrades EVERY rank to gloo and the world keeps
+        working."""
+        run_two_process(_ASYM_FAIL_CHILD, tmp_path,
+                        expect="ASYM-FALLBACK OK")

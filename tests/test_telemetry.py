@@ -316,6 +316,10 @@ class TestNoBarePrintLint:
                      "critpath.py", "align.py", "sketch.py"):
             assert os.path.join("telemetry", need) in scanned, \
                 sorted(scanned)
+        # ...and the round-12 shm wire: its waits/errors must ride the
+        # logger like every other transport layer
+        assert os.path.join("parallel", "shm_wire.py") in scanned, \
+            sorted(scanned)
         assert not offenders, (
             "bare print() in the package — route output through "
             "utils/log.py or the telemetry exporters:\n"
