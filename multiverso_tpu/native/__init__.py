@@ -106,12 +106,17 @@ def _configure_signatures(h: ctypes.CDLL) -> None:
     h.MV_HostStoreAddAll.argtypes = [ctypes.c_void_p, f32p]
     h.MV_HostStoreAddRows.argtypes = [ctypes.c_void_p, i32p, i64, f32p]
     h.MV_HostStoreGetRows.argtypes = [ctypes.c_void_p, i32p, i64, f32p]
+    h.MV_HostStorePoolStats.argtypes = [
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     h.MV_KvIndexNew.restype = ctypes.c_void_p
     h.MV_KvIndexNew.argtypes = [i64]
     h.MV_KvIndexFree.argtypes = [ctypes.c_void_p]
     h.MV_KvIndexSize.restype = i64
     h.MV_KvIndexSize.argtypes = [ctypes.c_void_p]
+    if hasattr(h, "MV_KvIndexCapacity"):    # older prebuilt .so
+        h.MV_KvIndexCapacity.restype = i64
+        h.MV_KvIndexCapacity.argtypes = [ctypes.c_void_p]
     h.MV_KvIndexLookup.argtypes = [ctypes.c_void_p, i64p, i64, i32p]
     h.MV_KvIndexInsert.argtypes = [ctypes.c_void_p, i64p, i64, i32p]
     h.MV_KvIndexItems.argtypes = [ctypes.c_void_p, i64p, i32p]
@@ -294,6 +299,15 @@ class KvIndex:
     def __len__(self) -> int:
         return int(self._h.MV_KvIndexSize(self._ptr))
 
+    def capacity(self) -> int:
+        """Allocated probing-table slots (>= len; the load-factor
+        headroom the accounting ledger must count). Falls back to len
+        on an older .so without the export."""
+        fn = getattr(self._h, "MV_KvIndexCapacity", None)
+        if fn is None:
+            return len(self)
+        return int(fn(self._ptr))
+
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, np.int64)
         out = np.empty(len(keys), np.int32)
@@ -329,3 +343,19 @@ class KvIndex:
             raise ValueError("set_items slots must be a permutation of "
                              "0..n-1 (native used counter is next-slot)")
         self._h.MV_KvIndexSetItems(self._ptr, keys, slots, len(keys))
+
+
+def pool_stats() -> Optional[dict]:
+    """The native host-store pool's dispatch tallies (round 13
+    watchdog plane): {parallel_runs, inline_busy, inline_small,
+    pool_threads}. ``inline_busy`` counts applies that found the pool
+    owned by another engine shard and ran their slices inline — the
+    saturation signal the apply-pool watchdog rule alerts on. None
+    when the native runtime is unavailable."""
+    handle = lib()
+    if handle is None:
+        return None
+    out = np.zeros(4, np.int64)
+    handle.MV_HostStorePoolStats(out)
+    return {"parallel_runs": int(out[0]), "inline_busy": int(out[1]),
+            "inline_small": int(out[2]), "pool_threads": int(out[3])}

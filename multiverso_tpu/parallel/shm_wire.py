@@ -226,6 +226,17 @@ class ShmWire:
         self._t_crc = tmetrics.counter("shm_wire.crc_failures")
         self._t_rounds = tmetrics.counter("shm_wire.exchanges")
         self._t_bytes = tmetrics.counter("shm_wire.bytes_out")
+        # round 13 — saturation telemetry (watchdog plane): seconds this
+        # rank's WRITER spent stalled with chunks still to publish (its
+        # readers lag — backpressure on the ring, distinct from the
+        # reader-side wait for a slow peer's frame, which critpath
+        # attributes to the peer), and the largest frame ever published
+        # (ring occupancy high-watermark vs -mv_shm_ring_bytes)
+        self._t_wstall = tmetrics.counter("shm_wire.writer_stall_s")
+        self._t_hw = tmetrics.gauge("shm_wire.frame_hw_bytes")
+        self._t_occ = tmetrics.gauge("shm_wire.ring_occupancy_pct")
+        self.writer_stall_s = 0.0
+        self.frame_hw_bytes = 0
         for ch in range(channels):
             shm = shared_memory.SharedMemory(
                 name=segment_name(token, ch, rank), create=True,
@@ -284,6 +295,14 @@ class ShmWire:
         rnd = self._round[channel]
         self._round[channel] += 1
         own = self._own[channel]
+        if len(blob) > self.frame_hw_bytes:
+            # high-watermark only (one compare per exchange): the gauge
+            # answers "how close do frames come to the ring cap" —
+            # multi-chunk frames (> cap) serialize through the single
+            # data area and are exactly what the writer-stall measures
+            self.frame_hw_bytes = len(blob)
+            self._t_hw.set(float(len(blob)))
+            self._t_occ.set(min(100.0, 100.0 * len(blob) / self.cap))
         crc = (zlib.crc32(blob) & 0xFFFFFFFF) if self.payload_crc else 0
         plan = self._chunks(blob)
         blob_view = memoryview(blob)
@@ -297,6 +316,7 @@ class ShmWire:
         t0 = time.perf_counter()
         last_probe = t0
         spins = 0
+        wstall_s = 0.0          # writer blocked on reader acks (local)
         while True:
             progressed = False
             # -- write side: publish the next chunk once every reader
@@ -390,6 +410,14 @@ class ShmWire:
             spins += 1
             if spins > _HOT_SPINS:
                 time.sleep(_SLEEP_S)
+                if wi < len(plan):
+                    # chunks left to publish and every sleep here means
+                    # a reader has not acked the previous one: ring
+                    # BACKPRESSURE (the watchdog's shm_backpressure
+                    # rule reads the counter's slope). Reader-side
+                    # waits (wi done, peers not published) stay out —
+                    # they are the PEER's problem, named by critpath.
+                    wstall_s += _SLEEP_S
                 now = time.perf_counter()
                 if now - last_probe > _PROBE_PERIOD_S:
                     last_probe = now
@@ -406,6 +434,9 @@ class ShmWire:
         self._wseq[channel] += len(plan)
         self._t_rounds.inc()
         self._t_bytes.inc(len(blob))
+        if wstall_s > 0.0:
+            self.writer_stall_s += wstall_s
+            self._t_wstall.inc(wstall_s)
         out: List[bytes] = []
         for r in range(self.nprocs):
             out.append(blob if r == self.rank
@@ -418,4 +449,16 @@ class ShmWire:
         return {"token": self.token, "rank": self.rank,
                 "nprocs": self.nprocs, "channels": self.channels,
                 "cap_bytes": self.cap,
-                "rounds": [int(r) for r in self._round]}
+                "rounds": [int(r) for r in self._round],
+                "writer_stall_s": round(self.writer_stall_s, 6),
+                "frame_hw_bytes": self.frame_hw_bytes}
+
+    def mem_bytes(self) -> dict:
+        """Ledger probe (telemetry/accounting.py): this process's shm
+        footprint — the segments it OWNS (created, counted once
+        process-wide) vs the peer segments it merely maps (shared
+        pages, owned elsewhere), plus the frame high-watermark the
+        occupancy gauge tracks."""
+        return {"segment_bytes": len(self._own) * self._size,
+                "peer_mapped_bytes": len(self._peer) * self._size,
+                "frame_hw_bytes": self.frame_hw_bytes}

@@ -114,6 +114,16 @@ class SnapshotStore:
         with self._lock:
             return list(self._versions)
 
+    def retained_bytes(self) -> Dict[int, int]:
+        """{version: snapshot bytes} for every LIVE version — the
+        accounting ledger's retention probe (round 13). Snapshots are
+        immutable after install, so ``nbytes()`` is pure size
+        arithmetic here (host copies report their buffers, device
+        residences their logical array bytes)."""
+        with self._lock:
+            snaps = list(self._versions.items())
+        return {v: int(s.nbytes()) for v, s in snaps}
+
     def pin(self, version: int) -> int:
         """Hold ``version`` live past retention (counted — pins nest).
         Returns the version. KeyError when it is not live any more."""

@@ -720,6 +720,24 @@ class Server(Actor):
         self._t_binding = tmetrics.gauge("engine.binding_phase")
         self._t_binding.set(-1.0)
         self.last_binding_phase = ""
+        #: round 13 — watchdog plane saturation surfaces. apply_busy_s
+        #: accumulates this STREAM's total apply seconds as a plain
+        #: float (one add per window — the watchdog/ops refresh mirrors
+        #: it into the engine.shard<k>.* gauges off the hot path; a
+        #: per-window gauge.set would bill its lock against the 2%
+        #: blocking-round budget). xw_busy_s accumulates seconds
+        #: blocked inside the window-exchange collective the same way.
+        #: Both are UNCONDITIONAL — the watchdog's straggler rule reads
+        #: them so it keeps working with ``-mv_phase_stamps=0`` or the
+        #: flight recorder off. The per-stream binding gauge is
+        #: resolved lazily: sub-shards learn their stream id AFTER
+        #: construction.
+        self.apply_busy_s = 0.0
+        self.xw_busy_s = 0.0
+        self._t_binding_st = None
+        self._t_pool_jobs = tmetrics.counter("engine.apply_pool.jobs")
+        self._t_pool_inline = tmetrics.counter(
+            "engine.apply_pool.inline_jobs")
         #: single-process window counter for the 1-in-N full-stamp
         #: sampling + the current window's stamp decision (read by
         #: _local_window for the per-table attribution gating)
@@ -809,6 +827,9 @@ class Server(Actor):
             "mailbox_depth": self.mailbox.Size(),
             "window_epoch": self.window_epoch,
             "window_exchanges": self.mh_window_exchanges,
+            "apply_busy_s": round(self.apply_busy_s, 6),
+            "xw_busy_s": round(self.xw_busy_s, 6),
+            "window_verbs": self.mh_window_verbs,
             "stage": None if st is None else {
                 "depth": st.depth(),
                 "pending_verbs": st.pending_verbs(),
@@ -867,6 +888,18 @@ class Server(Actor):
         recorder's listener-cached capacity + -mv_phase_stamps)."""
         return _phase_stamps_flag() and tflight.enabled()
 
+    def _binding_stream_gauge(self):
+        """The PER-STREAM binding-phase gauge (round 13 — the global
+        ``engine.binding_phase`` is one name, so N shard streams would
+        overwrite each other's verdicts). Lazy: a sub-shard's stream id
+        is assigned after construction. Only touched when the binding
+        phase CHANGES, so the lookup amortizes to nothing."""
+        g = self._t_binding_st
+        if g is None:
+            g = self._t_binding_st = tmetrics.gauge(
+                f"engine.stream{self.mh_stream}.binding_phase")
+        return g
+
     def _ph_emit(self, ph: dict, nverbs: int) -> None:
         """Emit one window's phase record: the ``window.phases`` flight
         event (keyed by (mepoch, SEQ); durations in integer
@@ -896,6 +929,8 @@ class Server(Actor):
                     self.last_binding_phase = "apply"
                     self._t_binding.set(
                         float(ENGINE_PHASES.index("apply")))
+                    self._binding_stream_gauge().set(
+                        float(ENGINE_PHASES.index("apply")))
             tflight.record("window.phases", seq=ph.get("seq", -1),
                            epoch=self.window_epoch,
                            mepoch=ph.get("mepoch", 0),
@@ -918,6 +953,8 @@ class Server(Actor):
         if binding and binding != self.last_binding_phase:
             self.last_binding_phase = binding
             self._t_binding.set(float(ENGINE_PHASES.index(binding)))
+            self._binding_stream_gauge().set(
+                float(ENGINE_PHASES.index(binding)))
         parts = [f"v={nverbs}"]
         for tag, key in (("f", "form"), ("p", "pack"), ("e", "encode"),
                          ("x", "exchange"), ("xw", "exchange_wait"),
@@ -1193,6 +1230,10 @@ class Server(Actor):
                        detail=f"{len(batch)}v")
         _win_s = _time.perf_counter() - _t0
         self._t_window_s.observe(_win_s)
+        # a single-process window's whole body IS apply — the per-shard
+        # load number the watchdog's imbalance rule compares (one plain
+        # float add: within the blocking-round overhead budget)
+        self.apply_busy_s += _win_s
         if phases:
             # single-process window: the whole body is apply (there is
             # no exchange); seq stays -1 so these never enter the
@@ -1557,6 +1598,7 @@ class Server(Actor):
         finally:
             now = _time.perf_counter()
             self._apply_since = 0.0
+            self.apply_busy_s += now - t0
             st = self._ex_stage
             b0 = st.busy_since if st is not None else 0.0
             if b0:
@@ -1756,9 +1798,12 @@ class Server(Actor):
                         blob, self._mh_caps, (local[0][0], local[0][1]),
                         channel=self.mh_channel),
                     "window exchange")
+            xs = multihost.last_exchange_stats()
+            # plain-attr accumulation (one float add, no stamps needed):
+            # the watchdog straggler rule's collective-wait input
+            self.xw_busy_s += xs.get("coll_s", 0.0)
             if ph is not None:
                 ph["x"] = ph.get("x", 0.0) + _time.perf_counter() - _tx
-                xs = multihost.last_exchange_stats()
                 ph["xw"] = ph.get("xw", 0.0) + xs["coll_s"]
                 # rendezvous anchor: every rank leaves this allgather
                 # at ~the same instant (critpath's clock-offset source)
@@ -1922,8 +1967,10 @@ class Server(Actor):
             ph["seq"] = seq
             ph["mepoch"] = multihost.membership_epoch()
             ph["a_start_m"] = _time.perf_counter()
+        _ta = _time.perf_counter()
         self._mh_apply_window(used[:prefix], windows, prefix, descs[0],
                               seq=seq)
+        self.apply_busy_s += _time.perf_counter() - _ta
         self.window_epoch += 1
         if ph is not None:
             ph["apply"] = _time.perf_counter() - ph["a_start_m"]
@@ -2068,6 +2115,10 @@ class Server(Actor):
             j, parts_at, verbs, my_rank,
             {} if tbl is not None else None))
             for j in job_lists[:-1]]
+        # pool-utilization accounting (watchdog plane): jobs handed to
+        # the worker pool vs the one job that always runs inline here
+        self._t_pool_jobs.inc(len(boxes))
+        self._t_pool_inline.inc()
         results = [self._mh_run_ops(job_lists[-1], parts_at, verbs,
                                     my_rank,
                                     {} if tbl is not None else None)]

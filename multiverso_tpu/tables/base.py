@@ -67,6 +67,19 @@ _get_staleness_flag = cached_int_flag("mv_get_staleness", 0)
 _GET_CACHE_ENTRIES = 64
 
 
+def _result_nbytes(result) -> int:
+    """Host bytes a fetched result pins (accounting ledger): arrays by
+    ``nbytes``, one container level deep — the shapes copy_result
+    handles. Non-array scalars count as zero (noise)."""
+    if isinstance(result, np.ndarray):
+        return int(result.nbytes)
+    if isinstance(result, (tuple, list)):
+        return sum(_result_nbytes(r) for r in result)
+    if isinstance(result, dict):
+        return sum(_result_nbytes(r) for r in result.values())
+    return 0
+
+
 @dataclass
 class TableOption:
     """Base table creation record (reference CreateTableOption structs)."""
@@ -263,6 +276,41 @@ class ServerTable:
         """A serving.snapshot.TableSnapshot of this table's state at the
         current stream position, or None (family not servable)."""
         return None
+
+    # -- memory-accounting ledger (round 13; telemetry/accounting.py).
+    # The watchdog plane's byte ledger asks every live table where its
+    # state actually LIVES — the measurement substrate the ROADMAP's
+    # tiered giant-table work (host-RAM rows + device hot-row cache)
+    # will decide hot sets against. CONTRACT: the probe is called from
+    # a sampling thread (the watchdog tick / an ops scrape), so it must
+    # be pure shape/size arithmetic — never a device sync, a host
+    # mirror creation, or a copy. Keys:
+    #
+    # * ``device_bytes``      — device-resident authoritative state
+    #   (the jax store). On jax the number is the LOGICAL array size
+    #   (``.nbytes`` — shape math, no sync); on a multi-device process
+    #   the per-device share is that divided by the mesh's local device
+    #   count — a documented bound, not a measured allocation.
+    # * ``host_mirror_bytes`` — replicated host mirrors (the native f32
+    #   store, numpy kv mirrors). Exact: these are real host buffers.
+    # * ``host_bytes``        — host-authoritative state (host-backed
+    #   values, freshness bitmaps, index structures). Exact.
+
+    def ledger_bytes(self) -> Dict[str, int]:
+        """Byte placement of this table's live state (see above).
+        Default: the generic ``state`` pytree's leaf bytes count as
+        device residence; families with mirrors/host planes override.
+        ``vars()`` deliberately bypasses properties — a family whose
+        ``state`` getter syncs mirrors (matrix) must never be synced by
+        a sampling probe; such families override this method."""
+        out = {"device_bytes": 0, "host_mirror_bytes": 0, "host_bytes": 0}
+        state = vars(self).get("state")
+        if isinstance(state, dict):
+            import jax
+            out["device_bytes"] = int(sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree.leaves(state)))
+        return out
 
     # Serializable (checkpoint) contract
     def Store(self, stream) -> None:
@@ -663,6 +711,21 @@ class WorkerTable:
                     return hid, None
                 del self._gc_cache[key]   # expired: drop, refill below
         return None, key
+
+    def worker_ledger_bytes(self) -> Dict[str, int]:
+        """Worker-half buffered bytes for the accounting ledger (round
+        13): the combined-write buffer awaiting its one mailbox hop and
+        the staleness-bounded Get cache's parked result copies. Exact
+        host bytes, one short lock — called from the watchdog sampling
+        thread, never from a verb path."""
+        with self._lock:
+            wc = sum(payload_nbytes(p) for p in self._wc_buf)
+            gc = sum(_result_nbytes(ent[2])
+                     for ent in self._gc_cache.values())
+            gc += sum(_result_nbytes(r)
+                      for r in self._gc_results.values())
+        return {"write_combine_bytes": int(wc),
+                "get_cache_bytes": int(gc)}
 
     def _gc_store(self, key, result, fill_epoch: int,
                   fill_wep: int) -> None:

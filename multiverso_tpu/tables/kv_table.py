@@ -33,6 +33,7 @@ from multiverso_tpu.parallel.mesh import (local_device_count, next_bucket,
                                           pad_to_multiple, parts_bucket,
                                           place_parts)
 from multiverso_tpu.tables.base import ServerTable, TableOption, WorkerTable
+from multiverso_tpu.telemetry import sketch as tsketch
 from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.log import CHECK
 
@@ -71,6 +72,14 @@ class KVServerTable(ServerTable):
         # of new keys never triggers whole-index rebuilds
         self._nat_index = None        # created lazily on first index use
         self._nat_index_tried = False  # (KvIndex.create may build the .so)
+        # round 13 — key-access skew sketch (-mv_row_sketch extended
+        # from the matrix family: the ROADMAP hot-row-cache groundwork
+        # wants skew on BOTH families). Lazy SpaceSaving via
+        # telemetry/sketch.note_table_access; off = one cached int read
+        # per Get. The /perf row_skew list and Dashboard [RowSkew] line
+        # pick these up through the same _row_sketch attribute.
+        self._row_sketch = None
+        self._row_sketch_notes = 0
         self._sorted_keys = np.empty(0, np.int64)
         self._sorted_slots = np.empty(0, np.int32)
         self._pending: Dict[int, int] = {}
@@ -386,8 +395,9 @@ class KVServerTable(ServerTable):
             return None
         keys = np.asarray(keys, np.int64).ravel()
         if self._host_backed or self._np_values() is not None:
-            out = self.ProcessGet(keys, option)
+            out = self.ProcessGet(keys, option)   # notes the sketch
             return lambda: out
+        tsketch.note_table_access(self, keys, "kv")
         slots = self._slots_for(keys, create=False)
         padded = self._pad_slots(slots)
         vals = self._gather(self._values, jnp.asarray(padded))
@@ -401,6 +411,32 @@ class KVServerTable(ServerTable):
             out[slots < 0] = 0  # absent keys read as 0
             return out
         return _finalize
+
+    def ledger_bytes(self):
+        """Accounting-ledger probe (tables/base.py contract): values
+        placement + the key-index control plane. Shape math only — the
+        mirror is read as the RAW attribute (``_np_values()`` would
+        CREATE it with a device fetch, which a sampling thread must
+        never trigger)."""
+        out = {"device_bytes": 0, "host_mirror_bytes": 0, "host_bytes": 0}
+        vals = self._values_arr
+        if self._host_backed:
+            out["host_bytes"] += int(getattr(vals, "nbytes", 0))
+        else:
+            out["device_bytes"] += int(getattr(vals, "nbytes", 0))
+            if self._values_np is not None:
+                out["host_mirror_bytes"] += int(self._values_np.nbytes)
+        # control plane: the native index's ALLOCATED probing-table
+        # slots (capacity >= size — the linear-probing load-factor
+        # headroom is real allocation the tiering policy must see) or
+        # the python sorted-array lookup
+        nat = self._nat_index
+        if nat is not None:
+            out["host_bytes"] += 12 * nat.capacity()  # i64 key + i32 slot
+        else:
+            out["host_bytes"] += int(self._sorted_keys.nbytes
+                                     + self._sorted_slots.nbytes)
+        return out
 
     def mh_prepare_local_apply(self) -> None:
         """Sharded-engine pre-warm (tables/base.py contract): force the
@@ -446,6 +482,10 @@ class KVServerTable(ServerTable):
         set of this collective Get (the windowed engine's parts hooks)
         passes the precomputed union so no key collective runs here."""
         keys = np.asarray(keys, np.int64).ravel()
+        # key-access skew (-mv_row_sketch): THIS rank's requested keys
+        # — ProcessGetParts and the eager ProcessGetAsync branch both
+        # funnel through here, so each logical Get notes once
+        tsketch.note_table_access(self, keys, "kv")
         npv = self._np_values()
         if npv is not None and multihost.world_size() > 1:
             # replicated mirror: serve locally — no union round, no
@@ -522,6 +562,7 @@ class KVServerTable(ServerTable):
             out = []
             for parts in positions:
                 keys = np.asarray(parts[my_rank]["keys"], np.int64).ravel()
+                tsketch.note_table_access(self, keys, "kv")
                 slots = self._slots_for(keys, create=False)
                 vals = npv[np.where(slots < 0, 0, slots)]
                 vals[slots < 0] = 0
@@ -529,6 +570,10 @@ class KVServerTable(ServerTable):
             return out
         pos_keys = [[np.asarray(p["keys"], np.int64).ravel() for p in parts]
                     for parts in positions]
+        for rank_keys in pos_keys:
+            # skew counts THIS rank's requested keys per position (the
+            # union gather serves them all in one dispatch below)
+            tsketch.note_table_access(self, rank_keys[my_rank], "kv")
         union = np.unique(np.concatenate(
             [k for rank_keys in pos_keys for k in rank_keys]))
         union_slots = self._slots_for(union, create=False)

@@ -581,6 +581,25 @@ class MatrixServerTable(ServerTable):
         if self._native_host_ok:
             self._host_store()
 
+    def ledger_bytes(self):
+        """Accounting-ledger probe (tables/base.py contract): shape
+        arithmetic only — ``_state`` is read directly (the ``state``
+        property syncs a dirty mirror back to the device, which a
+        sampling thread must never trigger), and the native mirror's
+        footprint is its logical rows*cols floats."""
+        import jax
+        out = {"device_bytes": 0, "host_mirror_bytes": 0,
+               "host_bytes": 0}
+        st = self._state
+        if isinstance(st, dict):
+            out["device_bytes"] = int(sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree.leaves(st)))
+        nat = self._nat_store
+        if nat is not None:
+            out["host_mirror_bytes"] = int(nat.rows) * int(nat.cols) * 4
+        return out
+
     def mh_apply_is_local(self) -> bool:
         """Pipelined-engine overlap gate (tables/base.py contract): with
         the replicated native mirror LIVE, every exchanged-parts apply
@@ -1215,24 +1234,13 @@ class MatrixServerTable(ServerTable):
 
     def _note_row_access(self, ids) -> None:
         """Feed one Get's row ids to the ``-mv_row_sketch`` access-skew
-        sketch (telemetry/sketch.py; the off path is ONE cached int
-        read). Engine-thread updates; the /metrics top-share gauge
-        refreshes every 32 notes, not per Get."""
-        cap = tsketch.row_sketch_capacity()
-        if cap <= 0:
-            return
-        sk = self._row_sketch
-        if sk is None:
-            sk = self._row_sketch = tsketch.SpaceSaving(cap)
-        sk.update_ids(ids)
-        self._row_sketch_notes += 1
-        if self._row_sketch_notes & 31 == 1:
-            from multiverso_tpu.telemetry import metrics as tmetrics
-            fam = ("sparse" if "sparse" in type(self).__name__.lower()
-                   else "matrix")
-            tmetrics.gauge(
-                f"table.{fam}{getattr(self, 'table_id', 0)}"
-                f".row_skew_top_share").set(sk.top_share())
+        sketch (telemetry/sketch.py note_table_access — the one hook
+        shared with the KV family since round 13; the off path is ONE
+        cached int read). Engine-thread updates; the /metrics
+        top-share gauge refreshes every 32 notes, not per Get."""
+        fam = ("sparse" if "sparse" in type(self).__name__.lower()
+               else "matrix")
+        tsketch.note_table_access(self, ids, fam)
 
     def ProcessGetWindowParts(self, positions, my_rank: int):
         """Cross-rank get-dedup: serve a window segment's Gets from ONE

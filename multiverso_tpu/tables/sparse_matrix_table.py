@@ -79,6 +79,13 @@ class SparseMatrixServerTable(MatrixServerTable):
         self.up_to_date = np.ones((self._procs * zoo.num_workers, num_rows),
                                   dtype=bool)
 
+    def ledger_bytes(self):
+        """Matrix placement plus the per-(worker, row) freshness bitmap
+        — host-authoritative state the dense family doesn't carry."""
+        out = super().ledger_bytes()
+        out["host_bytes"] += int(self.up_to_date.nbytes)
+        return out
+
     def _gwid(self, rank: int, worker_id: int) -> Optional[int]:
         """Global worker id, or None for out-of-range/-1 ids — a
         system-level push with no owning worker (reference UpdateAddState

@@ -37,11 +37,25 @@ The ops plane (round 9) adds three more:
   ``/flight`` (recent events). Local snapshots only — the handler
   never issues collectives.
 
+The watchdog plane (round 13) adds two more:
+
+* ``accounting`` — the process memory/capacity ledger: pull-probed
+  ``mem.*`` byte gauges (per-table device/mirror/host placement,
+  snapshot retention, flight/dedup/buffer footprints, shm rings) and
+  the ``/memory`` ops endpoint.
+* ``watchdog`` — ``-mv_watchdog_s`` typed online alert rules with
+  fire/clear hysteresis over LOCAL instruments only (shard imbalance,
+  shm backpressure, apply-pool saturation, mailbox/memory growth,
+  snapshot staleness, the straggler proxy), surfaced at ``/alerts``,
+  in ``alert.<rule>`` counters + flight events, and as the /healthz
+  ``warn`` status.
+
 Importing this package registers every telemetry flag (``-telemetry``,
 ``-trace``, ``-stats_interval_s``, ``-mv_flight_events``,
-``-mv_diag_dir``, ``-mv_ops_port``) so ``MV_Init`` argv parsing claims
-them.
+``-mv_diag_dir``, ``-mv_ops_port``, ``-mv_watchdog_s``) so ``MV_Init``
+argv parsing claims them.
 """
 
 from multiverso_tpu.telemetry import (export, flight,  # noqa: F401
                                       metrics, ops, trace)
+from multiverso_tpu.telemetry import accounting, watchdog  # noqa: F401,E402

@@ -36,6 +36,32 @@ from typing import Dict, List, Optional, Tuple
 Pos = Tuple[int, int, int]
 
 
+def expand_paths(paths: List[str]) -> List[str]:
+    """CLI argument expansion shared by the forensics and critpath
+    mains (round 13): a DIRECTORY argument globs its own
+    ``flight_rank*.jsonl`` dumps — the exact layout ``-mv_diag_dir``
+    writes — so ``python -m ...forensics <diag_dir>`` works without
+    hand-listing every rank. File arguments pass through untouched; a
+    directory holding no dumps raises loudly (a typo'd path must not
+    silently correlate the remaining ranks)."""
+    import glob
+    import os
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p,
+                                                  "flight_rank*.jsonl")))
+            if not found:
+                raise FileNotFoundError(
+                    f"directory {p!r} holds no flight_rank*.jsonl "
+                    f"dumps (is it the -mv_diag_dir of a run that "
+                    f"dumped?)")
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
 def load(path: str) -> dict:
     """Read one flight JSONL dump -> ``{"rank": r, "header": {...},
     "events": [...], "path": path}`` (events oldest first)."""

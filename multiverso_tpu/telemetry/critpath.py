@@ -49,7 +49,11 @@ by apply seconds.
 CLI::
 
     python -m multiverso_tpu.telemetry.critpath diag/flight_rank*.jsonl
+    python -m multiverso_tpu.telemetry.critpath diag/
     python -m multiverso_tpu.telemetry.critpath --trace merged.json ...
+
+(a directory argument globs its own ``flight_rank*.jsonl`` — the
+layout ``-mv_diag_dir`` writes).
 
 ``--trace`` writes the merged cross-rank timeline as Chrome trace
 JSON (one track per rank x stage, the PR 2 writer's schema) for
@@ -468,7 +472,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "points, and report each window's binding rank + "
                     "phase (per engine shard stream)")
     parser.add_argument("paths", nargs="+",
-                        help="per-rank flight_rank<R>.jsonl dumps")
+                        help="per-rank flight_rank<R>.jsonl dumps, or "
+                             "a directory (e.g. the -mv_diag_dir) "
+                             "whose flight_rank*.jsonl are globbed")
     parser.add_argument("--trace", default="",
                         help="also write the merged timeline as Chrome "
                              "trace JSON (Perfetto) to this path")
@@ -476,14 +482,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="emit the full report as JSON instead of "
                              "the text rendering")
     args = parser.parse_args(argv)
-    report = correlate(args.paths)
+    paths = align.expand_paths(args.paths)
+    report = correlate(paths)
     if args.json:
         Log.Info("%s", json.dumps(report, indent=1, sort_keys=True))
     else:
         Log.Info("%s", report_text(report))
     if args.trace:
         with open(args.trace, "w") as f:
-            json.dump(to_chrome_trace(args.paths, report), f)
+            json.dump(to_chrome_trace(paths, report), f)
         Log.Info("critpath: wrote merged timeline to %s", args.trace)
     return 0 if report.get("degraded") is None else 2
 

@@ -104,6 +104,18 @@ class FlightRecorder:
         with self._lock:
             return self._recorded, self._recorded - len(self._ring)
 
+    def approx_bytes(self, per_event_overhead: int) -> Tuple[int, int]:
+        """(event_count, byte_estimate) for the accounting ledger:
+        ``events * overhead + total detail chars``, summed from the RAW
+        ring tuples — the ledger probes this every watchdog tick, so it
+        must not materialize len(ring) dicts per tick the way
+        :meth:`events` does. One snapshot-copy under the lock (same as
+        every other reader), then plain arithmetic."""
+        with self._lock:
+            raw = list(self._ring)
+        return (len(raw),
+                sum(per_event_overhead + len(ev[5]) for ev in raw))
+
     def last_detail(self, kind: str) -> Optional[str]:
         """detail of the most recent event of ``kind`` (dashboard [Ops]
         line probe), or None."""

@@ -44,9 +44,11 @@ divergence while a real divergence *within* one stream still does.
 CLI::
 
     python -m multiverso_tpu.telemetry.forensics diag/flight_rank*.jsonl
+    python -m multiverso_tpu.telemetry.forensics diag/
 
-prints the report and exits 1 when a divergence was found (0 when the
-streams agree — useful in drills).
+(a directory argument globs its own ``flight_rank*.jsonl`` — the
+layout ``-mv_diag_dir`` writes) prints the report and exits 1 when a
+divergence was found (0 when the streams agree — useful in drills).
 """
 
 from __future__ import annotations
@@ -173,9 +175,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="align per-rank flight-recorder dumps by exchange "
                     "SEQ and report the first diverging stream position")
     parser.add_argument("paths", nargs="+",
-                        help="per-rank flight_rank<R>.jsonl dumps")
+                        help="per-rank flight_rank<R>.jsonl dumps, or "
+                             "a directory (e.g. the -mv_diag_dir) "
+                             "whose flight_rank*.jsonl are globbed")
     args = parser.parse_args(argv)
-    report = correlate(args.paths)
+    report = correlate(align.expand_paths(args.paths))
     Log.Info("%s", report_text(report))
     return 1 if report["diverged"] else 0
 

@@ -1,5 +1,5 @@
 """Ops endpoint: a flag-gated stdlib-HTTP daemon serving /metrics,
-/healthz and /flight.
+/healthz, /flight, /perf, /alerts and /memory.
 
 ``-mv_ops_port=N`` (default -1 = off; 0 = ephemeral, for tests and
 multi-world processes) starts one daemon thread at MV_Init running a
@@ -19,6 +19,15 @@ multi-world processes) starts one daemon thread at MV_Init running a
   binding-phase proxy and the ``-mv_row_sketch`` row-skew summaries.
   The cross-rank binding verdict needs every rank's dump through
   ``python -m multiverso_tpu.telemetry.critpath`` — the body says so.
+* ``GET /alerts`` — the live watchdog plane's state (round 13,
+  telemetry/watchdog.py): active typed alerts with durations + every
+  rule's hysteresis counters; says "off" while ``-mv_watchdog_s`` is
+  unarmed. Active alerts also degrade ``/healthz`` to a distinct
+  ``warn`` status — still 200 (503 stays death-only).
+* ``GET /memory`` — the process byte ledger (round 13,
+  telemetry/accounting.py): per-table device/mirror/host placement,
+  per-version snapshot retention, flight/dedup/buffer estimates, shm
+  ring footprint — refreshed at request time.
 
 THE HANDLER NEVER ISSUES COLLECTIVES — same rule as the PR 2 periodic
 reporter: a scrape thread running allgathers would interleave with the
@@ -197,6 +206,17 @@ def health_report() -> dict:
     rec, drop = flight.stats()
     out["flight"] = {"recorded": rec, "dropped": drop,
                      "enabled": flight.enabled()}
+    # round 13 — watchdog plane: active typed alerts degrade the
+    # status to a DISTINCT "warn" (still 200 — 503 stays death-only;
+    # an alert is a saturation symptom, not a corpse)
+    try:
+        from multiverso_tpu.telemetry import watchdog as twatchdog
+        alerts = twatchdog.active_alerts()
+        out["alerts"] = [a["rule"] for a in alerts]
+        out["status"] = ("dead" if not out["healthy"]
+                         else ("warn" if alerts else "ok"))
+    except Exception:           # watchdog torn down mid-scrape
+        out["status"] = "dead" if not out["healthy"] else "ok"
     return out
 
 
@@ -266,6 +286,23 @@ class _OpsHandler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         try:
             if path == "/metrics":
+                # mirror the hot paths' plain tallies into their gauges
+                # before rendering (local probes only, never
+                # collective); a scrape must see current saturation AND
+                # ledger numbers even when no watchdog ticks between
+                # scrapes (the watchdog is OFF by default — without
+                # this the mem.* family would scrape frozen at zero)
+                try:
+                    from multiverso_tpu.telemetry import \
+                        watchdog as twatchdog
+                    twatchdog.refresh_saturation_gauges()
+                except Exception:
+                    pass
+                try:
+                    from multiverso_tpu.telemetry import accounting
+                    accounting.refresh()
+                except Exception:
+                    pass
                 self._send(200, render_prometheus(metrics.snapshot()),
                            "text/plain; version=0.0.4")
             elif path == "/healthz":
@@ -283,9 +320,21 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(perf_report(), indent=1,
                                            sort_keys=True),
                            "application/json")
+            elif path == "/alerts":
+                from multiverso_tpu.telemetry import \
+                    watchdog as twatchdog
+                self._send(200, json.dumps(twatchdog.alerts_report(),
+                                           indent=1, sort_keys=True),
+                           "application/json")
+            elif path == "/memory":
+                from multiverso_tpu.telemetry import accounting
+                self._send(200, json.dumps(accounting.memory_report(),
+                                           indent=1, sort_keys=True),
+                           "application/json")
             else:
                 self._send(404, "unknown path (know /metrics /healthz "
-                                "/flight /perf)\n", "text/plain")
+                                "/flight /perf /alerts /memory)\n",
+                           "text/plain")
         except Exception as exc:    # never kill the handler thread
             try:
                 self._send(500, f"ops handler failed: {exc!r}\n",
@@ -309,7 +358,8 @@ class OpsServer:
     def start(self) -> None:
         self._thread.start()
         Log.Info("ops endpoint serving on 127.0.0.1:%d "
-                 "(/metrics /healthz /flight)", self.port)
+                 "(/metrics /healthz /flight /perf /alerts /memory)",
+                 self.port)
 
     def stop(self, join_s: float = 5.0) -> None:
         """Shut down + join BOUNDED (Zoo.Stop must never hang on a

@@ -26,13 +26,15 @@ from typing import List, Tuple
 from multiverso_tpu.utils.configure import MV_DEFINE_int, cached_int_flag
 
 MV_DEFINE_int("mv_row_sketch", 0,
-              "per-row access-skew sketch on MatrixTable Gets: track "
-              "the top-N hottest rows per table in a bounded "
-              "Space-Saving sketch (0 = off, no per-Get cost beyond "
-              "one cached flag read). Surfaced in /metrics "
+              "per-row access-skew sketch on MatrixTable row Gets AND "
+              "KVTable key Gets (round 13): track the top-N hottest "
+              "rows/keys per table in a bounded Space-Saving sketch "
+              "(0 = off, no per-Get cost beyond one cached flag "
+              "read). Surfaced in /metrics "
               "(table.<family><id>.row_skew_top_share), the Dashboard "
               "[RowSkew] line and /perf — the measurement groundwork "
-              "for the ROADMAP's hot-row cache.")
+              "for the ROADMAP's hot-row cache, which needs skew on "
+              "both families.")
 
 #: the -mv_row_sketch gate, listener-cached (consulted per Get)
 row_sketch_capacity = cached_int_flag("mv_row_sketch", 0)
@@ -146,3 +148,26 @@ class SpaceSaving:
                 "top": [{"key": int(k), "count": int(c),
                          "overcount_max": int(e)}
                         for k, c, e in self.top(n)]}
+
+
+def note_table_access(table, ids, fam: str) -> None:
+    """The ONE per-Get sketch hook both table families ride (round 13
+    extended the matrix-only round-11 hook to KVTable key Gets): feed
+    one Get's id/key array to ``table._row_sketch``, creating it
+    lazily when ``-mv_row_sketch`` arms. The off path is one cached
+    int read; the /metrics top-share gauge refreshes every 32 notes,
+    not per Get. ``table`` must carry ``_row_sketch`` /
+    ``_row_sketch_notes`` slots (both families initialize them)."""
+    cap = row_sketch_capacity()
+    if cap <= 0:
+        return
+    sk = table._row_sketch
+    if sk is None:
+        sk = table._row_sketch = SpaceSaving(cap)
+    sk.update_ids(ids)
+    table._row_sketch_notes += 1
+    if table._row_sketch_notes & 31 == 1:
+        from multiverso_tpu.telemetry import metrics as tmetrics
+        tmetrics.gauge(
+            f"table.{fam}{getattr(table, 'table_id', 0)}"
+            f".row_skew_top_share").set(sk.top_share())

@@ -239,6 +239,32 @@ class Dashboard:
                         f"[RowSkew] table {tid}: top_share = "
                         f"{100 * s['top_share']:.1f}% of "
                         f"{s['total']} gets, hottest = [{top}]")
+            # round 13 — watchdog plane: the byte ledger's placement
+            # line (where table/snapshot/buffer state actually lives)
+            # plus the live alert verdicts when the watchdog is armed
+            try:
+                from multiverso_tpu.telemetry import accounting
+                rep = accounting.memory_report()
+                t = rep["components"]["tables"]["totals"]
+                lines.append(
+                    f"[Mem] total = {rep['total_bytes'] / 1e6:.1f} MB "
+                    f"(tables device {t['device_bytes'] / 1e6:.1f} / "
+                    f"mirror {t['host_mirror_bytes'] / 1e6:.1f} / "
+                    f"host {t['host_bytes'] / 1e6:.1f}, snapshots "
+                    f"{rep['components']['snapshots']['bytes'] / 1e6:.1f})")
+            except Exception:   # ledger probing a torn-down world
+                pass
+            try:
+                from multiverso_tpu.telemetry import watchdog
+                wd = watchdog.peek()
+                if wd is not None:
+                    alerts = wd.active_alerts()
+                    names = (", ".join(a["rule"] for a in alerts)
+                             or "none")
+                    lines.append(f"[Watchdog] ticks = {wd.ticks}, "
+                                 f"active_alerts = {names}")
+            except Exception:
+                pass
             from multiverso_tpu import elastic
             el = elastic.state_report()
             if el is not None:

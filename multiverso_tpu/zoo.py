@@ -130,6 +130,14 @@ class Zoo:
         start_reporter()        # -stats_interval_s periodic reports
         from multiverso_tpu.telemetry.ops import start_ops
         start_ops()             # -mv_ops_port /metrics·/healthz·/flight
+        # watchdog plane (round 13): the byte ledger's mem.* gauges
+        # register eagerly every world; the typed-rule tick thread only
+        # arms when -mv_watchdog_s > 0 (off by default, like the
+        # reporter). Both are LOCAL-only — no collectives ever.
+        from multiverso_tpu.telemetry.accounting import start_ledger
+        start_ledger()
+        from multiverso_tpu.telemetry.watchdog import start_watchdog
+        start_watchdog()
         # elastic membership plane LAST (needs the engine up): rank 0
         # hosts the coordinator, every rank registers + heartbeats
         elastic.start_plane(self)
@@ -153,6 +161,13 @@ class Zoo:
         stop_reporter()
         from multiverso_tpu.telemetry.ops import stop_ops
         stop_ops()
+        # watchdog down with the other samplers and BOUNDED (its join
+        # rides failsafe.deadline.bounded): a tick thread probing the
+        # engine must not outlive it
+        from multiverso_tpu.telemetry.watchdog import stop_watchdog
+        stop_watchdog()
+        from multiverso_tpu.telemetry.accounting import stop_ledger
+        stop_ledger()
         if self.server_engine is not None:
             try:
                 self.FinishTrain()

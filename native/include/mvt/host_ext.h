@@ -19,10 +19,17 @@ void MV_HostStoreAddRows(void* h, const int32_t* ids, int64_t n,
                          const float* deltas);
 void MV_HostStoreGetRows(void* h, const int32_t* ids, int64_t n,
                          float* out);
+// out[4] = {parallel_runs, inline_busy, inline_small, pool_threads};
+// inline_busy = pool had no usable capacity (owned by another shard,
+// or single-threaded), inline_small = under the parallel byte floor
+void MV_HostStorePoolStats(int64_t* out);
 
 void* MV_KvIndexNew(int64_t cap_hint);
 void MV_KvIndexFree(void* h);
 int64_t MV_KvIndexSize(void* h);
+// allocated probing-table slots (>= size; power of two) — the ledger's
+// true-allocation probe: each slot holds an i64 key + i32 slot id
+int64_t MV_KvIndexCapacity(void* h);
 void MV_KvIndexLookup(void* h, const int64_t* keys, int64_t n,
                       int32_t* out);
 void MV_KvIndexInsert(void* h, const int64_t* keys, int64_t n,

@@ -133,6 +133,18 @@ Pool& GlobalPool() {
 // costs more than it buys — run inline on the calling thread
 constexpr int64_t kParallelBytes = 1 << 18;
 
+// pool-dispatch accounting (round 13 watchdog plane): which path each
+// apply actually took. The inline-busy fallback was invisible — a
+// world whose shards constantly found the pool busy looked identical
+// to one riding it — so the saturation telemetry reads these through
+// MV_HostStorePoolStats. Relaxed atomics: the numbers are monotonic
+// tallies consumed by a sampling watchdog, not synchronization.
+std::atomic<int64_t> g_pool_parallel{0};   // ran on the worker pool
+// pool had no usable capacity -> caller ran inline: another shard owns
+// it, or the pool is single-threaded (nt <= 1) and a handoff buys nothing
+std::atomic<int64_t> g_pool_inline_busy{0};
+std::atomic<int64_t> g_pool_inline_small{0};  // under kParallelBytes
+
 struct HostStore {
   int64_t rows, cols;
   float sign;
@@ -142,12 +154,18 @@ struct HostStore {
 inline void ForRows(int64_t n, int64_t cols,
                     const std::function<void(int64_t, int64_t)>& body) {
   if (n * cols * static_cast<int64_t>(sizeof(float)) < kParallelBytes) {
+    g_pool_inline_small.fetch_add(1, std::memory_order_relaxed);
     body(0, n);
     return;
   }
   Pool& pool = GlobalPool();
   int nt = pool.size();
-  if (nt <= 1) {  // single-core host: a pool handoff is pure overhead
+  if (nt <= 1) {
+    // single-core host: a pool handoff is pure overhead. Tally under
+    // inline_busy (no parallel capacity), NOT inline_small — this
+    // apply is at or above kParallelBytes by construction, and
+    // inline_small's exported meaning is "under the byte floor"
+    g_pool_inline_busy.fetch_add(1, std::memory_order_relaxed);
     body(0, n);
     return;
   }
@@ -157,10 +175,13 @@ inline void ForRows(int64_t n, int64_t cols,
     int64_t hi = lo + chunk < n ? lo + chunk : n;
     if (lo < hi) body(lo, hi);
   });
-  if (!ran) {
+  if (ran) {
+    g_pool_parallel.fetch_add(1, std::memory_order_relaxed);
+  } else {
     // another engine shard owns the pool: run inline on THIS shard's
     // actor thread — concurrent shards each saturate their own core
     // instead of convoying behind one pool
+    g_pool_inline_busy.fetch_add(1, std::memory_order_relaxed);
     body(0, n);
   }
 }
@@ -227,6 +248,17 @@ void MV_HostStoreGetRows(void* h, const int32_t* ids, int64_t n,
                   cols * sizeof(float));
     }
   });
+}
+
+// out[4] = {parallel_runs, inline_busy (pool owned by another shard),
+// inline_small (under the parallel byte floor), pool_threads}.
+// Monotonic process-wide tallies — the python watchdog plane samples
+// them and alerts on a rising inline_busy share (pool saturation).
+void MV_HostStorePoolStats(int64_t* out) {
+  out[0] = g_pool_parallel.load(std::memory_order_relaxed);
+  out[1] = g_pool_inline_busy.load(std::memory_order_relaxed);
+  out[2] = g_pool_inline_small.load(std::memory_order_relaxed);
+  out[3] = GlobalPool().size();
 }
 
 }  // extern "C"

@@ -73,6 +73,10 @@ void MV_KvIndexFree(void* h) { delete static_cast<KvIndex*>(h); }
 
 int64_t MV_KvIndexSize(void* h) { return static_cast<KvIndex*>(h)->used; }
 
+int64_t MV_KvIndexCapacity(void* h) {
+  return static_cast<KvIndex*>(h)->cap;
+}
+
 void MV_KvIndexLookup(void* h, const int64_t* keys, int64_t n,
                       int32_t* out) {
   auto* ix = static_cast<KvIndex*>(h);

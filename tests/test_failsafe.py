@@ -405,10 +405,12 @@ class TestBlockingPathLint:
         assert any(rel.startswith(("serving/", "serving\\"))
                    for rel in scanned), sorted(scanned)
         # ...and the ops-plane modules (round 9) + the perf-forensics
-        # modules (round 11): the HTTP server stop and every dump path
-        # must stay bounded too
+        # modules (round 11) + the watchdog plane (round 13): the HTTP
+        # server stop, every dump path, the watchdog tick join and the
+        # ledger probes must all stay bounded
         for need in ("flight.py", "ops.py", "forensics.py",
-                     "critpath.py", "align.py", "sketch.py"):
+                     "critpath.py", "align.py", "sketch.py",
+                     "watchdog.py", "accounting.py"):
             assert any(rel.endswith(need)
                        and rel.startswith(("telemetry/", "telemetry\\"))
                        for rel in scanned), sorted(scanned)

@@ -50,7 +50,10 @@ class TestWheel:
         assert any(n == "multiverso_tpu/api.py" for n in names)
         assert any(n.startswith("multiverso_tpu/tables/") for n in names)
         assert any(n.startswith("multiverso_tpu/serving/") for n in names)
-        for mod in ("flight", "ops", "forensics"):
+        # ...and the round-13 watchdog plane: the wheel must carry the
+        # watchdog rules + accounting ledger the lints scan
+        for mod in ("flight", "ops", "forensics", "watchdog",
+                    "accounting"):
             assert f"multiverso_tpu/telemetry/{mod}.py" in names, names
 
     def test_install_and_import_in_clean_venv(self, wheel, tmp_path):

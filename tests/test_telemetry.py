@@ -310,10 +310,13 @@ class TestNoBarePrintLint:
         assert any(rel.startswith("serving") for rel in scanned), \
             sorted(scanned)
         # ...and the ops-plane modules (round 9) + the perf-forensics
-        # modules (round 11): the forensics/critpath CLIs and the HTTP
-        # handler all emit text and must ride the logger too
+        # modules (round 11) + the watchdog plane (round 13): the
+        # forensics/critpath CLIs, the HTTP handler, the watchdog's
+        # alert lines and the ledger all emit text and must ride the
+        # logger too
         for need in ("flight.py", "ops.py", "forensics.py",
-                     "critpath.py", "align.py", "sketch.py"):
+                     "critpath.py", "align.py", "sketch.py",
+                     "watchdog.py", "accounting.py"):
             assert os.path.join("telemetry", need) in scanned, \
                 sorted(scanned)
         # ...and the round-12 shm wire: its waits/errors must ride the
