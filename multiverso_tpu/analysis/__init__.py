@@ -1,0 +1,27 @@
+"""mvlint — the static invariant-analysis plane (DESIGN.md §16).
+
+The port's load-bearing conventions (never-collective reporter/handler
+threads, bounded blocking, logger-routed output, hot-path flag caching,
+SPMD lockstep verb streams) were guarded by two regex lints and 2-proc
+drills that catch violations only after they deadlock. This package
+turns them into machine-checked laws:
+
+* :mod:`core` — package index, checker registry, the inline
+  suppression contract (``# mv-lint: ok(<rule>): <reason>``; stale or
+  reasonless suppressions are themselves errors);
+* :mod:`callgraph` — the package-wide static call graph;
+* :mod:`rules` — the four AST checkers;
+* :mod:`collective` — the call-graph never-collective checker;
+* :mod:`cli` — ``python -m multiverso_tpu.analysis`` (text / ``--json``,
+  exit codes 0 clean / 1 findings / 2 usage).
+
+The analysis modules themselves import neither jax nor any runtime
+state — scanning is pure source analysis, so the CLI also works on a
+box that can't start a world (``python -m`` still pays the parent
+package import, as any submodule execution does).
+"""
+
+from multiverso_tpu.analysis.core import (AnalysisResult, Checker,  # noqa: F401
+                                          CHECKERS, Finding,
+                                          all_checker_names, load_package,
+                                          run_analysis)

@@ -1,0 +1,5 @@
+import sys
+
+from multiverso_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,560 @@
+"""Package-wide static call graph for the never-collective checker.
+
+Construction (and its honesty bounds, DESIGN.md §16): one AST pass per
+module collects defs, classes (with in-package base resolution) and
+import aliases; a second pass turns every call / callable reference in
+every top-level def into edges. Resolution, strongest first:
+
+1. dotted module chains through import aliases (``multihost.host_barrier``),
+   following ``from X import f`` re-exports transitively;
+2. ``self.``/``cls.`` methods through the class's in-package MRO;
+3. ``ClassName.m`` / ``ClassName(...).m`` / local ``x = ClassName(...)``
+   one-pass constructor type inference;
+4. anything else that is still a method call falls back to EVERY
+   in-package method of that name (dynamic-dispatch over-approximation —
+   a path through a fallback edge can be a false positive, never a
+   silently missed true one);
+5. bare-name calls resolve through local defs and ``from``-imports only;
+   an unresolved bare name (builtins, stdlib) drops out of the graph.
+
+Lambdas and nested defs merge into their enclosing top-level def, so
+``bounded(lambda: capped_exchange(...))`` correctly charges the caller.
+Defs under module/class-level ``if``/``try``/``with`` scaffolding (the
+version-shim idiom — parallel/mesh.py's ``shard_map``) are top-level
+definitions too (:func:`flat_body`), not module code.
+Non-call references to resolvable functions (callbacks passed by name)
+also produce edges. What the graph cannot see: getattr-by-string,
+property getters that do work, and calls that cross an actor mailbox
+(a ``msg.reply``/queue hop ends the static chain — by design: the verb
+stream discipline is about which THREAD issues a collective).
+
+Node ids are ``"<rel>:<qualname>"`` (``zoo.py:Zoo._barrier_wait``,
+``parallel/multihost.py:capped_exchange``, ``<module>`` for top-level
+code). Calls to well-known external collective attributes (``psum``,
+``all_gather``...) produce ``<external>:<name>`` sink nodes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from multiverso_tpu.analysis.core import PackageIndex, SourceFile
+
+#: attribute names that are collective primitives wherever they resolve
+#: (jax/gloo surfaces the package may grow calls to)
+EXTERNAL_COLLECTIVE_ATTRS = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_reduce",
+    "allreduce", "allgather", "alltoall", "reduce_scatter",
+    "broadcast_one_to_all", "sync_global_devices", "process_allgather",
+})
+
+_MODULE_NODE = "<module>"
+
+#: method names that collide with builtin container/string/IO/threading
+#: methods. An UNRESOLVED receiver calling one of these is almost always
+#: a dict/list/file/lock — fanning out to every same-named package
+#: method would wire `snap.get(...)` into MatrixTableHandler.get and
+#: drown the graph in false paths. Such names resolve only through
+#: typed receivers (self/cls, class names, constructor inference,
+#: module attributes) — a documented honesty bound, DESIGN.md §16. The
+#: package's own verb surfaces are capitalized (Add/Get/Wait/Join), so
+#: the lowercase exclusions cost little.
+_COMMON_METHOD_NAMES = frozenset({
+    "get", "set", "add", "pop", "append", "extend", "insert", "remove",
+    "discard", "clear", "copy", "update", "keys", "values", "items",
+    "setdefault", "popitem", "sort", "reverse", "index", "count",
+    "join", "split", "rsplit", "partition", "strip", "lstrip", "rstrip",
+    "lower", "upper", "title", "format", "replace", "startswith",
+    "endswith", "encode", "decode", "read", "readline", "readlines",
+    "write", "writelines", "flush", "close", "open", "seek", "tell",
+    "send", "recv", "put", "get_nowait", "put_nowait", "run", "start",
+    "stop", "wait", "notify", "notify_all", "acquire", "release",
+    "submit", "result", "cancel", "done", "shutdown", "connect",
+    "bind", "listen", "accept", "fileno", "terminate", "kill", "poll",
+    "communicate", "tobytes", "tolist", "item", "reshape", "astype",
+    "mean", "sum", "max", "min", "all", "any", "group", "match",
+    "search", "findall", "sub", "finditer", "fullmatch",
+})
+
+
+def flat_body(body) -> "list":
+    """Module/class-body statements with conditional/guard scaffolding
+    flattened: a def under a module-level ``if``/``try``/``with`` (the
+    version-shim and optional-dependency-fallback idioms —
+    parallel/mesh.py's ``shard_map`` shim is the in-package example) is
+    still a top-level definition for graph purposes. The guard's own
+    expressions (``if`` tests, ``except`` types, ``with`` context
+    expressions) are yielded too, so module-level guard code keeps its
+    edges. Does NOT descend into defs/lambdas — nested defs stay merged
+    into their enclosing def."""
+    out = []
+    for node in body:
+        if isinstance(node, ast.If):
+            out.append(node.test)
+            out.extend(flat_body(node.body))
+            out.extend(flat_body(node.orelse))
+        elif isinstance(node, ast.Try):
+            out.extend(flat_body(node.body))
+            for h in node.handlers:
+                if h.type is not None:
+                    out.append(h.type)
+                out.extend(flat_body(h.body))
+            out.extend(flat_body(node.orelse))
+            out.extend(flat_body(node.finalbody))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.append(item.context_expr)
+            out.extend(flat_body(node.body))
+        else:
+            out.append(node)
+    return out
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    bases: List[Tuple[str, str]] = field(default_factory=list)  # (rel, name)
+    methods: Dict[str, int] = field(default_factory=dict)       # name -> line
+
+
+@dataclass
+class ModuleInfo:
+    rel: str
+    dotted: str
+    sf: SourceFile
+    functions: Dict[str, int] = field(default_factory=dict)     # qual -> line
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    #: local name -> ("mod", rel) | ("sym", rel, name)
+    imports: Dict[str, tuple] = field(default_factory=dict)
+
+
+class CallGraph:
+    def __init__(self, pkg: PackageIndex):
+        self.pkg = pkg
+        self.pkg_name = os.path.basename(pkg.root)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.dotted: Dict[str, str] = {}            # dotted -> rel
+        self.edges: Dict[str, Set[str]] = {}
+        self.node_lines: Dict[str, Tuple[str, int]] = {}  # node -> (rel, line)
+        #: method name -> every "<rel>:<Class.m>" node (fallback targets)
+        self.methods_by_name: Dict[str, Set[str]] = {}
+        self.stats = {"calls": 0, "resolved": 0, "fallback": 0,
+                      "dropped": 0}
+        self._build()
+
+    # ---------------------------------------------------------- building
+
+    def _build(self) -> None:
+        for sf in self.pkg.files:
+            if sf.tree is None:
+                continue
+            rel = sf.rel
+            parts = rel[:-3].split("/")     # strip .py
+            if parts[-1] == "__init__":
+                parts = parts[:-1]
+            dotted = ".".join([self.pkg_name] + parts)
+            mi = ModuleInfo(rel=rel, dotted=dotted, sf=sf)
+            self.modules[rel] = mi
+            self.dotted[dotted] = rel
+        for mi in self.modules.values():
+            self._collect_defs(mi)
+        for mi in self.modules.values():
+            self._collect_imports(mi)
+        # base-class names resolve only after every module's defs exist
+        for mi in self.modules.values():
+            self._resolve_bases(mi)
+        for mi in self.modules.values():
+            self._collect_edges(mi)
+
+    def _collect_defs(self, mi: ModuleInfo) -> None:
+        for node in flat_body(mi.sf.tree.body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mi.functions[node.name] = node.lineno
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, rel=mi.rel)
+                mi.classes[node.name] = ci
+                for sub in flat_body(node.body):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        ci.methods[sub.name] = sub.lineno
+                        qual = f"{node.name}.{sub.name}"
+                        mi.functions[qual] = sub.lineno
+                        nid = f"{mi.rel}:{qual}"
+                        self.methods_by_name.setdefault(
+                            sub.name, set()).add(nid)
+        for qual, line in mi.functions.items():
+            self.node_lines[f"{mi.rel}:{qual}"] = (mi.rel, line)
+        self.node_lines[f"{mi.rel}:{_MODULE_NODE}"] = (mi.rel, 1)
+
+    def _collect_imports(self, mi: ModuleInfo) -> None:
+        pkg_prefix = self.pkg_name + "."
+        for node in ast.walk(mi.sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.name
+                    if name != self.pkg_name \
+                            and not name.startswith(pkg_prefix):
+                        # external module: record it so attribute calls
+                        # on it (subprocess.run, np.sum) resolve to
+                        # "external" and DON'T hit the method-name
+                        # fallback — stdlib receivers must not fan out
+                        # to every same-named package method
+                        local = alias.asname or name.split(".")[0]
+                        mi.imports.setdefault(local, ("ext",))
+                        continue
+                    rel = self._dotted_rel(name)
+                    if rel is None:
+                        continue
+                    if alias.asname:
+                        mi.imports[alias.asname] = ("mod", rel)
+                    else:
+                        # "import a.b.c" binds "a"; chains walk down
+                        root_rel = self._dotted_rel(name.split(".")[0])
+                        if root_rel is not None:
+                            mi.imports[name.split(".")[0]] = \
+                                ("mod", root_rel)
+            elif isinstance(node, ast.ImportFrom):
+                target = self._from_target(mi, node)
+                if target is None:
+                    if node.level == 0:
+                        # external from-import: same external marker for
+                        # the bound names (threading.Thread, Path, ...)
+                        for alias in node.names:
+                            mi.imports.setdefault(
+                                alias.asname or alias.name, ("ext",))
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    sub_rel = self._dotted_rel(
+                        f"{target}.{alias.name}")
+                    if sub_rel is not None:
+                        mi.imports[local] = ("mod", sub_rel)
+                    else:
+                        rel = self._dotted_rel(target)
+                        if rel is not None:
+                            mi.imports[local] = ("sym", rel, alias.name)
+
+    def _from_target(self, mi: ModuleInfo,
+                     node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            mod = node.module or ""
+            if mod == self.pkg_name or mod.startswith(self.pkg_name + "."):
+                return mod
+            return None
+        # relative import: climb from this module's dotted package
+        base = mi.dotted.split(".")
+        if not mi.rel.endswith("__init__.py"):
+            base = base[:-1]
+        climb = node.level - 1
+        if climb > len(base):
+            return None
+        base = base[: len(base) - climb] if climb else base
+        return ".".join(base + ([node.module] if node.module else []))
+
+    def _dotted_rel(self, dotted: str) -> Optional[str]:
+        return self.dotted.get(dotted)
+
+    def _resolve_bases(self, mi: ModuleInfo) -> None:
+        for node in flat_body(mi.sf.tree.body):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = mi.classes[node.name]
+            for b in node.bases:
+                ref = self._lookup_class(mi, b)
+                if ref is not None:
+                    ci.bases.append(ref)
+
+    def _lookup_class(self, mi: ModuleInfo,
+                      expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """Resolve a base-class expression to an in-package (rel, name)."""
+        if isinstance(expr, ast.Name):
+            return self._class_by_name(mi, expr.id)
+        if isinstance(expr, ast.Attribute):
+            chain = _attr_chain(expr)
+            if chain is None:
+                return None
+            state = self._chain_resolve(mi, chain)
+            if state is not None and state[0] == "class":
+                return (state[1], state[2])
+        return None
+
+    def _class_by_name(self, mi: ModuleInfo,
+                       name: str, _seen=None) -> Optional[Tuple[str, str]]:
+        if name in mi.classes:
+            return (mi.rel, name)
+        imp = mi.imports.get(name)
+        if imp is None:
+            return None
+        if imp[0] == "sym":
+            tgt = self.modules.get(imp[1])
+            if tgt is None:
+                return None
+            seen = _seen or set()
+            if (imp[1], imp[2]) in seen:
+                return None
+            seen.add((imp[1], imp[2]))
+            return self._class_by_name(tgt, imp[2], seen)
+        return None
+
+    # ------------------------------------------------------ symbol lookup
+
+    def _resolve_symbol(self, rel: str, name: str,
+                        _seen=None) -> Optional[tuple]:
+        """Resolve ``name`` inside module ``rel`` to
+        ("func", rel, qual) | ("class", rel, cname) | ("mod", rel)."""
+        mi = self.modules.get(rel)
+        if mi is None:
+            return None
+        if name in mi.classes:
+            return ("class", rel, name)
+        if name in mi.functions and "." not in name:
+            return ("func", rel, name)
+        imp = mi.imports.get(name)
+        if imp is None:
+            return None
+        if imp[0] == "ext":
+            return ("ext",)
+        if imp[0] == "mod":
+            return ("mod", imp[1])
+        seen = _seen or set()
+        if (imp[1], imp[2]) in seen:
+            return None
+        seen.add((imp[1], imp[2]))
+        return self._resolve_symbol(imp[1], imp[2], seen)
+
+    def _chain_resolve(self, mi: ModuleInfo, chain: List[str],
+                       local_types: Optional[Dict[str, Tuple[str, str]]]
+                       = None,
+                       own_class: Optional[ClassInfo] = None
+                       ) -> Optional[tuple]:
+        """Walk a dotted name chain to a ("func"|"class"|"mod") state."""
+        head, rest = chain[0], chain[1:]
+        state: Optional[tuple]
+        if head in ("self", "cls") and own_class is not None:
+            state = ("class", own_class.rel, own_class.name)
+        elif local_types and head in local_types:
+            crel, cname = local_types[head]
+            state = ("class", crel, cname)
+        else:
+            state = self._resolve_symbol(mi.rel, head)
+        for part in rest:
+            if state is None:
+                return None
+            kind = state[0]
+            if kind == "ext":
+                continue        # external chains stay external
+            if kind == "mod":
+                sub = self.modules.get(state[1])
+                if sub is None:
+                    return None
+                nxt = self._dotted_rel(f"{sub.dotted}.{part}")
+                if nxt is not None:
+                    state = ("mod", nxt)
+                else:
+                    state = self._resolve_symbol(state[1], part)
+            elif kind == "class":
+                m = self._mro_method(state[1], state[2], part)
+                state = m           # ("func", rel, Class.m) or None
+            else:
+                return None         # attribute of a function: opaque
+        return state
+
+    def _mro_method(self, rel: str, cname: str, method: str,
+                    _seen=None) -> Optional[tuple]:
+        seen = _seen or set()
+        if (rel, cname) in seen:
+            return None
+        seen.add((rel, cname))
+        mi = self.modules.get(rel)
+        if mi is None or cname not in mi.classes:
+            return None
+        ci = mi.classes[cname]
+        if method in ci.methods:
+            return ("func", rel, f"{cname}.{method}")
+        for brel, bname in ci.bases:
+            got = self._mro_method(brel, bname, method, seen)
+            if got is not None:
+                return got
+        return None
+
+    # ---------------------------------------------------------- edge pass
+
+    def _collect_edges(self, mi: ModuleInfo) -> None:
+        mod_owner = f"{mi.rel}:{_MODULE_NODE}"
+        for node in flat_body(mi.sf.tree.body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._edges_for_def(mi, f"{mi.rel}:{node.name}", node, None)
+            elif isinstance(node, ast.ClassDef):
+                ci = mi.classes[node.name]
+                for sub in flat_body(node.body):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        owner = f"{mi.rel}:{node.name}.{sub.name}"
+                        self._edges_for_def(mi, owner, sub, ci)
+            else:
+                # everything else (incl. flattened guard expressions)
+                # is module-level code
+                self._edges_for_def(mi, mod_owner, node, None)
+
+    def _edges_for_def(self, mi: ModuleInfo, owner: str, root: ast.AST,
+                       own_class: Optional[ClassInfo]) -> None:
+        local_types: Dict[str, Tuple[str, str]] = {}
+        # pass 1: one-shot constructor type inference (x = ClassName(...))
+        for node in ast.walk(root):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name):
+                cref = self._class_by_name(mi, node.value.func.id)
+                if cref is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_types[tgt.id] = cref
+        # pass 2: calls + callable references
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                self._edge_for_call(mi, owner, node, local_types, own_class)
+            elif isinstance(node, (ast.Name, ast.Attribute)) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                self._edge_for_ref(mi, owner, node, local_types, own_class)
+
+    def _add_edge(self, owner: str, target: str) -> None:
+        self.edges.setdefault(owner, set()).add(target)
+
+    def _edge_for_call(self, mi: ModuleInfo, owner: str, call: ast.Call,
+                       local_types, own_class) -> None:
+        self.stats["calls"] += 1
+        func = call.func
+        if isinstance(func, ast.Name):
+            state = self._resolve_symbol(mi.rel, func.id)
+            if (state is None or state[0] == "ext") \
+                    and func.id in EXTERNAL_COLLECTIVE_ATTRS:
+                # `from jax...multihost_utils import process_allgather`
+                # then a bare-name call: still a collective sink — an
+                # in-package def of the same name resolves first and
+                # wins (its body is scanned instead)
+                self._add_edge(owner, f"<external>:{func.id}")
+                self.stats["resolved"] += 1
+                return
+            self._edge_for_state(owner, state, mi)
+            return
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            chain = _attr_chain(func)
+            state = None
+            if chain is not None:
+                state = self._chain_resolve(mi, chain, local_types,
+                                            own_class)
+            elif isinstance(func.value, ast.Call) \
+                    and isinstance(func.value.func, ast.Name):
+                # ClassName(...).method(...)
+                cref = self._class_by_name(mi, func.value.func.id)
+                if cref is not None:
+                    state = self._mro_method(cref[0], cref[1], attr)
+            if state is not None and state[0] != "ext":
+                self._edge_for_state(owner, state, mi)
+                return
+            if attr in EXTERNAL_COLLECTIVE_ATTRS:
+                self._add_edge(owner, f"<external>:{attr}")
+                self.stats["resolved"] += 1
+                return
+            if state is not None:       # ("ext",): known-external receiver
+                self.stats["dropped"] += 1
+                return
+            targets = self.methods_by_name.get(attr)
+            if targets and not attr.startswith("__") \
+                    and attr not in _COMMON_METHOD_NAMES:
+                self.stats["fallback"] += 1
+                for t in targets:
+                    self._add_edge(owner, t)
+            else:
+                self.stats["dropped"] += 1
+
+    def _edge_for_state(self, owner: str, state: Optional[tuple],
+                        mi: ModuleInfo) -> None:
+        if state is None:
+            self.stats["dropped"] += 1
+            return
+        kind = state[0]
+        if kind == "func":
+            self.stats["resolved"] += 1
+            self._add_edge(owner, f"{state[1]}:{state[2]}")
+        elif kind == "class":
+            init = self._mro_method(state[1], state[2], "__init__")
+            self.stats["resolved"] += 1
+            if init is not None:
+                self._add_edge(owner, f"{init[1]}:{init[2]}")
+        else:
+            self.stats["dropped"] += 1
+
+    def _edge_for_ref(self, mi: ModuleInfo, owner: str, node: ast.AST,
+                      local_types, own_class) -> None:
+        """Callback references: a bare/dotted name resolving to an
+        in-package function creates an edge even without a call."""
+        if isinstance(node, ast.Name):
+            state = self._resolve_symbol(mi.rel, node.id)
+        else:
+            chain = _attr_chain(node)
+            if chain is None:
+                return
+            state = self._chain_resolve(mi, chain, local_types, own_class)
+        if state is not None and state[0] == "func":
+            self._add_edge(owner, f"{state[1]}:{state[2]}")
+
+    # ------------------------------------------------------- reachability
+
+    def reachable(self, roots: List[str]
+                  ) -> Tuple[Set[str], Dict[str, str]]:
+        """BFS closure + parent map (for path reconstruction)."""
+        seen: Set[str] = set()
+        parent: Dict[str, str] = {}
+        frontier = [r for r in roots if r in self.node_lines
+                    or r in self.edges]
+        seen.update(frontier)
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for t in self.edges.get(n, ()):
+                    if t not in seen:
+                        seen.add(t)
+                        parent[t] = n
+                        nxt.append(t)
+            frontier = nxt
+        return seen, parent
+
+    def path_to(self, parent: Dict[str, str], node: str) -> List[str]:
+        out = [node]
+        while node in parent:
+            node = parent[node]
+            out.append(node)
+        return list(reversed(out))
+
+    def has_node(self, node: str) -> bool:
+        return node in self.node_lines
+
+
+def _attr_chain(node: ast.Attribute) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None when the chain root is not a
+    plain name (subscripts, calls, literals)."""
+    parts = [node.attr]
+    cur = node.value
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return list(reversed(parts))
+    return None
+
+
+_GRAPH_CACHE: Dict[str, CallGraph] = {}
+
+
+def build_graph(pkg: PackageIndex) -> CallGraph:
+    g = _GRAPH_CACHE.get(pkg.root)
+    if g is None or g.pkg is not pkg:
+        g = _GRAPH_CACHE[pkg.root] = CallGraph(pkg)
+    return g

@@ -256,16 +256,20 @@ class PSModel(Model):
             # n restarts at zero — but not silently dropped)
             flat = self._flat_keys(np.arange(self.config.input_size,
                                              dtype=np.int64))
+            # mv-lint: ok(spmd-stream-guard): single-submitter warm start by design (ps_model.cpp:117-152)
             self.z_table.Add(flat, np.asarray(self.z, np.float32).ravel())
+            # mv-lint: ok(spmd-stream-guard): single-submitter warm start by design (ps_model.cpp:117-152)
             self.n_table.Add(flat, np.asarray(self.n, np.float32).ravel())
             return
         W = self.weights()
         flat = np.ascontiguousarray(-W.T, np.float32).ravel()  # server does -=
         if self.config.sparse:
+            # mv-lint: ok(spmd-stream-guard): single-submitter warm start by design (ps_model.cpp:117-152)
             self.table.AddRows(np.arange(self.config.input_size,
                                          dtype=np.int32),
                                -W.astype(np.float32))
         else:
+            # mv-lint: ok(spmd-stream-guard): single-submitter warm start by design (ps_model.cpp:117-152)
             self.table.Add(flat)
 
     def train_window(self, window: Window) -> float:

@@ -24,12 +24,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_string
+from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_string,
+                                            cached_str_flag)
 
-MV_DEFINE_string("use_pallas", "auto",
+#: one constant feeds both the flag registration and the cached
+#: accessor's fallback, so the two defaults cannot drift apart
+_USE_PALLAS_DEFAULT = "auto"
+MV_DEFINE_string("use_pallas", _USE_PALLAS_DEFAULT,
                  "row-op kernels: auto (TPU only) / on / off")
 MV_DEFINE_string("matrix_pad_cols", "auto",
                  "pad matrix storage cols to the 128-lane tile: auto/on/off")
+#: use_pallas/_forced_on run per row-op dispatch (every verb on the
+#: apply path) — listener-cached read, not a registry walk per call
+_use_pallas_flag = cached_str_flag("use_pallas", _USE_PALLAS_DEFAULT)
 
 LANE = 128
 #: Pallas row kernels take the id vector as a SCALAR-PREFETCH operand in
@@ -56,7 +63,7 @@ def _pallas_eligible(data) -> bool:
 def use_pallas(data=None, ids=None) -> bool:
     if ids is not None and ids.shape[0] * 4 > SMEM_IDS_BYTES:
         return False   # id vector would overflow the SMEM prefetch
-    mode = str(GetFlag("use_pallas")).lower()
+    mode = _use_pallas_flag()
     if mode == "on":
         # forced on (interpreter mode off-TPU; tests): still respect the
         # lowering constraints — an ineligible shape would be a Mosaic
@@ -91,8 +98,7 @@ def _forced_on(data, ids=None) -> bool:
     whose default path is XLA, so tests keep covering the kernels."""
     if ids is not None and ids.shape[0] * 4 > SMEM_IDS_BYTES:
         return False
-    return (str(GetFlag("use_pallas")).lower() == "on"
-            and _pallas_eligible(data))
+    return _use_pallas_flag() == "on" and _pallas_eligible(data)
 
 
 def dedup_rows(ids: jax.Array, deltas: jax.Array):

@@ -46,7 +46,8 @@ from multiverso_tpu.updaters.base import AddOption, GetOption
 from multiverso_tpu.utils.configure import (GetFlag, MV_DEFINE_bool,
                                             MV_DEFINE_int, MV_DEFINE_string,
                                             cached_bool_flag,
-                                            cached_int_flag)
+                                            cached_int_flag,
+                                            cached_str_flag)
 from multiverso_tpu.utils.dashboard import monitor_region
 from multiverso_tpu.utils.log import CHECK, Log
 from multiverso_tpu.utils.mt_queue import MtQueue
@@ -72,12 +73,22 @@ MV_DEFINE_int("backup_worker_ratio", 0, "ratio% of backup workers (dead flag, pa
 # deployment, where the device wire moves 100+ GB/s with ~us dispatch,
 # should run -window_transport=device (or drop the threshold to ~1 MB)
 # — see docs/BENCHMARK.md "transport selection".
-MV_DEFINE_string("window_transport", "auto",
+# each constant feeds both the flag registration and the cached
+# accessor's fallback, so the two defaults cannot drift apart
+_WINDOW_TRANSPORT_DEFAULT = "auto"
+_WINDOW_DEVICE_MIN_BYTES_DEFAULT = 6 << 20
+MV_DEFINE_string("window_transport", _WINDOW_TRANSPORT_DEFAULT,
                  "windowed-engine Add-value transport: auto / host / device")
-MV_DEFINE_int("window_device_min_bytes", 6 << 20,
+MV_DEFINE_int("window_device_min_bytes", _WINDOW_DEVICE_MIN_BYTES_DEFAULT,
               "auto transport: defer Add values >= this many bytes to "
               "the device wire (default just above this host's measured "
               "crossover)")
+# both are read per window on the pack path — listener-cached reads,
+# not a registry RLock walk per window (hot-path-flag-cache law)
+_window_transport_flag = cached_str_flag("window_transport",
+                                         _WINDOW_TRANSPORT_DEFAULT)
+_window_device_min_bytes_flag = cached_int_flag(
+    "window_device_min_bytes", _WINDOW_DEVICE_MIN_BYTES_DEFAULT)
 # Round 7 — PIPELINED window engine. The serial engine ran drain ->
 # encode -> exchange -> apply strictly in sequence on the actor thread,
 # parking every worker behind the whole chain. With the pipeline a
@@ -1688,7 +1699,7 @@ class Server(Actor):
               f"stream position (the SPMD collective contract)")
 
     def _mh_transport(self) -> str:
-        mode = str(GetFlag("window_transport")).lower()
+        mode = _window_transport_flag()
         CHECK(mode in ("auto", "host", "device"),
               f"-window_transport must be auto/host/device, got {mode!r}")
         return mode
@@ -1866,7 +1877,7 @@ class Server(Actor):
         ~nothing here and a device-transport burst of large Adds still
         coalesces into one exchange."""
         mode = self._mh_transport()
-        min_bytes = int(GetFlag("window_device_min_bytes"))
+        min_bytes = _window_device_min_bytes_flag()
         local = []
         used = []
         packed = 0

@@ -1,0 +1,17 @@
+"""Fixture: an unbounded join hidden behind an attribute chain."""
+
+import threading
+
+
+class Inner:
+    def __init__(self):
+        self.t = threading.Thread(target=lambda: None, daemon=True)
+
+
+class Drain:
+    def __init__(self):
+        self.inner = Inner()
+
+    def stop(self):
+        self.inner.t.join(
+        )  # seeded violation: multi-line, chained — the regex missed these

@@ -1,0 +1,5 @@
+"""Fixture: bare print bypasses the logger."""
+
+
+def report(msg):
+    print("report:", msg)  # seeded violation

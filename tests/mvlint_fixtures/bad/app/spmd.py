@@ -1,0 +1,30 @@
+"""Fixture: rank-guarded verbs — the diverged-stream bug class, in all
+five spellings the checker knows (lexical guard, guard-clause early
+return, short-circuit boolean chain, comprehension rank filter,
+rank-dependent for iteration)."""
+
+
+def step(table, rank, delta):
+    if rank == 0:
+        table.Add(delta)  # seeded violation (lexical guard)
+    return table.Get()
+
+
+def publish(table, rank, delta):
+    if rank != 0:
+        return None
+    table.Add(delta)  # seeded violation (guard-clause early return)
+    return table.Get()
+
+
+def maybe_probe(table, rank, key):
+    return rank == 0 and table.Get(key)  # seeded violation (short-circuit)
+
+
+def push_batch(table, rank, deltas):
+    return [table.Add(d) for d in deltas if rank == 0]  # seeded violation (comprehension filter)
+
+
+def replay(table, rank, deltas):
+    for d in deltas[rank:]:
+        table.Add(d)  # seeded violation (rank-dependent iteration count)

@@ -1,0 +1,5 @@
+"""Fixture mirror: row-op dispatch hot zone (HOT_ZONES liveness)."""
+
+
+def use_pallas(data=None, ids=None):
+    return False

@@ -1,0 +1,54 @@
+"""Fixture twin of parallel/multihost.py: the collective primitives the
+never-collective checker marks as sinks (bodies are stubs)."""
+
+
+class Group:
+    def exchange(self, blob, key):
+        return [blob]
+
+    def barrier(self, name):
+        return None
+
+
+def process_count():
+    return 1
+
+
+def capped_exchange(blob, caps, key, channel=0):
+    return [blob]
+
+
+def host_barrier(name="mv_barrier"):
+    return None
+
+
+def host_allreduce_sum(data):
+    return data
+
+
+def host_allgather_bytes(data):
+    return [data]
+
+
+def host_allgather_objects(obj):
+    return [obj]
+
+
+def host_allgather_objects_capped(obj, key):
+    return [obj]
+
+
+def broadcast_from_master(data):
+    return data
+
+
+def merge_collective_add(option, *arrays, with_parts=False):
+    return arrays, None
+
+
+def sum_collective_add(option, values, with_parts=False):
+    return values, None
+
+
+def union_collective_ids(ids):
+    return ids

@@ -1,0 +1,11 @@
+"""Fixture twin of the engine hot path: a registry walk per window."""
+
+
+def GetFlag(name):
+    return 4 << 20
+
+
+class Server:
+    def _mh_pack_window(self, verbs):
+        budget = int(GetFlag("window_bytes"))  # seeded violation
+        return verbs[:budget]

@@ -1,0 +1,6 @@
+"""Fixture mirror: worker verb path hot zone (HOT_ZONES liveness)."""
+
+
+class ArrayTable:
+    def Add(self, delta):
+        return delta

@@ -1,0 +1,15 @@
+"""Fixture twin of the stats reporter: the reporter thread is a root."""
+
+
+class StatsReporter:
+    def __init__(self, interval_s):
+        self.interval_s = interval_s
+        self._stopped = False
+
+    def _run(self):
+        while not self._stopped:
+            self.emit()
+            break
+
+    def emit(self):
+        return {"telemetry": True}

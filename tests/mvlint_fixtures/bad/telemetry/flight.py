@@ -1,0 +1,5 @@
+"""Fixture mirror: flight record hot zone (HOT_ZONES liveness)."""
+
+
+def record(event):
+    return event

@@ -1,0 +1,8 @@
+"""Fixture twin of the ops plane: the HTTP handler is a restricted root."""
+
+from . import accounting
+
+
+class _OpsHandler:
+    def do_GET(self):
+        return accounting.memory_report()

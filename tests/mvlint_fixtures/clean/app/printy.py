@@ -1,0 +1,11 @@
+"""Fixture: output rides the logger."""
+
+
+class Log:
+    @staticmethod
+    def Info(fmt, *args):
+        return fmt % args if args else fmt
+
+
+def report(msg):
+    Log.Info("%s", msg)

@@ -1,0 +1,39 @@
+"""Fixture: verbs run on every rank; rank guards only gate logging, a
+verb ahead of the rank test in a boolean chain evaluates everywhere
+(short-circuit order), and a rank-dependent raise is an error path,
+not a quiet stream divergence."""
+
+
+def step(table, rank, delta, log):
+    if rank == 0:
+        log("leading rank heartbeat")
+    table.Add(delta)
+    return table.Get()
+
+
+def probe_then_note(table, rank, key, log):
+    if table.Get(key) and rank == 0:
+        log("leading rank saw the key")
+    return None
+
+
+def validated_step(table, worker_id, delta):
+    if worker_id is None:
+        raise ValueError("worker_id is required")
+    table.Add(delta)
+    return table.Get()
+
+
+def note_leading(table, rank, note):
+    # the iterable is the FIRST comprehension clause: the Get runs on
+    # every rank before the rank filter is ever consulted
+    return [note(row) for row in table.Get() if rank == 0]
+
+
+def note_then_push(table, rank, delta, log):
+    # a rank-dependent loop does NOT exit the block the way a
+    # guard-clause return does: the Add after it runs on every rank
+    for peer in range(rank):
+        log("lower-ranked peer %d" % peer)
+    table.Add(delta)
+    return table.Get()

@@ -1,0 +1,6 @@
+"""Fixture twin of the shm wire: ShmWire.exchange is a sink."""
+
+
+class ShmWire:
+    def exchange(self, blob, channel):
+        return [blob]

@@ -1,0 +1,16 @@
+"""Fixture twin of the engine hot path: flag reads ride cached accessors."""
+
+
+def cached_int_flag(name, default):
+    def _get():
+        return default
+    return _get
+
+
+_budget_flag = cached_int_flag("window_bytes", 4 << 20)
+
+
+class Server:
+    def _mh_pack_window(self, verbs):
+        budget = int(_budget_flag())
+        return verbs[:budget]

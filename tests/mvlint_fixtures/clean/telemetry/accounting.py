@@ -1,0 +1,12 @@
+"""Fixture twin of the accounting ledger: pull probes, local only."""
+
+from ..zoo import Zoo
+
+
+def memory_report():
+    zoo = Zoo.Get()
+    return {"tables": [], "zoo": zoo is not None}
+
+
+def refresh():
+    return memory_report()

@@ -1,0 +1,17 @@
+"""Fixture twin of the watchdog: tick/_run are restricted roots."""
+
+
+def collect_sample():
+    return {"mem.process_bytes": 0.0}
+
+
+class Watchdog:
+    def __init__(self, interval_s):
+        self.interval_s = interval_s
+
+    def tick(self):
+        sample = collect_sample()
+        return [k for k in sample]
+
+    def _run(self):
+        return self.tick()

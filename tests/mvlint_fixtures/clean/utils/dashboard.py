@@ -1,0 +1,15 @@
+"""Fixture twin of the dashboard: Display/_ops_lines are local renders."""
+
+
+class Dashboard:
+    _records = {}
+
+    @classmethod
+    def Display(cls):
+        lines = [str(k) for k in sorted(cls._records)]
+        lines += cls._ops_lines()
+        return chr(10).join(lines)
+
+    @staticmethod
+    def _ops_lines():
+        return ["[Ops] fixture"]

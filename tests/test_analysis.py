@@ -1,0 +1,588 @@
+"""mvlint (multiverso_tpu.analysis) tests: framework contract, call-graph
+resolution, per-rule fixture catches, the frozen zero-findings package
+baseline, and the CLI exit-code contract (0 clean / 1 findings / 2
+usage) that lets CI gate on the pass."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from multiverso_tpu.analysis import core
+from multiverso_tpu.analysis import run_analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "mvlint_fixtures")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+
+
+def _write_pkg(root, files):
+    for rel, text in files.items():
+        path = os.path.join(str(root), rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(text))
+    return str(root)
+
+
+class TestSuppressionContract:
+    def test_trailing_marker_suppresses_and_is_not_stale(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(msg):
+                print(msg)  # mv-lint: ok(no-bare-print): fixture reason
+            """})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        assert res.clean
+        assert len(res.suppressed) == 1
+        assert res.suppressed[0].rule == "no-bare-print"
+
+    def test_own_line_marker_targets_next_code_line(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(msg):
+                # mv-lint: ok(no-bare-print): fixture reason
+                print(msg)
+            """})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        assert res.clean and len(res.suppressed) == 1
+
+    def test_reasonless_marker_is_a_finding(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(msg):
+                print(msg)  # mv-lint: ok(no-bare-print)
+            """})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        rules = {f.rule for f in res.findings}
+        # the marker is rejected AND the print itself still reports
+        assert rules == {"mvlint-suppression", "no-bare-print"}
+        assert any("no reason" in f.message for f in res.findings)
+
+    def test_unknown_rule_marker_is_a_finding(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            X = 1  # mv-lint: ok(no-such-rule): because
+            """})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        assert [f.rule for f in res.findings] == ["mvlint-suppression"]
+        assert "unknown rule" in res.findings[0].message
+
+    def test_stale_suppression_is_a_finding(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(msg):
+                return msg  # mv-lint: ok(no-bare-print): nothing here
+            """})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        assert [f.rule for f in res.findings] == ["stale-suppression"]
+
+    def test_stale_judged_only_for_rules_that_ran(self, tmp_path):
+        """A --rules subset must not flag other rules' suppressions."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(msg):
+                return msg  # mv-lint: ok(no-bare-print): nothing here
+            """})
+        res = run_analysis(root=root, rules=["bounded-blocking"])
+        assert res.clean
+
+    def test_trailing_marker_on_continuation_line_suppresses(
+            self, tmp_path):
+        """A marker trailing the CLOSING line of a call that spans
+        lines binds to the whole simple statement — it lands on the
+        finding anchored at the call's first line instead of failing
+        to suppress and then reporting itself stale."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(table, rank, ids, deltas):
+                if rank == 0:
+                    table.AddRows(ids,
+                                  deltas)  # mv-lint: ok(spmd-stream-guard): single submitter
+            """})
+        res = run_analysis(root=root, rules=["spmd-stream-guard"])
+        assert res.clean, [f.render() for f in res.findings]
+        assert len(res.suppressed) == 1
+
+    def test_marker_on_compound_header_keeps_exact_line_scope(
+            self, tmp_path):
+        """A marker trailing an `if` header must NOT quietly excuse
+        violations inside the block — compound statements are not the
+        suppression anchor unit."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(table, rank, delta):
+                if rank == 0:  # mv-lint: ok(spmd-stream-guard): header only
+                    table.Add(delta)
+            """})
+        res = run_analysis(root=root, rules=["spmd-stream-guard"])
+        rules = sorted(f.rule for f in res.findings)
+        # the violation still reports AND the marker is stale
+        assert rules == ["spmd-stream-guard", "stale-suppression"], \
+            [f.render() for f in res.findings]
+
+    def test_empty_rule_list_is_rejected(self):
+        """run_analysis(rules=[]) must not run zero checkers and
+        return clean=True — the CLI maps this KeyError to exit 2."""
+        with pytest.raises(KeyError, match="empty rule list"):
+            run_analysis(rules=[])
+
+    def test_marker_in_allowlisted_file_reports_redundant(
+            self, tmp_path):
+        """A marker in a file the rule wholesale-ALLOWs can never be
+        used — the finding must say the marker is redundant with the
+        allowlist, not claim the violation it excused is gone."""
+        root = _write_pkg(tmp_path / "p", {"parallel/shm_wire.py": """\
+            def layout(table, rank, delta):
+                if rank == 0:
+                    # mv-lint: ok(spmd-stream-guard): peer ring layout
+                    table.Add(delta)
+            """})
+        res = run_analysis(root=root, rules=["spmd-stream-guard"])
+        assert [f.rule for f in res.findings] == ["stale-suppression"]
+        assert "redundant" in res.findings[0].message \
+            and "allowlisted" in res.findings[0].message, \
+            res.findings[0].message
+
+    def test_marker_text_inside_docstring_is_ignored(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": '''\
+            def f():
+                """Suppress with '# mv-lint: ok(rule)' — doc text only."""
+                return 1
+            '''})
+        res = run_analysis(root=root, rules=["no-bare-print"])
+        assert res.clean
+
+
+class TestCallGraph:
+    def _graph(self, tmp_path, files):
+        from multiverso_tpu.analysis import callgraph
+        pkg = core.PackageIndex(_write_pkg(tmp_path / "pkg", files))
+        return callgraph.CallGraph(pkg)
+
+    def test_module_attr_and_from_import_resolution(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "wire.py": "def exchange_bytes(b):\n    return [b]\n",
+            "user.py": """\
+                from .wire import exchange_bytes
+                from . import wire
+
+                def a(b):
+                    return exchange_bytes(b)
+
+                def b(b):
+                    return wire.exchange_bytes(b)
+                """})
+        assert "wire.py:exchange_bytes" in g.edges["user.py:a"]
+        assert "wire.py:exchange_bytes" in g.edges["user.py:b"]
+
+    def test_self_methods_resolve_through_inheritance(self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            class Base:
+                def leaf(self):
+                    return 1
+
+            class Child(Base):
+                def top(self):
+                    return self.leaf()
+            """})
+        assert "m.py:Base.leaf" in g.edges["m.py:Child.top"]
+
+    def test_constructor_type_inference(self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            class Probe:
+                def sample_now(self):
+                    return 0
+
+            def use():
+                p = Probe()
+                return p.sample_now()
+            """})
+        assert "m.py:Probe.sample_now" in g.edges["m.py:use"]
+
+    def test_lambda_and_callback_refs_charge_the_enclosing_def(
+            self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            def bounded(fn):
+                return fn()
+
+            def fence():
+                return 0
+
+            def caller():
+                bounded(lambda: fence())
+
+            def by_name():
+                bounded(fence)
+            """})
+        assert "m.py:fence" in g.edges["m.py:caller"]
+        assert "m.py:fence" in g.edges["m.py:by_name"]
+
+    def test_external_receivers_do_not_fan_out(self, tmp_path):
+        """subprocess.run must NOT link to a package method named run."""
+        g = self._graph(tmp_path, {"m.py": """\
+            import subprocess
+
+            class Job:
+                def run(self):
+                    return 1
+
+            def build():
+                subprocess.run(["make"])
+            """})
+        assert "m.py:Job.run" not in g.edges.get("m.py:build", set())
+
+    def test_fallback_links_distinctive_names_not_container_names(
+            self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            class Table:
+                def ledger_probe(self):
+                    return 0
+
+                def get(self, k):
+                    return k
+
+            def scan(tables):
+                for t in tables:
+                    t.ledger_probe()
+                    t.get("x")
+            """})
+        edges = g.edges["m.py:scan"]
+        assert "m.py:Table.ledger_probe" in edges     # dynamic dispatch
+        assert "m.py:Table.get" not in edges          # container-name bound
+
+    def test_defs_under_module_level_guards_are_nodes(self, tmp_path):
+        """The shard_map version-shim idiom (parallel/mesh.py): a def
+        inside a module-level try/except or if/else is a top-level
+        graph node — dropping it would silently break the
+        never-collective guarantee for shimmed collectives."""
+        g = self._graph(tmp_path, {"m.py": """\
+            try:
+                import fastpath
+            except ImportError:
+                def exchange(b):
+                    return [b]
+
+            if 1 == 1:
+                class Shim:
+                    def relay(self, b):
+                        return exchange(b)
+
+            def caller(s, b):
+                return s.relay(b)
+            """})
+        assert "m.py:exchange" in g.edges["m.py:Shim.relay"]
+        assert "m.py:Shim.relay" in g.edges["m.py:caller"]
+
+    def test_external_collective_attrs_become_sinks(self, tmp_path):
+        g = self._graph(tmp_path, {"m.py": """\
+            def reduce_all(x, mhu):
+                return mhu.process_allgather(x)
+            """})
+        assert "<external>:process_allgather" in g.edges["m.py:reduce_all"]
+
+
+class TestFixtureCatches:
+    """Every checker catches its seeded fixture and stays silent on the
+    clean twin (the false-positive guard)."""
+
+    EXPECT = {
+        "no-bare-print": ("app/printy.py", 5),
+        "bounded-blocking": ("app/blocky.py", 16),
+        "spmd-stream-guard": ("app/spmd.py", 9),
+        "hot-path-flag-cache": ("sync/server.py", 10),
+        "never-collective": ("telemetry/watchdog.py", 14),
+    }
+
+    @pytest.fixture(scope="class")
+    def results(self):
+        return (run_analysis(root=BAD), run_analysis(root=CLEAN))
+
+    @pytest.mark.parametrize("rule", sorted(EXPECT))
+    def test_rule_catches_seeded_violation_and_passes_clean_twin(
+            self, results, rule):
+        bad_res, clean_res = results
+        path, line = self.EXPECT[rule]
+        hits = [f for f in bad_res.findings if f.rule == rule]
+        assert any(f.path == path and f.line == line for f in hits), \
+            [f.render() for f in bad_res.findings]
+        assert not [f for f in clean_res.findings if f.rule == rule], \
+            [f.render() for f in clean_res.findings]
+
+    def test_clean_twin_is_fully_clean(self, results):
+        _, clean_res = results
+        assert clean_res.clean, [f.render() for f in clean_res.findings]
+
+    def test_bad_twin_has_no_unexpected_rules(self, results):
+        bad_res, _ = results
+        assert {f.rule for f in bad_res.findings} == set(self.EXPECT)
+
+    def test_never_collective_reports_the_full_chain(self, results):
+        bad_res, _ = results
+        hit = next(f for f in bad_res.findings
+                   if f.rule == "never-collective")
+        assert "collect_sample" in hit.message
+        assert "parallel/multihost.py:host_barrier" in hit.message
+
+    def test_spmd_catches_all_five_guard_spellings(self, results):
+        """Lexical guard (9), guard-clause early return (16, and the
+        Get trailing it at 17), short-circuit boolean chain (21),
+        comprehension rank filter (25), rank-dependent for iteration
+        (30) — while the clean twin's verb-before-rank chain,
+        rank-dependent raise, verb-in-first-iterable comprehension,
+        and verb-after-rank-loop stay silent (short-circuit/clause
+        order means the leading verb runs on every rank; an error
+        path fails loudly; a loop does not quietly exit its block)."""
+        bad_res, clean_res = results
+        lines = {f.line for f in bad_res.findings
+                 if f.rule == "spmd-stream-guard"
+                 and f.path == "app/spmd.py"}
+        assert {9, 16, 17, 21, 25, 30} <= lines, lines
+        assert not [f for f in clean_res.findings
+                    if f.rule == "spmd-stream-guard"]
+
+
+class TestSpmdSameLineArms:
+    def test_both_ternary_arms_on_one_line_are_distinct_findings(
+            self, tmp_path):
+        """Dedup is keyed on the call node, not the line: both arms of
+        `Add(a) if rank == 0 else Get(b)` are separate violations, so
+        both are visible before anyone writes the line-scoped
+        suppression that excuses them together."""
+        root = _write_pkg(tmp_path / "p", {"app/tern.py": """\
+            def step(table, rank, a, b):
+                return table.Add(a) if rank == 0 else table.Get(b)
+            """})
+        res = run_analysis(root=root, rules=["spmd-stream-guard"])
+        whats = sorted(f.message.split("(")[0] for f in res.findings)
+        assert len(res.findings) == 2, [f.render() for f in res.findings]
+        assert "Add" in whats[0] and "Get" in whats[1], whats
+
+    def test_suppression_is_line_scoped_and_excuses_both_arms(
+            self, tmp_path):
+        """The documented noqa-like contract: one marker excuses every
+        same-rule finding on its line (the reason must speak for
+        both), and counts as used — not stale."""
+        root = _write_pkg(tmp_path / "p", {"app/tern.py": """\
+            def step(table, rank, a, b):
+                # mv-lint: ok(spmd-stream-guard): both arms single-submitter by design
+                return table.Add(a) if rank == 0 else table.Get(b)
+            """})
+        res = run_analysis(root=root, rules=["spmd-stream-guard"])
+        assert res.clean, [f.render() for f in res.findings]
+        assert len(res.suppressed) == 2, \
+            [f.render() for f in res.suppressed]
+
+
+class TestBoundedBlockingNoneBound:
+    def test_literal_none_bound_is_unbounded(self, tmp_path):
+        """t.join(None) / evt.wait(timeout=None) block forever by
+        stdlib semantics — the spelled-out-None form needs the same
+        justification as the no-argument form, while a real bound
+        passes."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            def f(t, evt):
+                t.join(None)
+                evt.wait(timeout=None)
+                evt.wait(0.5)
+                t.join(None)  # unbounded-ok: fixture justification
+            """})
+        res = run_analysis(root=root, rules=["bounded-blocking"])
+        lines = sorted(f.line for f in res.findings)
+        assert lines == [2, 3], [f.render() for f in res.findings]
+
+
+class TestHotZoneUnderGuard:
+    def test_hot_zone_method_under_module_if_is_scanned(self, tmp_path):
+        """_defs_with_quals shares the flat_body guard-flattening: a
+        hot-zone class shipped under a module-level if must not dodge
+        the hot-path-flag-cache rule."""
+        root = _write_pkg(tmp_path / "p", {"sync/server.py": """\
+            if 1 == 1:
+                class Server:
+                    def _mh_pack(self):
+                        return GetFlag("window_transport")
+            """})
+        res = run_analysis(root=root, rules=["hot-path-flag-cache"])
+        hits = [f for f in res.findings
+                if "inside hot path" in f.message]
+        assert len(hits) == 1 and hits[0].path == "sync/server.py", \
+            [f.render() for f in res.findings]
+        # the rest is module-level rot for the zones this scratch
+        # tree does not mirror — the vanished-module law
+        assert all("no file matches" in f.message
+                   for f in res.findings if f not in hits), \
+            [f.render() for f in res.findings]
+
+    def test_hot_zone_missing_module_is_config_rot(self, tmp_path):
+        """Renaming a hot-zone module away entirely must fail the
+        gate (the module-level form of config rot), not silently
+        retire the protection — same law as collective.py's root/sink
+        inventory, anchored at the config source."""
+        root = _write_pkg(tmp_path / "p", {"other/mod.py": "X = 1\n"})
+        res = run_analysis(root=root, rules=["hot-path-flag-cache"])
+        assert res.findings, "vanished hot-zone modules must report"
+        assert all("no file matches" in f.message
+                   for f in res.findings), \
+            [f.render() for f in res.findings]
+
+
+class TestWholePackageBaseline:
+    """The frozen baseline: every checker over the whole package, ZERO
+    unsuppressed findings and zero stale suppressions. One test owns
+    the full-package cost (parse + call graph), so the analysis
+    overhead in tier-1 is this test, not a per-test tax."""
+
+    def test_package_is_clean_under_every_checker(self):
+        res = run_analysis()
+        assert res.clean, "\n".join(f.render() for f in res.findings)
+        # the registry really ran all five laws (plus nothing unknown)
+        assert {c.name for c in res.checkers} == {
+            "no-bare-print", "bounded-blocking", "hot-path-flag-cache",
+            "spmd-stream-guard", "never-collective"}
+
+    def test_never_collective_rederives_the_restricted_root_set(self):
+        """The checker's root config must cover (at minimum) every
+        surface the runtime conventions already protect: ops HTTP
+        handlers, the watchdog tick, the -stats_interval_s reporter,
+        the accounting probes and the dashboard render — and each root
+        must resolve to a real graph node with a non-trivial closure
+        (a typo'd root that matches nothing would be silent)."""
+        from multiverso_tpu.analysis.collective import (
+            DEFAULT_ROOTS, DEFAULT_SINKS, NeverCollectiveChecker)
+        pkg = core.load_package()
+        checker = NeverCollectiveChecker()
+        findings = checker.check(pkg)
+        assert not [f for f in findings], \
+            "\n".join(f.render() for f in findings)
+        conventions = {
+            "ops HTTP handler": "telemetry/ops.py:_OpsHandler.do_GET",
+            "watchdog tick": "telemetry/watchdog.py:Watchdog.tick",
+            "stats reporter": "telemetry/export.py:StatsReporter._run",
+            "accounting probe": "telemetry/accounting.py:memory_report",
+            "dashboard render": "utils/dashboard.py:Dashboard.Display",
+        }
+        for label, node in conventions.items():
+            assert node in DEFAULT_ROOTS, label
+            assert node in checker.closures, label
+            # the closure walked INTO the root's callees, not just the
+            # root itself — vacuous coverage would hide regressions
+            assert len(checker.closures[node]) > 5, (label, node)
+        # the primitive inventory stays anchored on the real surfaces
+        for sink in ("parallel/multihost.py:capped_exchange",
+                     "parallel/multihost.py:host_barrier",
+                     "parallel/shm_wire.py:ShmWire.exchange",
+                     "zoo.py:Zoo._barrier_wait"):
+            assert sink in DEFAULT_SINKS
+
+    def test_every_hot_zone_matches_real_defs(self):
+        """Each HOT_ZONES entry must still name live code: a rename or
+        move of a protected module/class would otherwise retire the
+        hot-path-flag-cache rule silently while the zero-findings
+        baseline stays green. (The checker itself reports wholesale
+        per-module rot as a finding; this pins the finer per-entry
+        liveness on the real package.)"""
+        from multiverso_tpu.analysis.rules import HotPathFlagCacheChecker
+        pkg = core.load_package()
+        checker = HotPathFlagCacheChecker()
+        checker.check(pkg)
+        for zi, zone in enumerate(HotPathFlagCacheChecker.HOT_ZONES):
+            assert checker.zone_hits[zi] > 0, zone
+
+    def test_hot_zone_module_rot_is_a_finding(self, tmp_path):
+        """A tree holding a hot-zone module whose protected defs are
+        all gone (renamed away) must report config rot, not pass."""
+        root = _write_pkg(tmp_path / "p", {"sync/server.py": """\
+            class RenamedEngine:
+                def pack(self):
+                    return 1
+            """})
+        res = run_analysis(root=root, rules=["hot-path-flag-cache"])
+        assert all(f.rule == "hot-path-flag-cache"
+                   for f in res.findings)
+        defrot = [f for f in res.findings
+                  if "no def in files matching" in f.message]
+        assert defrot and defrot[0].path == "sync/server.py", \
+            [f.render() for f in res.findings]
+
+    def test_explicitly_collective_surfaces_are_not_roots(self):
+        """DisplayAll / snapshot_all_hosts are collective BY CONTRACT
+        (every rank calls them at the same point) — if someone adds
+        them as roots the whole pass goes red; pin the exclusion."""
+        from multiverso_tpu.analysis.collective import DEFAULT_ROOTS
+        assert "utils/dashboard.py:Dashboard.DisplayAll" \
+            not in DEFAULT_ROOTS
+
+
+class TestCLIContract:
+    """Exit codes: 0 clean, 1 findings, 2 usage — pinned so the pass
+    can gate future PRs from CI."""
+
+    def _main(self, argv):
+        from multiverso_tpu.analysis.cli import main
+        return main(argv)
+
+    def test_exit_0_on_clean_tree(self, capsys):
+        assert self._main(["--root", CLEAN]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_exit_1_on_findings(self, capsys):
+        assert self._main(["--root", BAD]) == 1
+        out = capsys.readouterr().out
+        assert "[no-bare-print]" in out and "[never-collective]" in out
+
+    def test_exit_2_on_unknown_rule(self, capsys):
+        assert self._main(["--rules", "no-such-rule"]) == 2
+        assert "usage error" in capsys.readouterr().out
+
+    def test_exit_2_on_empty_rules(self, capsys):
+        """--rules that names nothing (an unset CI variable
+        interpolated into --rules "$RULES,") must not run zero
+        checkers and read as a clean pass — exit 0 means every
+        checker ran."""
+        assert self._main(["--root", CLEAN, "--rules", ","]) == 2
+        assert "names no rules" in capsys.readouterr().out
+
+    def test_exit_2_on_bad_root(self, capsys):
+        assert self._main(["--root", "/no/such/dir"]) == 2
+        assert "usage error" in capsys.readouterr().out
+
+    def test_list_names_every_rule(self, capsys):
+        assert self._main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("no-bare-print", "bounded-blocking",
+                     "hot-path-flag-cache", "spmd-stream-guard",
+                     "never-collective"):
+            assert rule in out
+
+    def test_json_output_and_diag_artifact(self, tmp_path, capsys):
+        diag = str(tmp_path / "diag")
+        assert self._main(["--root", BAD, "--json",
+                           "--diag-dir", diag]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["clean"] is False
+        rules = {f["rule"] for f in payload["findings"]}
+        assert "never-collective" in rules
+        # the artifact rides the -mv_diag_dir layout (analysis_rank<R>)
+        art = os.path.join(diag, "analysis_rank0.json")
+        assert os.path.exists(art)
+        with open(art) as f:
+            assert json.load(f) == payload
+
+    def test_exit_2_on_unwritable_diag_dir(self, tmp_path, capsys):
+        """A diag-dir that cannot hold the artifact is a usage error
+        (2) — never a crash, and never exit 1 masquerading as
+        'findings present' to a CI gate."""
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("occupied")
+        assert self._main(["--root", CLEAN, "--json",
+                           "--diag-dir", str(blocker)]) == 2
+        assert "cannot write diag artifact" in capsys.readouterr().out
+
+    def test_module_entry_point_subprocess(self):
+        """One real `python -m multiverso_tpu.analysis` run (the form
+        CI invokes) — over the clean fixture tree to keep it fast."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "multiverso_tpu.analysis",
+             "--root", CLEAN],
+            capture_output=True, text=True, timeout=180, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 finding(s)" in proc.stdout

@@ -19,15 +19,12 @@ Covers the four tentpole pillars plus the satellites:
   timeout-capable path or carries an ``unbounded-ok:`` justification.
 """
 
-import re
 import threading
 import time
-from pathlib import Path
 
 import numpy as np
 import pytest
 
-import multiverso_tpu
 from multiverso_tpu.failsafe import chaos as fchaos
 from multiverso_tpu.failsafe.dedup import PENDING, DedupWindow
 from multiverso_tpu.failsafe.errors import (ActorDied, DeadlineExceeded,
@@ -368,7 +365,12 @@ class TestBlockingPathLint:
     """Every bare ``.wait()`` / ``.join()`` in the package must either
     not exist (a timeout-capable call replaced it) or carry an
     ``unbounded-ok:`` justification within the 3 preceding lines; whole
-    files may be allowlisted with a justification here."""
+    files may be allowlisted with a justification. Round-16 migration:
+    the PR 3 regex now rides the mvlint AST framework
+    (multiverso_tpu.analysis.rules.BoundedBlockingChecker) — same law
+    and the same ``unbounded-ok:`` grammar, but the AST form also
+    resolves attribute chains and calls split across lines, and knows
+    a ``timeout=`` keyword when it sees one."""
 
     FILE_ALLOW = {
         # pallas DMA semaphore waits: device-side copy completion inside
@@ -377,33 +379,23 @@ class TestBlockingPathLint:
             "pallas DMA semaphore .wait() inside traced kernels",
     }
 
-    # case-insensitive: the package's own primitives are capitalized
-    # (Waiter.Wait, ASyncBuffer.Join) and are exactly what the failsafe
-    # contract is about — a lowercase-only lint would miss them
-    _PATTERN = re.compile(r"\.(?:wait|join)\(\s*\)", re.IGNORECASE)
-
     def test_no_unbounded_wait_or_join_without_justification(self):
-        pkg = Path(multiverso_tpu.__file__).parent
-        offenders = []
-        scanned = set()
-        for py in sorted(pkg.rglob("*.py")):
-            rel = str(py.relative_to(pkg))
-            if rel in self.FILE_ALLOW:
-                continue
-            scanned.add(rel)
-            lines = py.read_text().splitlines()
-            for i, line in enumerate(lines):
-                if not self._PATTERN.search(line):
-                    continue
-                context = lines[max(0, i - 3): i + 1]
-                if any("unbounded-ok:" in ln for ln in context):
-                    continue
-                offenders.append(f"{rel}:{i + 1}: {line.strip()}")
-        # the rglob covers new subpackages by construction — pin the
+        from multiverso_tpu.analysis import run_analysis
+        from multiverso_tpu.analysis.rules import BoundedBlockingChecker
+        # the allowlist (and its justification) is part of the law
+        assert set(BoundedBlockingChecker.ALLOW) == set(self.FILE_ALLOW)
+        # case-insensitivity too: the package's own primitives are
+        # capitalized (Waiter.Wait, ASyncBuffer.Join) and are exactly
+        # what the failsafe contract is about
+        assert {"wait", "join"} == set(BoundedBlockingChecker._BLOCKING)
+        assert BoundedBlockingChecker.JUSTIFY_WINDOW == 3
+        result = run_analysis(rules=["bounded-blocking"])
+        scanned = result.checkers[0].scanned
+        # the walk covers new subpackages by construction — pin the
         # serving plane (round 8: every blocking path there must stay
         # bounded) so a future restructuring can't silently drop it
-        assert any(rel.startswith(("serving/", "serving\\"))
-                   for rel in scanned), sorted(scanned)
+        assert any(rel.startswith("serving/") for rel in scanned), \
+            sorted(scanned)
         # ...and the ops-plane modules (round 9) + the perf-forensics
         # modules (round 11) + the watchdog plane (round 13): the HTTP
         # server stop, every dump path, the watchdog tick join and the
@@ -411,17 +403,14 @@ class TestBlockingPathLint:
         for need in ("flight.py", "ops.py", "forensics.py",
                      "critpath.py", "align.py", "sketch.py",
                      "watchdog.py", "accounting.py"):
-            assert any(rel.endswith(need)
-                       and rel.startswith(("telemetry/", "telemetry\\"))
-                       for rel in scanned), sorted(scanned)
+            assert f"telemetry/{need}" in scanned, sorted(scanned)
         # ...and the round-12 shm wire: a transport with spin-waits is
         # exactly where an unbounded block would hide
-        assert any(rel.endswith("shm_wire.py")
-                   and rel.startswith(("parallel/", "parallel\\"))
-                   for rel in scanned), sorted(scanned)
-        assert not offenders, (
+        assert "parallel/shm_wire.py" in scanned, sorted(scanned)
+        assert not result.findings, (
             "unbounded blocking calls without a timeout-capable path or "
-            "an 'unbounded-ok:' justification:\n" + "\n".join(offenders))
+            "an 'unbounded-ok:' justification:\n"
+            + "\n".join(f.render() for f in result.findings))
 
     def test_blocking_primitives_expose_timeouts(self):
         """The package's own blocking primitives all take timeouts."""

@@ -35,7 +35,20 @@ class TestSelftestBinary:
         assert "ALL NATIVE TESTS OK" in result.stdout
 
 
+@pytest.mark.slow
 class TestSanitizers:
+    """Round-16: slow-marked (each sanitizer target is a full -O1
+    instrumented rebuild of the runtime when stale, plus a minutes-long
+    instrumented run) — `pytest -m slow tests/test_native.py` is the CI
+    lane. The make targets declare real file dependencies, so the
+    build step is a no-op whenever the binaries are fresh
+    (build-if-stale). The selftest now includes the PR 9/10
+    host_store.cc pool paths: concurrent ParallelFor callers racing
+    the single-owner mutex into the TryParallelFor inline fallback,
+    with the dispatch tallies (parallel/inline_busy/inline_small)
+    asserted exact — under TSAN that is precisely the fn_/done_
+    handoff race class that segfaulted before PR 9's owner lock."""
+
     def test_selftest_runs_clean_under_asan(self, native_build):
         """AddressSanitizer + UBSan sibling: heap/stack violations, leaks
         (the handle registry), and UB must stay at zero."""
