@@ -1160,6 +1160,193 @@ print(f"child {rank} SERVING BENCH OK", flush=True)
 '''
 
 
+#: replica-plane bench config: table sized so a full base is MBs (the
+#: delta-vs-full comparison means something) while the sweep stays
+#: seconds; 1% churn per publish is the ROADMAP's acceptance workload
+REP_ROWS = 20_000
+REP_COLS = 64
+REP_CHURN = REP_ROWS // 100
+REP_PUBLISHES = 5
+REP_CLIENT_THREADS = 3
+REP_CLIENT_N = 400       # lookups per client thread per measurement
+REP_BATCH = 64
+
+#: one reader CLIENT process per replica (client-side GIL must not cap
+#: the aggregate — the sweep measures the REPLICAS' scaling, so each
+#: replica gets its own client interpreter); jax-free on purpose
+_REPLICA_CLIENT_SRC = r'''
+import json, sys, threading, time
+import numpy as np
+from multiverso_tpu.replica.replica import ReplicaClient
+port, rows, batch, threads, n, seed = (int(a) for a in sys.argv[1:7])
+lat = [[] for _ in range(threads)]
+def worker(i):
+    rc = ReplicaClient("127.0.0.1", port)   # one persistent conn each
+    r = np.random.default_rng(seed + i)
+    for _ in range(n):
+        sel = np.sort(r.choice(rows, batch, replace=False))
+        t0 = time.perf_counter()
+        rc.lookup(0, sel)
+        lat[i].append(time.perf_counter() - t0)
+    rc.close()
+ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+t0 = time.perf_counter()
+for t in ts: t.start()
+for t in ts: t.join()
+secs = time.perf_counter() - t0
+all_lat = np.concatenate([np.asarray(x) for x in lat])
+print("CLIENT_RESULT " + json.dumps({
+    "qps": threads * n / secs,
+    "p99_ms": float(np.percentile(all_lat, 99) * 1e3)}), flush=True)
+'''
+
+
+def _replica_spawn(endpoint, tmpdir, idx):
+    sf = os.path.join(tmpdir, f"rep{idx}.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.replica.replica",
+         "--addr", endpoint, "--mode", "shm", "--lease", "10",
+         "--status-file", sf],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 60
+    while not os.path.exists(sf):
+        if proc.poll() is not None or time.time() > deadline:
+            out = proc.communicate(timeout=5)[0]
+            raise RuntimeError(f"bench replica {idx} never came up:\n"
+                               f"{out[-1500:]}")
+        time.sleep(0.05)
+    with open(sf) as f:
+        return proc, json.load(f)["serve_port"]
+
+
+def _replica_wait(port, version, timeout=60):
+    from multiverso_tpu.replica.replica import ReplicaClient
+    rc = ReplicaClient("127.0.0.1", port)
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if (rc.status()["latest"] or -1) >= version:
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"replica :{port} never reached v{version}")
+    finally:
+        rc.close()
+
+
+def _replica_measure(ports, tmpdir):
+    """Aggregate QPS over all replicas: one client process per replica,
+    run concurrently; each reports its own throughput."""
+    src_path = os.path.join(tmpdir, "client.py")
+    with open(src_path, "w") as f:
+        f.write(_REPLICA_CLIENT_SRC)
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    procs = [subprocess.Popen(
+        [sys.executable, src_path, str(p), str(REP_ROWS),
+         str(REP_BATCH), str(REP_CLIENT_THREADS), str(REP_CLIENT_N),
+         str(1000 * i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i, p in enumerate(ports)]
+    qps = 0.0
+    p99s = []
+    for proc in procs:
+        out, _ = proc.communicate(timeout=280)
+        if proc.returncode != 0:
+            raise RuntimeError(f"replica bench client failed:\n"
+                               f"{out[-1500:]}")
+        rec = json.loads(out.split("CLIENT_RESULT ", 1)[1].splitlines()[0])
+        qps += rec["qps"]
+        p99s.append(rec["p99_ms"])
+    return qps, max(p99s)
+
+
+def bench_replica(np, rng):
+    """-> dict of replica-plane metrics: N-replica aggregate QPS sweep
+    (1/2/4 same-host shm replicas) + delta-vs-full publish bytes on a
+    1%-churn workload."""
+    import tempfile
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.tables import MatrixTableOption
+    from multiverso_tpu.telemetry import metrics as tmetrics
+
+    mv.MV_Init(["-mv_replica_fanout=true"])
+    procs = []
+    tmp_ctx = tempfile.TemporaryDirectory(prefix="mvt_bench_replica")
+    tmpdir = tmp_ctx.name
+    try:
+        from multiverso_tpu.replica import publisher
+        endpoint = publisher.publisher_endpoint()
+        mat = mv.MV_CreateTable(MatrixTableOption(num_rows=REP_ROWS,
+                                                  num_cols=REP_COLS))
+        chunk = 5000
+        for lo in range(0, REP_ROWS, chunk):
+            ids = np.arange(lo, lo + chunk, dtype=np.int32)
+            mat.AddRows(ids, rng.standard_normal(
+                (chunk, REP_COLS)).astype(np.float32))
+        v = mv.MV_PublishSnapshot()
+
+        def counter(name):
+            return tmetrics.snapshot().get(name, {}).get("value", 0)
+
+        qps_by_n = {}
+        p99_by_n = {}
+        for want in (1, 2, 4):
+            while len(procs) < want:
+                procs.append(_replica_spawn(endpoint, tmpdir,
+                                            len(procs)))
+                _replica_wait(procs[-1][1], v)
+            qps, p99 = _replica_measure([p for _, p in procs], tmpdir)
+            qps_by_n[want] = round(qps)
+            p99_by_n[want] = round(p99, 3)
+
+        # delta-vs-full: 1% churn per publish, 4 live subscribers —
+        # per-replica delta bytes must sit far under the full table
+        full_bytes = REP_ROWS * REP_COLS * 4
+        before = counter("replica.fanout_bytes")
+        for _ in range(REP_PUBLISHES):
+            sel = rng.choice(REP_ROWS, REP_CHURN,
+                             replace=False).astype(np.int32)
+            mat.AddRows(sel, rng.standard_normal(
+                (REP_CHURN, REP_COLS)).astype(np.float32))
+            v = mv.MV_PublishSnapshot()
+        for _, port in procs:
+            _replica_wait(port, v)
+        delta_bytes = (counter("replica.fanout_bytes") - before) \
+            / (REP_PUBLISHES * len(procs))
+        return {
+            "replica_lookup_qps": qps_by_n[1],
+            "replica_lookup_p99_ms": p99_by_n[1],
+            "replica_2rep_aggregate_qps": qps_by_n[2],
+            "replica_4rep_aggregate_qps": qps_by_n[4],
+            "replica_2rep_scaling_x": round(qps_by_n[2]
+                                            / max(qps_by_n[1], 1), 2),
+            "replica_4rep_scaling_x": round(qps_by_n[4]
+                                            / max(qps_by_n[1], 1), 2),
+            "replica_delta_publish_bytes": round(delta_bytes),
+            "replica_full_table_bytes": full_bytes,
+            "replica_delta_vs_full_pct": round(
+                100.0 * delta_bytes / full_bytes, 2),
+            "replica_config": (
+                f"{REP_ROWS}x{REP_COLS} f32 matrix; shm fan-out; "
+                f"{REP_CLIENT_THREADS} client threads x {REP_CLIENT_N} "
+                f"lookups of {REP_BATCH} rows per replica (one client "
+                f"process per replica); {100 * REP_CHURN / REP_ROWS:.0f}"
+                f"%-churn deltas over {REP_PUBLISHES} publishes with "
+                f"every replica subscribed"),
+        }
+    finally:
+        for proc, _ in procs:
+            proc.terminate()
+        for proc, _ in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+        mv.MV_ShutDown()
+        tmp_ctx.cleanup()
+
+
 def serving_two_proc_numbers() -> dict:
     """2-proc serving-plane read metrics (concurrent-reader harness):
     the blocking baseline pays one window exchange per Get round while
@@ -2338,7 +2525,9 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
             "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec",
             "serving_lookup_qps", "serving_lookup_p99_ms",
             "serving_lookup_2proc_qps", "serving_lookup_2proc_p99_ms",
-            "elastic_rebalance_pause_ms")
+            "elastic_rebalance_pause_ms",
+            "replica_lookup_qps", "replica_2rep_aggregate_qps",
+            "replica_delta_vs_full_pct")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key
@@ -2413,6 +2602,35 @@ if __name__ == "__main__":
         sys.exit(0)
     if sys.argv[1:2] == ["--serving"]:
         sys.exit(serving_section_main())
+    if sys.argv[1:2] == ["--replica"]:
+        # standalone replica-plane section (same-host shm fan-out sweep
+        # + delta-vs-full bytes), merged into the artifact when the
+        # platform/host match (the --serving pattern)
+        jax, platform = _init_jax_guarded()
+        import numpy as np
+        res = bench_replica(np, np.random.default_rng(0))
+        try:
+            with open(FULL_JSON_PATH) as f:
+                data = json.load(f)
+        except Exception as exc:
+            data = None
+            print(f"NOT merged: no readable full-run artifact at "
+                  f"{FULL_JSON_PATH} ({exc!r}) — run `python bench.py` "
+                  f"first")
+        if data is not None:
+            if (data.get("platform") == platform
+                    and data.get("host_cores") == os.cpu_count()):
+                data.update(res)
+                with open(FULL_JSON_PATH, "w") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"merged replica metrics into {FULL_JSON_PATH}")
+            else:
+                print(f"NOT merged: artifact platform/host "
+                      f"{data.get('platform')}/{data.get('host_cores')}"
+                      f" != {platform}/{os.cpu_count()}")
+        print(json.dumps(res, indent=1, sort_keys=True))
+        sys.exit(0)
     if sys.argv[1:2] == ["--update-doc"]:
         if len(sys.argv) < 3:
             print("usage: bench.py --update-doc <bench-json>",

@@ -51,6 +51,15 @@ DEFAULT_ROOTS: Dict[str, str] = {
         "local dashboard render (DisplayAll is the collective sibling)",
     "utils/dashboard.py:Dashboard._ops_lines":
         "dashboard [Ops] line (renders during teardown)",
+    # round 17 — replica plane: the reader process's serve loop (no
+    # SPMD stream exists in that process at all) and the trainer's
+    # fan-out thread (runs beside the engine; its per-replica ring is
+    # point-to-point to a non-SPMD reader and carries a reasoned
+    # suppression at the def — see replica/publisher.py)
+    "replica/replica.py:_LookupHandler.handle":
+        "replica lookup serve loop (jax-free reader process)",
+    "replica/publisher.py:ReplicaPublisher._run":
+        "replica fan-out thread (ships beside the engine stream)",
 }
 
 #: collective primitives: node id -> what it is

@@ -125,6 +125,16 @@ def is_departed() -> bool:
     return _state.departed
 
 
+def coordinator_endpoint():
+    """(host, port) of the live membership coordinator, or None when
+    the plane is down — the replica plane reuses this endpoint for its
+    subscription registry instead of hosting a second authority."""
+    st = _state
+    if not st.enabled or st.client is None:
+        return None
+    return (st.client.host, st.client.port)
+
+
 def _lease_s() -> float:
     lease = float(GetFlag("mv_elastic_lease_s"))
     if lease > 0:

@@ -49,6 +49,21 @@ idempotent or rendezvous-shaped, so the client may retry transients
                     after any transition the group rides this relay).
 * ``state``       — observability snapshot for /healthz + dashboards.
 
+**Replica members (round 17).** ``replica_*`` ops implement the plane's
+second member class: a *replica* is a genuinely NEW process (never part
+of the boot world, never touching ``jax.distributed``) with
+``role=replica`` — a heartbeat lease exactly like an SPMD member's, but
+NO verb stream, no epoch view membership, and no shard ownership. It
+subscribes to published snapshot versions and (in relay mode) receives
+fan-out blobs through a per-replica mailbox here, riding the same
+length-prefixed CRC-framed socket protocol as every other op; same-host
+replicas only rendezvous here (join/lease/ack) while their bytes ride a
+dedicated shm ring. A replica whose lease expires is declared dead by
+whichever op next evaluates leases and its subscription is evicted by
+the publisher's next fan-out tick — the SPMD world never blocks on a
+replica, which is what keeps the read tier failure-isolated from the
+training stream.
+
 Coordinator failover is out of scope (as is the jax.distributed
 coordinator's): rank 0 cannot drain, and its death ends the world.
 """
@@ -120,6 +135,41 @@ class _MemberRec:
                 and now - self.last_hb > self.lease_s)
 
 
+class _ReplicaRec:
+    """One subscribed replica (``role=replica``): lease + fan-out
+    bookkeeping. Not an epoch-view member — replicas have no verb
+    stream and never appear in transitions."""
+
+    __slots__ = ("rid", "mode", "token", "ring_bytes", "lease_s",
+                 "last_hb", "status", "acked_version", "needs_base",
+                 "mailbox", "joined_at")
+
+    def __init__(self, rid: int, mode: str, token: str, ring_bytes: int,
+                 lease_s: float):
+        self.rid = rid
+        self.mode = mode              # "shm" | "relay"
+        self.token = token            # shm session token ("" for relay)
+        self.ring_bytes = int(ring_bytes)
+        self.lease_s = float(lease_s)
+        self.last_hb = time.monotonic()
+        self.status = "live"          # live | dead | evicted
+        self.acked_version = -1
+        self.needs_base = True
+        #: relay-mode fan-out mailbox: [(version, blob)], bounded
+        self.mailbox: list = []
+        self.joined_at = time.time()
+
+    def expired(self, now: float) -> bool:
+        return (self.status == "live"
+                and now - self.last_hb > self.lease_s)
+
+
+#: relay-mode mailbox bound: a replica this far behind gets its queue
+#: dropped and a fresh base instead (lag handling, not backpressure on
+#: the trainer)
+_REPLICA_MAILBOX_CAP = 4
+
+
 class Coordinator:
     """The rank-0 membership authority. Thread-per-connection TCP
     server; all state under one lock + condition (rendezvous ops wait
@@ -152,6 +202,12 @@ class Coordinator:
         self._shard_dups = 0
         #: commit rendezvous: epoch -> set of committed members
         self._commits: Dict[int, set] = {}
+        #: replica subscriptions (role=replica — NOT epoch members)
+        self._replicas: Dict[int, _ReplicaRec] = {}
+        self._next_rid = 1
+        #: newest published version the publisher announced (replica
+        #: heartbeats answer lag from this without touching the trainer)
+        self._replica_latest = -1
         #: group transport: (epoch, key, idx) -> {member: blob}; once
         #: complete the ordered blob list parks in _xchg_results until
         #: every participant has read it
@@ -641,7 +697,132 @@ class Coordinator:
                            if self._transition else None),
                 "shard_frames": len(self._shards),
                 "shard_dedup_hits": self._shard_dups,
+                "replicas": {r.rid: r.status
+                             for r in self._replicas.values()},
             }
+
+    # -- replica subscriptions (role=replica — round 17) ---------------------
+
+    def _reap_replicas(self, now: Optional[float] = None) -> list:
+        """Mark lease-expired live replicas dead; returns newly dead
+        rids. Caller holds the lock. Unlike member reaping this stages
+        NO transition — replicas are not epoch members; the publisher's
+        next fan-out tick evicts the subscription."""
+        now = time.monotonic() if now is None else now
+        dead = []
+        for rec in self._replicas.values():
+            if rec.expired(now):
+                rec.status = "dead"
+                rec.mailbox = []
+                dead.append(rec.rid)
+                Log.Error("elastic: replica %d lease expired (%.1fs) — "
+                          "declared dead", rec.rid, rec.lease_s)
+        if dead:
+            tmetrics.counter("replica.lease_expirations").inc(len(dead))
+            self._cv.notify_all()
+        return dead
+
+    def _op_replica_join(self, req: dict) -> dict:
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            rec = _ReplicaRec(rid, str(req.get("mode", "relay")),
+                              str(req.get("token", "")),
+                              int(req.get("ring_bytes", 0)),
+                              float(req.get("lease_s", 5.0)))
+            self._replicas[rid] = rec
+            self._cv.notify_all()
+            Log.Info("elastic: replica %d joined (mode=%s, lease %.1fs)",
+                     rid, rec.mode, rec.lease_s)
+            return {"rid": rid, "latest": self._replica_latest}
+
+    def _op_replica_hb(self, req: dict) -> dict:
+        with self._lock:
+            rec = self._replicas.get(int(req["rid"]))
+            if rec is None or rec.status != "live":
+                return {"evicted": True, "latest": self._replica_latest}
+            rec.last_hb = time.monotonic()
+            return {"evicted": False, "latest": self._replica_latest,
+                    "acked": rec.acked_version}
+
+    def _op_replica_ack(self, req: dict) -> dict:
+        with self._lock:
+            rec = self._replicas.get(int(req["rid"]))
+            if rec is None or rec.status != "live":
+                return {"evicted": True}
+            rec.last_hb = time.monotonic()
+            rec.acked_version = max(rec.acked_version,
+                                    int(req["version"]))
+            rec.needs_base = False
+            return {"evicted": False}
+
+    def _op_replica_roster(self, req: dict) -> dict:
+        """Publisher-side poll: announce the newest published version,
+        reap expired replica leases, and return the full subscription
+        roster (dead/evicted included — /healthz names departures)."""
+        with self._lock:
+            if "latest" in req and req["latest"] is not None:
+                self._replica_latest = max(self._replica_latest,
+                                           int(req["latest"]))
+            self._reap_replicas()
+            return {"replicas": [
+                {"rid": r.rid, "mode": r.mode, "token": r.token,
+                 "ring_bytes": r.ring_bytes, "status": r.status,
+                 "acked": r.acked_version, "needs_base": r.needs_base,
+                 "mailbox_depth": len(r.mailbox)}
+                for r in sorted(self._replicas.values(),
+                                key=lambda r: r.rid)]}
+
+    def _op_replica_evict(self, req: dict) -> dict:
+        with self._lock:
+            rec = self._replicas.get(int(req["rid"]))
+            if rec is not None and rec.status != "evicted":
+                rec.status = "evicted"
+                rec.mailbox = []
+                self._cv.notify_all()
+                Log.Info("elastic: replica %d subscription evicted",
+                         rec.rid)
+            return {"ok": True}
+
+    def _op_replica_put(self, req: dict) -> dict:
+        """Relay-mode fan-out: park one (version, blob) in the
+        replica's mailbox. Overflow drops the queue and flags a fresh
+        base — a laggard must resync, never backpressure the
+        trainer."""
+        with self._lock:
+            rec = self._replicas.get(int(req["rid"]))
+            if rec is None or rec.status != "live":
+                return {"evicted": True}
+            if len(rec.mailbox) >= _REPLICA_MAILBOX_CAP:
+                rec.mailbox = []
+                rec.needs_base = True
+                tmetrics.counter("replica.mailbox_overflows").inc()
+                return {"evicted": False, "overflow": True}
+            rec.mailbox.append((int(req["version"]), req["blob"]))
+            self._cv.notify_all()
+            return {"evicted": False, "overflow": False}
+
+    def _op_replica_fetch(self, req: dict) -> dict:
+        """Relay-mode replica side: block until the mailbox holds a
+        blob (a fetch is also a liveness signal — it refreshes the
+        lease while parked). Times out typed like every rendezvous."""
+        rid = int(req["rid"])
+        deadline = time.monotonic() + float(req.get("timeout") or 60.0)
+        with self._lock:
+            while True:
+                rec = self._replicas.get(rid)
+                if rec is None or rec.status != "live":
+                    return {"evicted": True}
+                rec.last_hb = time.monotonic()
+                if rec.mailbox:
+                    version, blob = rec.mailbox.pop(0)
+                    return {"evicted": False, "version": version,
+                            "blob": blob}
+                if time.monotonic() > deadline:
+                    raise TransientError(
+                        f"replica {rid} fetch: nothing published within "
+                        f"the timeout")
+                self._cv.wait(0.1)
 
 
 class MemberClient:

@@ -8,14 +8,35 @@ engine (reference src/net/allreduce_engine.cpp) is replaced by ``psum`` —
 XLA picks the wire algorithm per size/topology, which is exactly the
 size-adaptive choice AllreduceEngine made by hand
 (reference allreduce_engine.cpp:31-55).
+
+The mesh/allreduce re-exports are LAZY (PEP 562): ``mesh`` and
+``allreduce`` import jax at module level, but this package also hosts
+the jax-free transport tier (``multihost``, ``shm_wire``, ``seal``) the
+replica plane's reader processes ride — importing those submodules must
+not pull jax through this ``__init__``.
 """
 
-from multiverso_tpu.parallel.mesh import (  # noqa: F401
-    MeshContext,
-    build_mesh,
-    partition_offsets,
-)
-from multiverso_tpu.parallel.allreduce import (  # noqa: F401
-    RendezvousAllreduce,
-    device_allreduce,
-)
+_LAZY = {
+    "MeshContext": "multiverso_tpu.parallel.mesh",
+    "build_mesh": "multiverso_tpu.parallel.mesh",
+    "partition_offsets": "multiverso_tpu.parallel.mesh",
+    "RendezvousAllreduce": "multiverso_tpu.parallel.allreduce",
+    "device_allreduce": "multiverso_tpu.parallel.allreduce",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is not None:
+        import importlib
+        value = getattr(importlib.import_module(mod), name)
+        globals()[name] = value
+        return value
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,61 @@
+"""CRC32 frame sealing — the one corruption posture, jax-free.
+
+Factored out of :mod:`multiverso_tpu.parallel.wire` (round 17) so the
+replica plane's jax-free reader processes can seal/verify fan-out blobs
+without importing the verb codec (``wire.py`` pulls
+``updaters.base`` → jax for its Add/GetOption tags — a read-tier
+process must stay numpy-only). ``wire.py`` re-exports everything here,
+so every existing call site keeps working and the posture stays ONE
+implementation: a little-endian CRC32 trailer over the body, verified
+BEFORE any parsing, raising the typed ``WireCorruption`` (and counting
+``wire.crc_failures``) on mismatch or truncation.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+from multiverso_tpu.failsafe.errors import WireCorruption
+
+#: every sealed blob carries a little-endian CRC32 trailer over all
+#: preceding bytes: a flipped bit or truncated frame raises
+#: WireCorruption at open instead of materializing garbage
+CRC_TRAILER_BYTES = 4
+
+_U32 = struct.Struct("<I")
+
+
+def _seal(body: bytes) -> bytes:
+    """Append the CRC32 trailer (little-endian u32 over ``body``)."""
+    return body + _U32.pack(zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def seal_frame(body: bytes) -> bytes:
+    """Public sealing for satellite planes (elastic shard moves,
+    replica fan-out blobs): the same CRC32 trailer every window blob
+    carries, so one corruption posture covers every byte that crosses
+    a process boundary."""
+    return _seal(body)
+
+
+def open_frame(blob: bytes) -> bytes:
+    """Verify + strip a :func:`seal_frame` trailer; raises
+    ``WireCorruption`` (counting ``wire.crc_failures``) on mismatch."""
+    check_crc(blob)
+    return blob[:-CRC_TRAILER_BYTES]
+
+
+def check_crc(blob: bytes) -> None:
+    """Verify a sealed blob's CRC32 trailer; raises ``WireCorruption``
+    (counting ``wire.crc_failures``) on mismatch or truncation. Runs
+    BEFORE any parsing so corrupt bytes never reach the decoders."""
+    ok = len(blob) > CRC_TRAILER_BYTES and (
+        zlib.crc32(blob[:-CRC_TRAILER_BYTES]) & 0xFFFFFFFF
+        == _U32.unpack_from(blob, len(blob) - CRC_TRAILER_BYTES)[0])
+    if not ok:
+        from multiverso_tpu.telemetry import metrics as _tmetrics
+        _tmetrics.counter("wire.crc_failures").inc()
+        raise WireCorruption(
+            f"wire blob failed CRC32 check ({len(blob)} bytes) — "
+            f"corrupted or truncated frame")

@@ -285,9 +285,15 @@ class ShmWire:
         return [(off, min(self.cap, len(blob) - off))
                 for off in range(0, len(blob), self.cap)]
 
-    def exchange(self, blob: bytes, channel: int) -> List[bytes]:
+    def exchange(self, blob: bytes, channel: int,
+                 timeout_s: Optional[float] = None) -> List[bytes]:
         """Every rank's blob for this channel's next round, rank order.
-        Collective per channel; bounded by ``-mv_deadline_s``."""
+        Collective per channel; bounded by ``-mv_deadline_s``, or by
+        ``timeout_s`` when given (the replica fan-out thread passes its
+        lease-derived bound explicitly — a dead reader must cost one
+        bounded wait, whatever the engine's deadline flag says). NOTE a
+        timed-out exchange leaves the channel's round counter advanced:
+        the caller must scrap the wire, never retry the round."""
         CHECK(not self._closed, "shm wire used after close")
         CHECK(0 <= channel < self.channels,
               f"shm wire channel {channel} out of range "
@@ -312,7 +318,8 @@ class ShmWire:
         rstate = {r: [None, None, 0, False, 0, 0] for r in peers}
         wseq0 = self._wseq[channel]
         wi = 0                        # next own chunk to write
-        deadline = fdeadline.timeout_or_none()
+        deadline = (timeout_s if timeout_s is not None
+                    else fdeadline.timeout_or_none())
         t0 = time.perf_counter()
         last_probe = t0
         spins = 0

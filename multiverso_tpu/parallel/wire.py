@@ -59,23 +59,23 @@ from __future__ import annotations
 
 import pickle
 import struct
-import zlib
 from typing import List, Tuple
 
 import numpy as np
 
 from multiverso_tpu.failsafe.errors import WireCorruption
+# sealing lives in parallel/seal.py (jax-free — the replica plane's
+# reader processes verify fan-out blobs without importing this codec's
+# updater-option tags); re-exported here so every call site keeps one
+# import home and one corruption posture
+from multiverso_tpu.parallel.seal import (  # noqa: F401
+    CRC_TRAILER_BYTES, _seal, check_crc, open_frame, seal_frame)
 from multiverso_tpu.updaters.base import AddOption, GetOption
 
 #: first byte of every exchanged blob — lets the far side tell a verb
 #: window from a non-verb head marker (and catch format drift loudly)
 KIND_WINDOW = 0x57      # 'W'
 KIND_HEAD_BARRIER = 0x42  # 'B'
-
-#: every blob carries a little-endian CRC32 trailer over all preceding
-#: bytes: a flipped bit or truncated frame raises WireCorruption at
-#: decode instead of materializing garbage arrays (failsafe subsystem)
-CRC_TRAILER_BYTES = 4
 
 _U8 = struct.Struct("<B")
 _U32 = struct.Struct("<I")
@@ -245,40 +245,6 @@ def _encode_value(parts: list, v) -> None:
         parts.append(b"p")
         parts.append(_I64.pack(len(pb)))
         parts.append(pb)
-
-
-def _seal(body: bytes) -> bytes:
-    """Append the CRC32 trailer (little-endian u32 over ``body``)."""
-    return body + _U32.pack(zlib.crc32(body) & 0xFFFFFFFF)
-
-
-def seal_frame(body: bytes) -> bytes:
-    """Public sealing for satellite planes (elastic shard moves): the
-    same CRC32 trailer every window blob carries, so one corruption
-    posture covers every byte that crosses a process boundary."""
-    return _seal(body)
-
-
-def open_frame(blob: bytes) -> bytes:
-    """Verify + strip a :func:`seal_frame` trailer; raises
-    ``WireCorruption`` (counting ``wire.crc_failures``) on mismatch."""
-    check_crc(blob)
-    return blob[:-CRC_TRAILER_BYTES]
-
-
-def check_crc(blob: bytes) -> None:
-    """Verify a sealed blob's CRC32 trailer; raises ``WireCorruption``
-    (counting ``wire.crc_failures``) on mismatch or truncation. Runs
-    BEFORE any parsing so corrupt bytes never reach the decoders."""
-    ok = len(blob) > CRC_TRAILER_BYTES and (
-        zlib.crc32(blob[:-CRC_TRAILER_BYTES]) & 0xFFFFFFFF
-        == _U32.unpack_from(blob, len(blob) - CRC_TRAILER_BYTES)[0])
-    if not ok:
-        from multiverso_tpu.telemetry import metrics as _tmetrics
-        _tmetrics.counter("wire.crc_failures").inc()
-        raise WireCorruption(
-            f"wire blob failed CRC32 check ({len(blob)} bytes) — "
-            f"corrupted or truncated frame")
 
 
 def encode_window(verbs: List[Tuple[str, int, dict]],

@@ -1,0 +1,128 @@
+"""Replica plane: delta-snapshot fan-out and a jax-free read tier.
+
+PR 5's serving plane splits reads from the verb stream but still serves
+them from the TRAINING process — every reader shares its cores and GIL
+(~3k GIL-bound verbs/s measured, PR 9), capping QPS far below the north
+star. This package is the classic parameter-server read/update split
+(Li et al., OSDI'14) taken to separate processes:
+
+* :mod:`delta` — the versioned delta codec: per-table "rows dirtied
+  since version V" blobs (the SparseMatrixTable dirty-row idiom lifted
+  to a publish journal for matrix/sparse, a write-set journal for
+  kv/array), a full-base blob for first join, all sealed with the PR 3
+  CRC trailer (``parallel/seal.py``), plus the replica-side mirror
+  store that applies them.
+* :mod:`publisher` — the trainer side: ``MV_PublishSnapshot``'s capture
+  hook drains each table's journal at the fenced cut, and a fan-out
+  thread ships base+delta blobs to subscribed replicas — same-host
+  replicas over dedicated PR 9 shm-ring channels (1.9–2.4 GB/s
+  measured), remote replicas over the PR 7 coordinator's
+  length-prefixed CRC-framed socket relay.
+* :mod:`replica` — the jax-free (numpy-only import path, asserted)
+  reader process: joins through the coordinator as a non-SPMD
+  ``role=replica`` member with a heartbeat lease but NO verb stream,
+  maintains local version mirrors under the same retention/pin
+  contract as ``SnapshotStore``, and serves lookups through a reused
+  ``ServingFrontend`` (admission/micro-batch/shed semantics identical,
+  host gather path only).
+
+Flags live HERE so zoo's eager import registers them before MV_Init's
+ParseCMDFlags (the sync/server.py flag-home rule).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from multiverso_tpu.utils.configure import (MV_DEFINE_bool,
+                                            MV_DEFINE_double,
+                                            MV_DEFINE_int,
+                                            MV_DEFINE_string)
+
+MV_DEFINE_bool("mv_replica_fanout", False,
+               "replica plane: journal per-table publish dirty sets and "
+               "fan published snapshots out to subscribed replica "
+               "reader processes as versioned base+delta blobs "
+               "(same-host: shm ring; remote: coordinator relay)")
+MV_DEFINE_string("mv_replica_addr", "",
+                 "replica subscription coordinator endpoint host:port. "
+                 "Empty: reuse the elastic coordinator when -mv_elastic "
+                 "is up, else rank 0 hosts one on loopback with an "
+                 "ephemeral port (single-process worlds; multi-process "
+                 "worlds without -mv_elastic must name a port)")
+MV_DEFINE_int("mv_replica_ring_bytes", 8 << 20,
+              "per-subscriber shm fan-out ring capacity (same-host "
+              "replicas); frames larger than this ship as multiple "
+              "flow-controlled chunks")
+MV_DEFINE_double("mv_replica_lease_s", 0.0,
+                 "replica heartbeat lease: a replica silent for this "
+                 "long is declared dead and its subscription evicted "
+                 "at the next fan-out tick (0 = derive from "
+                 "-mv_deadline_s like the elastic lease, floor 2s, "
+                 "default 5s)")
+
+from multiverso_tpu.replica import delta  # noqa: E402,F401
+
+
+def start_plane(zoo) -> bool:
+    """Bring the publisher up when ``-mv_replica_fanout`` is set
+    (Zoo.Start). Returns True when active on this rank."""
+    from multiverso_tpu.replica import publisher
+    return publisher.start_plane(zoo)
+
+
+def shutdown_plane() -> None:
+    """Stop the fan-out thread and drop every subscription wire
+    (Zoo.Stop)."""
+    from multiverso_tpu.replica import publisher
+    publisher.shutdown_plane()
+
+
+def note_publish(engine, snap) -> None:
+    """Publish-cut hook (serving/snapshot._capture_all, ON the engine
+    thread with every stream fenced): drain the per-table journals into
+    the dirty-set record for ``snap.version`` and kick the fan-out
+    thread. No-op (one attribute read) when the plane is off."""
+    from multiverso_tpu.replica import publisher
+    publisher.note_publish(engine, snap)
+
+
+def maybe_attach_journal(server_table) -> None:
+    """RegisterTable hook (sync/server.py): attach the publish dirty
+    journal when this rank fans out. No-op when the plane is off."""
+    from multiverso_tpu.replica import publisher
+    publisher.maybe_attach_journal(server_table)
+
+
+def status_report() -> Optional[dict]:
+    """Local publisher view for /healthz (per-replica lines) — never
+    collective, served from the fan-out thread's cached roster."""
+    from multiverso_tpu.replica import publisher
+    return publisher.status_report()
+
+
+def peek_sample() -> Optional[dict]:
+    """Watchdog probe: {replica_subscribers, replica_lag_versions} from
+    local publisher state, or None when the plane is off."""
+    from multiverso_tpu.replica import publisher
+    return publisher.peek_sample()
+
+
+def ledger_bytes() -> Optional[dict]:
+    """Accounting-ledger probe: journal + retained dirty-set bytes on
+    the fan-out rank (None when the plane is off)."""
+    from multiverso_tpu.replica import publisher
+    return publisher.ledger_bytes()
+
+
+def status_lines() -> List[str]:
+    """Dashboard line for DisplayAll — [] when the plane never ran."""
+    rep = status_report()
+    if rep is None:
+        return []
+    subs = rep.get("subscribers", [])
+    live = [s for s in subs if s.get("state") == "live"]
+    return ["[Replica] subscribers = %d live / %d known, latest = v%s, "
+            "max_lag = %s, fanout = %d bytes" % (
+                len(live), len(subs), rep.get("latest"),
+                rep.get("max_lag"), rep.get("fanout_bytes", 0))]

@@ -1,0 +1,441 @@
+"""Trainer-side replica fan-out: journals at the cut, ships off it.
+
+Two halves, split exactly where the SPMD stream's soundness demands:
+
+* :func:`note_publish` runs ON the engine thread INSIDE the publish
+  cut (serving/snapshot._capture_all, every stream fenced): it drains
+  each table's publish journal into the dirty-set record for the new
+  version and kicks the fan-out thread. Local numpy only, zero
+  collectives, a few microseconds — the cut pays nothing for fan-out.
+* The fan-out THREAD does everything slow: polls the subscription
+  roster (coordinator RPC), encodes base/delta blobs from the
+  IMMUTABLE retained snapshots (never the live tables), and ships them
+  — same-host subscribers over a dedicated per-replica shm ring
+  (PR 9's transport, 2-proc point-to-point, its own session token so
+  it can never collide with the engine wire's channels), remote
+  subscribers through the coordinator's relay mailbox.
+
+Failure isolation: a replica that stalls or dies costs ONE bounded
+ring wait (lease-derived ``timeout_s`` passed straight to
+``ShmWire.exchange``) and is then evicted — the SPMD world never
+blocks on the read tier. Eviction is driven by the same heartbeat
+lease machinery SPMD members ride (coordinator ``replica_*`` ops).
+
+Delta policy: a subscriber acked at version V gets
+``delta(V -> latest)`` when every interval dirty set V+1..latest is
+still retained (retention tracks ``-mv_serving_keep`` plus slack),
+else a fresh base. The delta applies to any replica state in
+``[V, latest]`` (delta.py's applicability rule), so ack lag can never
+corrupt a mirror — at worst it ships a few already-applied rows.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+                                            WireCorruption)
+from multiverso_tpu.replica import delta as rdelta
+from multiverso_tpu.telemetry import flight as tflight
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.configure import (GetFlag, cached_bool_flag,
+                                            cached_int_flag)
+from multiverso_tpu.utils.log import CHECK, Log
+
+_fanout_flag = cached_bool_flag("mv_replica_fanout", False)
+_ring_flag = cached_int_flag("mv_replica_ring_bytes", 8 << 20)
+_keep_flag = cached_int_flag("mv_serving_keep", 2)
+
+#: fan-out thread idle poll (roster refresh between publishes — new
+#: subscribers get their base without waiting for the next publish)
+_POLL_S = 0.25
+
+#: control-RPC bound for the fan-out thread's coordinator calls
+_RPC_TIMEOUT_S = 10.0
+
+
+def _lease_s() -> float:
+    lease = float(GetFlag("mv_replica_lease_s"))
+    if lease > 0:
+        return lease
+    from multiverso_tpu.failsafe import deadline as fdeadline
+    dl = fdeadline.deadline_s()
+    return max(2.0, 0.8 * dl) if dl > 0 else 5.0
+
+
+class ReplicaPublisher:
+    """Per-process fan-out state. Only the fan-out OWNER rank (boot
+    rank 0 — the rank that already hosts every coordinator) journals
+    and ships; other ranks keep the plane object as an inert flag
+    holder so the hooks stay one attribute read."""
+
+    def __init__(self, zoo, active: bool):
+        self.zoo = zoo
+        self.active = active
+        self.client = None              #: coordinator RPC client
+        self.endpoint: Optional[str] = None
+        self._own_coordinator = None    #: hosted here when no elastic
+        self.lease_s = _lease_s()
+        self._lock = threading.Lock()
+        #: version -> {tid: dirty descriptor} (interval prev->version)
+        self._dirty: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+        self.latest = -1
+        self.fanout_bytes = 0
+        self._subs: Dict[int, dict] = {}    #: rid -> local ship state
+        self._roster: List[dict] = []       #: last roster (healthz)
+        self.max_lag = 0
+        self._kick = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # EAGER registration (the PR 6 rule): the replica family
+        # scrapes at zero from the first /metrics read
+        self._t_bytes = tmetrics.counter("replica.fanout_bytes")
+        self._t_blobs = tmetrics.counter("replica.fanout_blobs")
+        self._t_evicted = tmetrics.counter("replica.evictions")
+        self._t_subs = tmetrics.gauge("replica.subscribers")
+        self._t_lag = tmetrics.gauge("replica.lag_versions")
+
+    # -- engine-thread half (inside the cut) --------------------------------
+
+    def record_cut(self, engine, snap) -> None:
+        """Drain every table's journal at the fenced cut: the dirty
+        descriptor for the interval (previous publish, ``snap``]."""
+        descs: Dict[int, dict] = {}
+        for tid, table in enumerate(engine.store_):
+            if tid not in snap.tables:
+                # family without a serving export: nothing to fan out,
+                # but its journal still DRAINS (a kv write-set left
+                # undrained would grow without bound across cuts)
+                j = getattr(table, "_pub_journal", None)
+                if j is not None:
+                    j.drain()
+                continue
+            journal = getattr(table, "_pub_journal", None)
+            if journal is None:
+                # registered before the plane was up (or a family that
+                # grew an export later): no coverage for THIS interval
+                # — the merge turns that into a full payload, and the
+                # fresh journal covers every later interval
+                table._pub_journal = rdelta.journal_for_table(table)
+                descs[tid] = {"kind": "all"}
+            else:
+                descs[tid] = journal.drain()
+        keep = max(4, _keep_flag() + 2)
+        with self._lock:
+            self._dirty[snap.version] = descs
+            while len(self._dirty) > keep:
+                self._dirty.popitem(last=False)
+            self.latest = snap.version
+        self._kick.set()
+
+    def _merged_descs(self, acked: int,
+                      target_snap) -> Optional[Dict[int, dict]]:
+        """Per-table dirty union over (acked, target]; None = a base is
+        needed (some interval's record already pruned)."""
+        with self._lock:
+            need = range(acked + 1, target_snap.version + 1)
+            if any(v not in self._dirty for v in need):
+                return None
+            per_version = [self._dirty[v] for v in need]
+        out: Dict[int, dict] = {}
+        for tid in target_snap.tables:
+            # a tid absent from an interval's record did not exist at
+            # that cut -> merge_descriptors(None) -> full payload
+            out[tid] = rdelta.merge_descriptors(
+                [d.get(tid) for d in per_version])
+        return out
+
+    # -- fan-out thread -----------------------------------------------------
+
+    def start(self) -> None:
+        if not self.active or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run,
+                                        name="mv-replica-fanout",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:  # mv-lint: ok(never-collective): the only reachable "collective" is ShmWire.exchange on a per-replica 2-proc fan-out ring with its own session token — a point-to-point channel to a non-SPMD reader, bounded by an explicit lease timeout; no SPMD rank ever participates, so it cannot interleave with the engine's window streams
+        while not self._stop.is_set():
+            self._kick.wait(_POLL_S)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._tick()
+            except Exception as exc:    # the fan-out must never die
+                if self._stop.is_set():
+                    return      # shutdown closed a wire under a stuck
+                                # ship — the abandonment is already
+                                # logged by stop()
+                Log.Error("replica fan-out tick failed: %r", exc)
+
+    def _tick(self) -> None:
+        from multiverso_tpu.serving import peek_plane
+        resp = self.client.call(
+            "replica_roster", timeout=_RPC_TIMEOUT_S,
+            latest=self.latest if self.latest >= 0 else None)
+        roster = resp["replicas"]
+        plane = peek_plane()
+        store = plane.store if plane is not None else None
+        live = 0
+        max_lag = 0
+        for rec in roster:
+            rid = rec["rid"]
+            st = self._subs.setdefault(
+                rid, {"wire": None, "last_sent": -1, "state": "live"})
+            if rec["status"] != "live":
+                if st["state"] == "live":
+                    self._evict(rid, st, rec["status"])
+                continue
+            live += 1
+            if store is None or store.latest_version() is None:
+                continue
+            snap = store.get(None)
+            if rec["acked"] >= 0:
+                # a never-acked subscriber is SYNCING, not lagging —
+                # counting it from version 0 would read as the
+                # trainer's whole history and fire spurious
+                # replica_lag alerts on every join (the lease owns the
+                # never-arrives case)
+                max_lag = max(max_lag, snap.version - rec["acked"])
+            if st["last_sent"] >= snap.version:
+                continue
+            try:
+                blob, kind = self._encode_for(rec, snap)
+                sent = self._ship(rec, st, blob, snap.version)
+            except (DeadlineExceeded, WireCorruption, OSError,
+                    ConnectionError) as exc:
+                Log.Error("replica %d ship failed (%r) — evicting its "
+                          "subscription", rid, exc)
+                try:
+                    self.client.call("replica_evict", rid=rid,
+                                     timeout=_RPC_TIMEOUT_S)
+                except Exception:
+                    pass
+                self._evict(rid, st, "dead")
+                continue
+            if not sent:
+                # relay mailbox overflow: the coordinator dropped the
+                # queue and flagged needs_base — leave last_sent alone
+                # so the NEXT tick ships that base (a laggard resyncs;
+                # it is never evicted for being slow)
+                continue
+            st["last_sent"] = snap.version
+            self.fanout_bytes += len(blob)
+            self._t_bytes.inc(len(blob))
+            self._t_blobs.inc()
+            tflight.record("replica.fanout", detail=f"r{rid} {kind} "
+                           f"v{snap.version} {len(blob)}B")
+        self._roster = roster
+        self.max_lag = max_lag
+        self._t_subs.set(float(live))
+        self._t_lag.set(float(max_lag))
+
+    def _encode_for(self, rec: dict, snap):
+        """(blob, kind) for one subscriber against the newest retained
+        snapshot — delta when the interval is fully journal-covered
+        and the subscriber doesn't need a resync, else base."""
+        acked = int(rec["acked"])
+        if rec["needs_base"] or acked < 0 or acked >= snap.version:
+            return rdelta.encode_base(snap), "base"
+        descs = self._merged_descs(acked, snap)
+        if descs is None:
+            return rdelta.encode_base(snap), "base"
+        return rdelta.encode_delta(snap, acked, descs), "delta"
+
+    def _ship(self, rec: dict, st: dict, blob: bytes,
+              version: int) -> bool:
+        """Ship one blob; returns False on a relay mailbox overflow
+        (the laggard-resync signal — NOT a failure; ship errors
+        raise)."""
+        if rec["mode"] == "shm":
+            wire = st["wire"]
+            if wire is None:
+                from multiverso_tpu.parallel.shm_wire import ShmWire
+                wire = ShmWire(rec["token"], rank=0, nprocs=2,
+                               channels=1,
+                               data_bytes=rec["ring_bytes"]
+                               or _ring_flag(),
+                               payload_crc=False)
+                wire.attach_peers()     # replica created its segment
+                st["wire"] = wire       # before it joined
+            wire.exchange(blob, 0,
+                          timeout_s=max(2.0 * self.lease_s, 5.0))
+            return True
+        resp = self.client.call("replica_put", rid=rec["rid"],
+                                version=version, blob=blob,
+                                timeout=_RPC_TIMEOUT_S)
+        return not resp.get("overflow")
+
+    def _evict(self, rid: int, st: dict, state: str) -> None:
+        wire, st["wire"] = st["wire"], None
+        if wire is not None:
+            wire.close()
+        if st["state"] == "live":
+            st["state"] = state
+            self._t_evicted.inc()
+            tflight.record("replica.evict", detail=f"r{rid} {state}")
+            Log.Info("replica plane: subscription r%d evicted (%s)",
+                     rid, state)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                Log.Error("replica fan-out thread stuck at shutdown — "
+                          "abandoning its daemon thread")
+        for st in self._subs.values():
+            wire, st["wire"] = st["wire"], None
+            if wire is not None:
+                wire.close()
+        if self._own_coordinator is not None:
+            self._own_coordinator.stop()
+            self._own_coordinator = None
+
+
+_publisher: Optional[ReplicaPublisher] = None
+_pub_lock = threading.Lock()
+
+
+def start_plane(zoo) -> bool:
+    """Bring up the fan-out when ``-mv_replica_fanout`` is set
+    (Zoo.Start, after the elastic plane so its coordinator can be
+    reused). Rank 0 owns the fan-out; other ranks hold an inert plane
+    object. Returns True when fan-out is active on this rank."""
+    global _publisher
+    if not _fanout_flag():
+        return False
+    CHECK(zoo.server_engine is not None,
+          "-mv_replica_fanout needs the server engine (not -ma mode): "
+          "the dirty journals drain at engine publish cuts")
+    from multiverso_tpu import elastic
+    from multiverso_tpu.elastic.coordinator import Coordinator, MemberClient
+    from multiverso_tpu.parallel import multihost
+    me = multihost.process_index()
+    active = me == 0
+    pub = ReplicaPublisher(zoo, active)
+    if active:
+        addr = str(GetFlag("mv_replica_addr"))
+        ep = elastic.coordinator_endpoint()
+        if addr:
+            host, _, port_s = addr.rpartition(":")
+            CHECK(host and port_s.isdigit(),
+                  f"-mv_replica_addr must be host:port, got {addr!r}")
+            pub._own_coordinator = Coordinator(host, int(port_s),
+                                               pub.lease_s)
+            host, port = host, pub._own_coordinator.port
+        elif ep is not None:
+            host, port = ep     # ride the elastic coordinator
+        else:
+            CHECK(multihost.process_count() <= 1,
+                  "-mv_replica_fanout in a multi-process world needs "
+                  "-mv_elastic (to reuse its coordinator) or an "
+                  "explicit -mv_replica_addr")
+            pub._own_coordinator = Coordinator("127.0.0.1", 0,
+                                               pub.lease_s)
+            host, port = "127.0.0.1", pub._own_coordinator.port
+        pub.client = MemberClient(host, port, me, pub.lease_s)
+        pub.endpoint = f"{host}:{port}"
+        pub.start()
+        Log.Info("replica plane: fan-out up at %s (lease %.1fs)",
+                 pub.endpoint, pub.lease_s)
+    with _pub_lock:
+        _publisher = pub
+    return active
+
+
+def shutdown_plane() -> None:
+    global _publisher
+    with _pub_lock:
+        pub, _publisher = _publisher, None
+    if pub is not None:
+        pub.stop()
+
+
+def note_publish(engine, snap) -> None:
+    """The publish-cut hook — see :meth:`ReplicaPublisher.record_cut`.
+    One attribute read when the plane is off or this rank is not the
+    fan-out owner."""
+    pub = _publisher
+    if pub is None or not pub.active:
+        return
+    pub.record_cut(engine, snap)
+
+
+def maybe_attach_journal(server_table) -> None:
+    """RegisterTable hook: give the table its publish journal so the
+    FIRST interval after a publish is covered from registration (a
+    late-attached journal forces one full-payload fan-out)."""
+    pub = _publisher
+    if pub is None or not pub.active:
+        return
+    if getattr(server_table, "_pub_journal", None) is None:
+        server_table._pub_journal = rdelta.journal_for_table(server_table)
+
+
+def publisher_endpoint() -> Optional[str]:
+    """host:port replicas should join (tests/bench); None when off."""
+    pub = _publisher
+    return pub.endpoint if pub is not None else None
+
+
+def status_report() -> Optional[dict]:
+    """Local fan-out view for /healthz: one line per known replica
+    (departed ones included — operators see who left). Served from the
+    fan-out thread's cached roster; never an RPC, never collective."""
+    pub = _publisher
+    if pub is None:
+        return None
+    subs = []
+    for rec in pub._roster:
+        # lag is meaningful only for live, at-least-once-acked
+        # subscribers — a joiner mid-first-base reports None (syncing)
+        lag = (pub.latest - rec["acked"]
+               if pub.latest >= 0 and rec["acked"] >= 0
+               and rec["status"] == "live" else None)
+        subs.append({"rid": rec["rid"], "mode": rec["mode"],
+                     "state": rec["status"], "acked": rec["acked"],
+                     "lag_versions": lag})
+    return {"active": pub.active, "endpoint": pub.endpoint,
+            "latest": pub.latest if pub.latest >= 0 else None,
+            "fanout_bytes": pub.fanout_bytes, "max_lag": pub.max_lag,
+            "subscribers": subs}
+
+
+def peek_sample() -> Optional[dict]:
+    """Watchdog probe: plain local attrs, refreshed by the fan-out
+    tick."""
+    pub = _publisher
+    if pub is None or not pub.active:
+        return None
+    live = sum(1 for r in pub._roster if r["status"] == "live")
+    return {"replica_subscribers": live,
+            "replica_lag_versions": pub.max_lag}
+
+
+def ledger_bytes() -> Optional[dict]:
+    """Accounting probe: journal bitmaps/write-sets on the live tables
+    plus the retained per-version dirty descriptors."""
+    pub = _publisher
+    if pub is None or not pub.active:
+        return None
+    journal = 0
+    eng = pub.zoo.server_engine
+    if eng is not None:
+        for table in getattr(eng, "store_", []):
+            j = getattr(table, "_pub_journal", None)
+            if j is not None:
+                journal += j.nbytes()
+    with pub._lock:
+        dirty = sum(rdelta.descriptor_nbytes(d)
+                    for descs in pub._dirty.values()
+                    for d in descs.values())
+    return {"journal_bytes": journal, "dirty_set_bytes": dirty,
+            "retained_versions": len(pub._dirty)}

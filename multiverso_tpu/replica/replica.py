@@ -1,0 +1,414 @@
+"""Replica reader process: a jax-free serving tier fed by fan-out.
+
+Run as ``python -m multiverso_tpu.replica.replica --addr HOST:PORT``
+against a trainer started with ``-mv_replica_fanout=true``. The
+process:
+
+1. **joins** the trainer's coordinator as a ``role=replica`` member —
+   a heartbeat lease like an SPMD member's, but NO verb stream and no
+   epoch membership; it never touches ``jax.distributed`` (this import
+   path is numpy-only, asserted in :func:`main` and pinned by
+   tests/test_packaging.py);
+2. **receives** base+delta blobs — same-host over a dedicated shm ring
+   (PR 9 transport, 2-proc point-to-point), remote through the
+   coordinator's relay mailbox — and applies them to local
+   :class:`~multiverso_tpu.replica.delta.MirrorStore` mirrors;
+3. **installs** each applied version into its own ``SnapshotStore``
+   (the SAME class the trainer serves from, so the retention/pin
+   contract — newest ``-mv_serving_keep`` live, pins nest — carries
+   over verbatim) and **serves** lookups through a reused
+   ``ServingFrontend``: admission bound, micro-batch coalescing into
+   one fused union gather, typed ``ServingOverloaded`` shedding — all
+   identical to in-process serving, host gather path only;
+4. **answers** a tiny length-prefixed CRC-framed TCP protocol
+   (:class:`ReplicaClient`): ``lookup`` / ``status`` / ``pin`` /
+   ``unpin`` — the QPS surface the bench drives.
+
+Lifecycle is lease-symmetric: the trainer evicts a replica whose lease
+expires; the replica exits when its heartbeats report eviction or the
+coordinator stays unreachable (trainer gone). Neither side ever blocks
+the SPMD stream on the other.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from multiverso_tpu.elastic.coordinator import (MemberClient, _recv_frame,
+                                                _send_frame)
+from multiverso_tpu.failsafe.errors import TransientError
+from multiverso_tpu.replica import delta as rdelta
+from multiverso_tpu.serving.frontend import ServingFrontend
+from multiverso_tpu.serving.store import SnapshotStore
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.configure import SetCMDFlag
+from multiverso_tpu.utils.log import CHECK, Log
+
+#: consecutive heartbeat failures before the replica concludes the
+#: trainer is gone and exits (the lease-symmetric shutdown path)
+_HB_FAILS_FATAL = 10
+
+#: how long the shm attach retries while the publisher discovers this
+#: subscription and creates its ring segment
+_ATTACH_TIMEOUT_S = 60.0
+
+
+class _LookupServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    replica: "Replica"
+
+
+class _LookupHandler(socketserver.BaseRequestHandler):
+    """Replica lookup serve loop — the read tier's QPS surface.
+    Registered as a never-collective ROOT (analysis/collective.py):
+    this process has no SPMD stream at all, and the handler must keep
+    it that way — snapshot gathers through the reused frontend only.
+
+    Connections are PERSISTENT (frame in, frame out, until the client
+    closes): a connect per lookup caps the client at the TCP handshake
+    rate, and the whole point of this tier is lookup QPS."""
+
+    def handle(self):
+        while True:
+            try:
+                req = _recv_frame(self.request)
+            except (ConnectionError, OSError):
+                return          # client closed — normal end of stream
+            try:
+                resp = self.server.replica._serve_op(req)
+            except Exception as exc:
+                resp = {"err": type(exc).__name__, "msg": str(exc)}
+            try:
+                _send_frame(self.request, resp)
+            except OSError:
+                return
+
+
+class Replica:
+    def __init__(self, host: str, port: int, *, mode: str = "shm",
+                 serve_port: int = 0, ring_bytes: int = 8 << 20,
+                 lease_s: float = 5.0):
+        CHECK(mode in ("shm", "relay"), f"unknown replica mode {mode!r}")
+        self.mode = mode
+        self.ring_bytes = int(ring_bytes)
+        self.lease_s = float(lease_s)
+        self.client = MemberClient(host, port, 0, self.lease_s)
+        self.store = SnapshotStore()
+        self.frontend = ServingFrontend(self.store)
+        self.mirrors = rdelta.MirrorStore()
+        self.rid: Optional[int] = None
+        self.latest_known = -1
+        self.applies = 0
+        self._wire = None
+        self._serve_port = int(serve_port)
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._stop = threading.Event()
+        self.exit_code: Optional[int] = None
+        # EAGER registration (the PR 6 rule)
+        self._t_lag = tmetrics.gauge("replica.lag_versions")
+        self._t_apply = tmetrics.histogram("replica.apply_s")
+        self._t_applies = tmetrics.counter("replica.applies")
+        self._t_recv = tmetrics.counter("replica.recv_bytes")
+        self._t_mirror = tmetrics.gauge("mem.replica.mirror_bytes")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        token = ""
+        if self.mode == "shm":
+            from multiverso_tpu.parallel.shm_wire import ShmWire
+            token = f"{os.getpid():x}{int(time.time() * 1e3) & 0xFFFF:x}"
+            # our (rank 1) segment exists BEFORE the join lands, so the
+            # publisher's first ship can attach immediately
+            self._wire = ShmWire(token, rank=1, nprocs=2, channels=1,
+                                 data_bytes=self.ring_bytes,
+                                 payload_crc=False)
+        resp = self.client.call_retry("replica_join", attempts=50,
+                                      mode=self.mode, token=token,
+                                      ring_bytes=self.ring_bytes,
+                                      lease_s=self.lease_s)
+        self.rid = int(resp["rid"])
+        self.latest_known = int(resp.get("latest", -1))
+        self._start_serve_server()
+        threading.Thread(target=self._hb_loop, name="mv-replica-hb",
+                         daemon=True).start()
+        Log.Info("replica r%d up: mode=%s, serving on 127.0.0.1:%d",
+                 self.rid, self.mode, self.serve_port)
+
+    @property
+    def serve_port(self) -> int:
+        return self._server.server_address[1] if self._server else -1
+
+    def _die(self, code: int, why: str) -> None:
+        Log.Error("replica r%s exiting (%d): %s", self.rid, code, why)
+        self.exit_code = code
+        self._stop.set()
+        # the recv loop may be parked in an shm exchange with nothing
+        # arriving — only a hard exit unblocks a standalone reader
+        os._exit(code)
+
+    def _hb_loop(self) -> None:
+        fails = 0
+        period = max(0.05, self.lease_s / 3.0)
+        while not self._stop.wait(period):
+            try:
+                resp = self.client.call("replica_hb", rid=self.rid,
+                                        timeout=5.0)
+            except Exception:
+                fails += 1
+                if fails >= _HB_FAILS_FATAL:
+                    self._die(3, "coordinator unreachable — trainer "
+                                 "gone")
+                continue
+            fails = 0
+            if resp.get("evicted"):
+                self._die(4, "subscription evicted by the trainer")
+            self.latest_known = max(self.latest_known,
+                                    int(resp.get("latest", -1)))
+            self._refresh_lag()
+
+    def _refresh_lag(self) -> None:
+        if self.latest_known >= 0:
+            self._t_lag.set(float(max(
+                0, self.latest_known - self.mirrors.version)))
+
+    # -- the fan-in (apply) loop --------------------------------------------
+
+    def _attach_ring(self) -> None:
+        deadline = time.monotonic() + _ATTACH_TIMEOUT_S
+        last: Exception = FileNotFoundError("never attempted")
+        while time.monotonic() <= deadline:
+            try:
+                self._wire.attach_peers()
+                return
+            except Exception as exc:
+                # the publisher creates its segment at first ship (one
+                # roster poll, ~0.25s, after our join lands) — and the
+                # engine's "attach after a world barrier" contract does
+                # not exist here, so an attach can even land BETWEEN
+                # the segment create and its magic store (a transient
+                # foreign-layout CHECK). Both resolve by retrying.
+                last = exc
+                time.sleep(0.02)
+        self._die(5, f"publisher never opened the fan-out ring "
+                     f"(last attach error: {last!r})")
+
+    def recv_loop(self) -> None:
+        """Receive + apply until stopped. Runs on the main thread; the
+        lookup server and heartbeats ride their own daemons."""
+        if self.mode == "shm":
+            self._attach_ring()
+        while not self._stop.is_set():
+            if self.mode == "shm":
+                # parked between publishes; eviction/trainer death is
+                # the heartbeat thread's exit path, not this wait's
+                blob = self._wire.exchange(b"", 0)[0]
+            else:
+                try:
+                    resp = self.client.call("replica_fetch",
+                                            rid=self.rid, timeout=10.0)
+                except (TransientError, ConnectionError, OSError):
+                    continue        # quiet interval — keep parking
+                if resp.get("evicted"):
+                    self._die(4, "subscription evicted by the trainer")
+                blob = resp["blob"]
+            if blob:
+                self._apply(blob)
+
+    def _apply(self, blob: bytes) -> None:
+        t0 = time.perf_counter()
+        self._t_recv.inc(len(blob))
+        bundle = rdelta.decode(blob)
+        version = int(bundle["version"])
+        if version <= self.mirrors.version:
+            # idempotent re-delivery (publisher retry after an ack it
+            # never saw): re-ack, never re-apply
+            self._ack(self.mirrors.version)
+            return
+        snap = self.mirrors.apply(bundle)
+        self.store.install(snap)
+        self.applies += 1
+        self.latest_known = max(self.latest_known, version)
+        self._t_applies.inc()
+        self._t_apply.observe(time.perf_counter() - t0)
+        self._t_mirror.set(float(self.mirrors.mirror_bytes()))
+        self._refresh_lag()
+        self._ack(version)
+        Log.Debug("replica r%s: applied %s v%d (%d tables)", self.rid,
+                  bundle["kind"], version, len(snap.tables))
+
+    def _ack(self, version: int) -> None:
+        try:
+            self.client.call_retry("replica_ack", rid=self.rid,
+                                   version=version, timeout=5.0)
+        except Exception as exc:    # the lease machinery owns liveness
+            Log.Error("replica r%s: ack v%d failed: %r", self.rid,
+                      version, exc)
+
+    # -- the lookup serve surface -------------------------------------------
+
+    def _start_serve_server(self) -> None:
+        self._server = _LookupServer(("127.0.0.1", self._serve_port),
+                                     _LookupHandler)
+        self._server.replica = self
+        threading.Thread(target=self._server.serve_forever,
+                         kwargs={"poll_interval": 0.05},
+                         name="mv-replica-serve", daemon=True).start()
+
+    def _serve_op(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "lookup":
+            ids = req.get("ids")
+            rows = self.frontend.lookup(
+                int(req["table_id"]),
+                None if ids is None else np.asarray(ids),
+                version=req.get("version"),
+                deadline=req.get("deadline"))
+            return {"rows": rows}
+        if op == "status":
+            return self.status()
+        if op == "pin":
+            return {"version": self.store.pin(int(req["version"]))}
+        if op == "unpin":
+            self.store.unpin(int(req["version"]))
+            return {"ok": True}
+        CHECK(False, f"replica serve: unknown op {op!r}")
+
+    def status(self) -> dict:
+        return {
+            "rid": self.rid, "mode": self.mode,
+            "latest": self.store.latest_version(),
+            "live_versions": self.store.live_versions(),
+            "latest_known": self.latest_known,
+            "lag_versions": (max(0, self.latest_known
+                                 - self.mirrors.version)
+                             if self.latest_known >= 0 else None),
+            "applies": self.applies,
+            "mirror_bytes": self.mirrors.mirror_bytes(),
+            "jax_free": "jax" not in sys.modules,
+        }
+
+
+class ReplicaClient:
+    """Client for the replica's lookup surface (tests/bench). Holds ONE
+    persistent connection (a connect per lookup would cap throughput at
+    the TCP handshake rate); reconnects once on a broken stream. A
+    client instance serializes its calls under a lock — give each
+    reader thread its own instance for concurrency (the server
+    micro-batches across connections anyway)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _call(self, timeout: float = 30.0, **req) -> dict:
+        with self._lock:
+            resp = None
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=timeout)
+                try:
+                    self._sock.settimeout(timeout)
+                    _send_frame(self._sock, req)
+                    resp = _recv_frame(self._sock)
+                    break
+                except (ConnectionError, OSError):
+                    # server restarted / idle stream dropped: one
+                    # fresh-connection retry, then the error is real
+                    self.close()
+                    if attempt:
+                        raise
+        err = resp.get("err") if isinstance(resp, dict) else None
+        if err is not None:
+            raise RuntimeError(
+                f"replica serve error {err}: {resp.get('msg')}")
+        return resp
+
+    def lookup(self, table_id: int, ids=None, *,
+               version: Optional[int] = None,
+               deadline: Optional[float] = None) -> np.ndarray:
+        ids_l = None if ids is None else np.asarray(ids).tolist()
+        return self._call(op="lookup", table_id=int(table_id),
+                          ids=ids_l, version=version,
+                          deadline=deadline)["rows"]
+
+    def status(self) -> dict:
+        return self._call(op="status")
+
+    def pin(self, version: int) -> int:
+        return self._call(op="pin", version=int(version))["version"]
+
+    def unpin(self, version: int) -> None:
+        self._call(op="unpin", version=int(version))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m multiverso_tpu.replica.replica",
+        description="jax-free replica reader: join a trainer's "
+                    "replica plane, mirror published versions, serve "
+                    "lookups")
+    p.add_argument("--addr", required=True,
+                   help="trainer replica coordinator host:port")
+    p.add_argument("--mode", choices=("shm", "relay"), default="shm",
+                   help="fan-out transport: shm (same host) or the "
+                        "coordinator socket relay (remote)")
+    p.add_argument("--serve-port", type=int, default=0,
+                   help="lookup TCP port (0 = ephemeral)")
+    p.add_argument("--ring-bytes", type=int, default=8 << 20)
+    p.add_argument("--lease", type=float, default=5.0,
+                   help="heartbeat lease seconds")
+    p.add_argument("--keep", type=int, default=2,
+                   help="version retention (the -mv_serving_keep "
+                        "contract)")
+    p.add_argument("--status-file", default="",
+                   help="write {rid, serve_port, pid} JSON here once "
+                        "up (test/bench discovery)")
+    args = p.parse_args(argv)
+    # the whole point of this tier: a reader must never pay the jax
+    # import (or its device bootstrap) — if this trips, some module on
+    # the replica import path regressed to a top-level jax import
+    CHECK("jax" not in sys.modules,
+          "replica process import graph must stay numpy-only — "
+          "something pulled jax at import time")
+    host, _, port_s = args.addr.rpartition(":")
+    CHECK(host and port_s.isdigit(),
+          f"--addr must be host:port, got {args.addr!r}")
+    SetCMDFlag("mv_serving_keep", args.keep)
+    rep = Replica(host, int(port_s), mode=args.mode,
+                  serve_port=args.serve_port,
+                  ring_bytes=args.ring_bytes, lease_s=args.lease)
+    rep.start()
+    if args.status_file:
+        tmp = args.status_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rid": rep.rid, "serve_port": rep.serve_port,
+                       "pid": os.getpid()}, f)
+        os.replace(tmp, args.status_file)
+    rep.recv_loop()
+    return rep.exit_code or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,6 +251,16 @@ def _capture_all(engine, store) -> Snapshot:
                     window_epoch=engine.cut_epoch(),
                     tables=tables)
     store.install(snap)
+    # replica plane fan-out hook (round 17): drain the per-table
+    # publish journals AT this fenced stream position (that is the
+    # delta-soundness argument — every Add admitted before the cut
+    # marked its journal before this drain, none after) and kick the
+    # fan-out thread. Local numpy only; one attribute read when off.
+    try:
+        from multiverso_tpu import replica as _replica
+        _replica.note_publish(engine, snap)
+    except Exception as exc:    # fan-out must never fail a publish
+        Log.Error("replica fan-out publish hook failed: %r", exc)
     tmetrics.gauge("serving.snapshot_bytes").set(snap.nbytes())
     tmetrics.gauge("serving.snapshot_age_s").set(0.0)
     tmetrics.histogram("serving.publish_s").observe(

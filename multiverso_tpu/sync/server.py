@@ -797,6 +797,12 @@ class Server(Actor):
         # (apply attribution, row-skew sketch metrics) name tables by
         # family+id without walking the store
         server_table.table_id = table_id
+        # replica plane (round 17): attach the publish dirty journal at
+        # registration so the first post-publish interval is covered
+        # from the table's birth (a late-attached journal costs one
+        # full-payload fan-out). One cached-flag read when off.
+        from multiverso_tpu import replica as _replica
+        _replica.maybe_attach_journal(server_table)
         return table_id
 
     def Stop(self) -> None:

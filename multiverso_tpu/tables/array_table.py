@@ -112,6 +112,17 @@ class ArrayServer(ServerTable):
             values = np.pad(values, (0, self.padded - self.size))
         delta = self._zoo.mesh_ctx.place(values, self._sharding)
         self.state = self._update(self.state, delta, option.as_jnp())
+        self._note_journal_all()
+
+    def _note_journal_all(self) -> None:
+        """Replica-plane publish journal (tables/base.py contract):
+        every array Add is whole-vector, so the journal is a flag —
+        the fan-out delta ships the full values when anything moved.
+        Fires AFTER the data update, from every apply site (host sums
+        and both device-wire paths)."""
+        journal = self._pub_journal
+        if journal is not None:
+            journal.mark_all()
 
     def ProcessAddParts(self, parts, my_rank: int) -> None:
         """Windowed-engine collective Add: every rank's payload arrived
@@ -182,6 +193,7 @@ class ArrayServer(ServerTable):
             np.asarray(local, self.dtype).ravel())
         self.state = self._update_parts_jit(self.state, gdelta,
                                             opts[0].as_jnp())
+        self._note_journal_all()
 
     def ProcessAddRunPartsDevice(self, positions, my_rank: int) -> bool:
         """Merged DEVICE-wire run (tables/base.py contract): a window's
@@ -219,6 +231,7 @@ class ArrayServer(ServerTable):
         gdelta = self.device_place_parts_delta(summed)
         self.state = self._update_parts_jit(self.state, gdelta,
                                             AddOption().as_jnp())
+        self._note_journal_all()
         return True
 
     def ProcessGet(self, option: GetOption) -> np.ndarray:

@@ -101,6 +101,19 @@ class TableOption:
 class ServerTable:
     """Server half: owns the sharded device store (table_interface.h:61-79)."""
 
+    #: replica-plane publish journal (round 17,
+    #: multiverso_tpu/replica/delta.py): attached at RegisterTable when
+    #: the fan-out plane owns this rank, None otherwise (one attribute
+    #: read on every apply). CONTRACT: every APPLIED Add marks it —
+    #: matrix families through the ``_note_add_parts`` hook (fires
+    #: after the data update on every Add path, so a rejected add never
+    #: dirties the journal), kv through ``_apply_merged_kv``, array at
+    #: its apply sites. ``publish_journal_kind`` picks the granularity:
+    #: "rows" (row bitmap — the SparseMatrixTable up_to_date idiom),
+    #: "keys" (write-set of touched keys), "all" (whole-table flag).
+    _pub_journal = None
+    publish_journal_kind = "all"
+
     def ProcessAdd(self, **payload) -> None:
         raise NotImplementedError
 

@@ -53,6 +53,10 @@ class KVTableOption(TableOption):
 
 
 class KVServerTable(ServerTable):
+    #: replica-plane journal granularity (tables/base.py contract):
+    #: key-addressed — the fan-out delta ships touched keys' values
+    publish_journal_kind = "keys"
+
     def __init__(self, dtype, zoo, init_capacity: int = 1024):
         self.dtype = np.dtype(dtype)
         self._zoo = zoo
@@ -465,6 +469,7 @@ class KVServerTable(ServerTable):
             # shape stability only); create=True slots are all valid
             np.add.at(npv, slots, deltas)
             self._np_dirty = True
+            self._note_journal_keys(keys)
             return
         padded = self._pad_slots(slots)
         pad_deltas = np.zeros(len(padded), self.dtype)
@@ -474,6 +479,16 @@ class KVServerTable(ServerTable):
         else:
             self._values = self._scatter_add(self._values, jnp.asarray(padded),
                                              jnp.asarray(pad_deltas))
+        self._note_journal_keys(keys)
+
+    def _note_journal_keys(self, keys: np.ndarray) -> None:
+        """Replica-plane publish journal (tables/base.py contract):
+        every merged-KV apply funnels through _apply_merged_kv, so one
+        mark site covers blocking, windowed and merged-run Adds. Fires
+        AFTER the data update — a rejected add never dirties it."""
+        journal = self._pub_journal
+        if journal is not None:
+            journal.mark_keys(keys)
 
     def ProcessGet(self, keys: np.ndarray,
                    option: Optional[GetOption] = None,

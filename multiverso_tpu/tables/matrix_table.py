@@ -151,6 +151,10 @@ class MatrixTableOption(TableOption):
 
 
 class MatrixServerTable(ServerTable):
+    #: replica-plane journal granularity (tables/base.py contract):
+    #: row-addressed — the fan-out delta ships dirtied rows
+    publish_journal_kind = "rows"
+
     def __init__(self, num_rows: int, num_cols: int, dtype, zoo,
                  updater_type: Optional[str] = None,
                  initializer: Optional[Callable] = None,
@@ -815,7 +819,15 @@ class MatrixServerTable(ServerTable):
         """Hook: every rank's id set (None = whole table) of the applied
         collective Add, in rank order — fires AFTER the data update so a
         rejected add cannot desynchronize subclass bookkeeping.
-        SparseMatrixTable overrides this for its freshness bits."""
+        SparseMatrixTable overrides this for its freshness bits (and
+        calls back up). Round 17: the replica plane's publish journal
+        rides the same hook — every Add path already funnels here, so
+        one mark site covers blocking, windowed, merged-run, device-
+        wire and compressed applies alike."""
+        journal = self._pub_journal
+        if journal is not None:
+            for part_ids in parts:
+                journal.mark_rows(part_ids)
 
     def ProcessAdd(self, values: Optional[np.ndarray] = None,
                    option: AddOption = None,

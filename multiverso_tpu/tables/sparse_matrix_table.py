@@ -148,6 +148,10 @@ class SparseMatrixServerTable(MatrixServerTable):
         and each keeper stays fresh only for the rows its own process
         pushed (a rejected add never reaches this hook, so the bits can't
         desynchronize)."""
+        # the parent hook carries the replica-plane publish journal
+        # (round 17) — the freshness bits below are the TRAINING-side
+        # delta machinery, the journal the publish-side one
+        super()._note_add_parts(option, parts)
         for rank, part_ids in enumerate(parts):
             self._mark_stale(self._gwid(rank, option.worker_id), part_ids)
 

@@ -70,6 +70,7 @@ MEM_FAMILIES = (
     "mem.get_cache.bytes",
     "mem.shm.segment_bytes",
     "mem.shm.frame_hw_bytes",
+    "mem.replica.journal_bytes",
 )
 
 #: flight-ring estimate: one event is an 8-slot tuple (3 ints, 2
@@ -202,8 +203,21 @@ def memory_report() -> dict:
         comps["shm"] = _shm_report()
     except Exception:
         comps["shm"] = None
+    # round 17 — replica fan-out plane: publish-journal bitmaps/write-
+    # sets on the live tables + the retained per-version dirty
+    # descriptors (the delta retention window). Exact shape arithmetic,
+    # publisher-rank only; absent when the plane is off. (The replica
+    # PROCESS accounts its own mirrors: mem.replica.mirror_bytes is set
+    # at every apply over there and reported through its status op —
+    # this ledger covers the trainer side of the split.)
+    try:
+        from multiverso_tpu import replica as treplica
+        comps["replica"] = treplica.ledger_bytes()
+    except Exception:
+        comps["replica"] = None
     t = comps["tables"]["totals"]
     shm = comps["shm"] or {}
+    rep = comps["replica"] or {}
     gauges = {
         "mem.tables.device_bytes": t["device_bytes"],
         "mem.tables.host_mirror_bytes": t["host_mirror_bytes"],
@@ -215,6 +229,8 @@ def memory_report() -> dict:
         "mem.get_cache.bytes": comps["tables"]["get_cache_bytes"],
         "mem.shm.segment_bytes": shm.get("segment_bytes", 0),
         "mem.shm.frame_hw_bytes": shm.get("frame_hw_bytes", 0),
+        "mem.replica.journal_bytes": (rep.get("journal_bytes", 0)
+                                      + rep.get("dirty_set_bytes", 0)),
     }
     total = sum(gauges.values()) - gauges["mem.shm.frame_hw_bytes"]
     gauges["mem.total_bytes"] = total

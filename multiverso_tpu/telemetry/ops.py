@@ -203,6 +203,18 @@ def health_report() -> dict:
             }
     except Exception:           # serving is optional
         pass
+    # round 17 — replica plane: one line per known subscriber (rid,
+    # mode, live/dead/evicted, acked version, lag). Served from the
+    # fan-out thread's CACHED roster — the handler does no RPC and no
+    # collective; departed replicas stay listed so operators see who
+    # left and when the publisher evicted them.
+    try:
+        from multiverso_tpu import replica as treplica
+        rrep = treplica.status_report()
+        if rrep is not None:
+            out["replica"] = rrep
+    except Exception:           # replica plane is optional
+        pass
     rec, drop = flight.stats()
     out["flight"] = {"recorded": rec, "dropped": drop,
                      "enabled": flight.enabled()}

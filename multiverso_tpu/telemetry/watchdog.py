@@ -47,6 +47,10 @@ straggler           sustained local proxy: per-window apply seconds
                     collective — ITS apply gates the stream (the
                     critpath drill's culprit); a live stamped binding
                     phase other than ``apply`` vetoes
+replica_lag         a live replica subscriber sits >= N published
+                    versions behind the newest snapshot (fan-out
+                    stalled, ring backpressured, or the replica's
+                    apply can't keep up)
 ==================  ====================================================
 
 Every ``alert.*`` counter is registered EAGERLY at
@@ -71,7 +75,8 @@ MV_DEFINE_double("mv_watchdog_s", 0.0,
                  "watchdog tick interval: evaluate the typed online "
                  "alert rules (shard imbalance, shm backpressure, "
                  "apply-pool saturation, mailbox/memory growth, "
-                 "snapshot staleness, straggler proxy) every N seconds "
+                 "snapshot staleness, straggler proxy, replica lag) "
+                 "every N seconds "
                  "over LOCAL instruments only, with fire/clear "
                  "hysteresis; alerts surface at /alerts, in "
                  "alert.<rule> counters + flight events, and degrade "
@@ -291,6 +296,34 @@ class MemoryGrowthRule(Rule):
         return None
 
 
+class ReplicaLagRule(Rule):
+    """A LIVE replica subscriber sitting ``max_lag`` or more published
+    versions behind the newest snapshot: the fan-out is stalled (slow
+    ring drain, relay mailbox churn) or the replica's apply can't keep
+    the publish cadence — either way its reads serve stale versions
+    and its next resync will be a full base. Reads the publisher's
+    plain local attrs (refreshed by the fan-out tick — local-only, the
+    never-collective rule); a world with no subscribers, or with the
+    plane off, HOLDs."""
+
+    name = "replica_lag"
+
+    def __init__(self, max_lag: int = 3):
+        self.max_lag = max_lag
+
+    def check(self, history):
+        cur = history[-1]
+        subs = cur.get("replica_subscribers")
+        if not subs:
+            return HOLD      # plane off / nobody subscribed
+        lag = cur.get("replica_lag_versions", 0)
+        if lag >= self.max_lag:
+            return (f"a live replica is {int(lag)} published versions "
+                    f"behind (>= {self.max_lag}) across "
+                    f"{int(subs)} subscriber(s)")
+        return None
+
+
 class StragglerRule(Rule):
     """Sustained LOCAL straggler proxy (multi-process windows only):
     the binding phase reads ``apply``, per-window apply seconds sit
@@ -346,7 +379,8 @@ class StragglerRule(Rule):
 def default_rules() -> List[Rule]:
     return [ShardImbalanceRule(), ShmBackpressureRule(),
             ApplyPoolSaturationRule(), MailboxBacklogRule(),
-            SnapshotStaleRule(), MemoryGrowthRule(), StragglerRule()]
+            SnapshotStaleRule(), MemoryGrowthRule(), StragglerRule(),
+            ReplicaLagRule()]
 
 
 def refresh_saturation_gauges() -> None:
@@ -435,6 +469,13 @@ def collect_sample() -> dict:
         plane = peek_plane()
         if plane is not None and plane.store.latest_version() is not None:
             sample["snapshot_age_s"] = plane.store.get(None).age_s()
+    except Exception:
+        pass
+    try:
+        from multiverso_tpu import replica as treplica
+        rsample = treplica.peek_sample()
+        if rsample is not None:
+            sample.update(rsample)
     except Exception:
         pass
     try:

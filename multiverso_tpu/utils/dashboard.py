@@ -175,6 +175,11 @@ class Dashboard:
             lines += serving.status_lines()
         except Exception:       # pragma: no cover - serving torn down
             pass
+        try:
+            from multiverso_tpu import replica
+            lines += replica.status_lines()
+        except Exception:       # pragma: no cover - replica torn down
+            pass
         lines += cls._ops_lines()
         out = "\n".join(lines)
         for line in lines:

@@ -39,6 +39,7 @@ from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # silently dropped.
 import multiverso_tpu.elastic  # noqa: F401
 import multiverso_tpu.failsafe  # noqa: F401
+import multiverso_tpu.replica  # noqa: F401
 import multiverso_tpu.serving  # noqa: F401
 import multiverso_tpu.sync.server  # noqa: F401
 import multiverso_tpu.telemetry  # noqa: F401
@@ -141,6 +142,11 @@ class Zoo:
         # elastic membership plane LAST (needs the engine up): rank 0
         # hosts the coordinator, every rank registers + heartbeats
         elastic.start_plane(self)
+        # replica fan-out AFTER elastic so its subscription registry
+        # can ride the membership coordinator (round 17); rank 0 owns
+        # the fan-out thread, every rank reads one cached flag
+        from multiverso_tpu import replica as _replica
+        _replica.start_plane(self)
         self.started = True
         Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
                   "mode=%s", self.num_servers, self.num_workers,
@@ -183,6 +189,13 @@ class Zoo:
         # the shm wire (when installed) outlives the engine — the
         # drain above still exchanged on it — and dies with the world
         multihost.close_wire()
+        # replica fan-out down after the engine (no more publish cuts
+        # can arrive) and BEFORE the elastic/serving planes it reads:
+        # the fan-out thread stops, per-subscriber rings close, and any
+        # hosted subscription coordinator dies with it — parked
+        # replicas notice through their heartbeat failures
+        from multiverso_tpu import replica as _replica
+        _replica.shutdown_plane()
         # membership plane down AFTER the engine drain: the drain's
         # final flushes must still route under the CURRENT epoch view
         # (restoring the boot-world group earlier would aim the drain's

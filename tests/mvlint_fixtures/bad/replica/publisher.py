@@ -1,0 +1,14 @@
+"""Fixture twin of the replica publisher — SEEDED: the fan-out thread
+reaches a collective primitive (an allgather from a sampling-style
+thread is exactly the interleaving the never-collective law bans)."""
+
+from ..parallel import multihost
+
+
+class ReplicaPublisher:
+    def _run(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        return multihost.host_allgather_objects({"roster": True})

@@ -1,0 +1,11 @@
+"""Fixture twin of the replica reader — SEEDED: the serve loop reaches
+a collective (a reader process issuing a host barrier would need an
+SPMD stream it does not have)."""
+
+from ..parallel import multihost
+
+
+class _LookupHandler:
+    def handle(self):
+        multihost.host_barrier("replica-serve")
+        return {"ok": True}

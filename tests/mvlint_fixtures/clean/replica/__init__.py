@@ -1,0 +1,1 @@
+"""Fixture twin of the replica plane package."""

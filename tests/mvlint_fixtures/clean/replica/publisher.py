@@ -1,0 +1,15 @@
+"""Fixture twin of the replica publisher: the fan-out thread is a
+restricted never-collective root (it ships beside the engine stream)."""
+
+
+class ReplicaPublisher:
+    def _run(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        return _encode_blob(b"state")
+
+
+def _encode_blob(body):
+    return body + b"crc"

@@ -1,0 +1,12 @@
+"""Fixture twin of the replica reader: the lookup serve loop is a
+restricted never-collective root (the reader process has no SPMD
+stream at all)."""
+
+
+class _LookupHandler:
+    def handle(self):
+        return _serve_locally({"op": "status"})
+
+
+def _serve_locally(req):
+    return {"ok": True, "op": req.get("op")}

@@ -317,9 +317,25 @@ class TestFixtureCatches:
     def test_never_collective_reports_the_full_chain(self, results):
         bad_res, _ = results
         hit = next(f for f in bad_res.findings
-                   if f.rule == "never-collective")
+                   if f.rule == "never-collective"
+                   and f.path == "telemetry/watchdog.py")
         assert "collect_sample" in hit.message
         assert "parallel/multihost.py:host_barrier" in hit.message
+
+    def test_never_collective_catches_replica_roots(self, results):
+        """The round-17 roots: a replica serve loop or fan-out thread
+        reaching a collective is a finding (seeded in bad/replica/),
+        and the clean twins pass (pinned by the clean-twin leg of the
+        parametrized test above via the EXPECT machinery's rule
+        filter)."""
+        bad_res, clean_res = results
+        paths = {f.path for f in bad_res.findings
+                 if f.rule == "never-collective"}
+        assert "replica/replica.py" in paths, sorted(paths)
+        assert "replica/publisher.py" in paths, sorted(paths)
+        assert not [f for f in clean_res.findings
+                    if f.rule == "never-collective"
+                    and f.path.startswith("replica/")]
 
     def test_spmd_catches_all_five_guard_spellings(self, results):
         """Lexical guard (9), guard-clause early return (16, and the
@@ -446,18 +462,25 @@ class TestWholePackageBaseline:
         must resolve to a real graph node with a non-trivial closure
         (a typo'd root that matches nothing would be silent)."""
         from multiverso_tpu.analysis.collective import (
-            DEFAULT_ROOTS, DEFAULT_SINKS, NeverCollectiveChecker)
-        pkg = core.load_package()
-        checker = NeverCollectiveChecker()
-        findings = checker.check(pkg)
-        assert not [f for f in findings], \
-            "\n".join(f.render() for f in findings)
+            DEFAULT_ROOTS, DEFAULT_SINKS)
+        # through run_analysis, not a bare checker.check: the package
+        # law is ZERO UNSUPPRESSED findings — the replica fan-out
+        # thread's reasoned never-collective suppression (its ring is
+        # point-to-point to a non-SPMD reader) is legal, a new
+        # unreasoned path is not
+        res = run_analysis(rules=["never-collective"])
+        assert not res.findings, \
+            "\n".join(f.render() for f in res.findings)
+        checker = res.checkers[0]
         conventions = {
             "ops HTTP handler": "telemetry/ops.py:_OpsHandler.do_GET",
             "watchdog tick": "telemetry/watchdog.py:Watchdog.tick",
             "stats reporter": "telemetry/export.py:StatsReporter._run",
             "accounting probe": "telemetry/accounting.py:memory_report",
             "dashboard render": "utils/dashboard.py:Dashboard.Display",
+            "replica serve loop": "replica/replica.py:_LookupHandler.handle",
+            "replica fan-out thread":
+                "replica/publisher.py:ReplicaPublisher._run",
         }
         for label, node in conventions.items():
             assert node in DEFAULT_ROOTS, label

@@ -44,6 +44,11 @@ GUARDED = {
     # bandwidth (vs ~0.3 GB/s gloo; the wire's whole point). Generous
     # floor: a shared host's memory subsystem swings per session
     "matrix_table_2proc_shm_wire_MB_s": 0.5,
+    # round 17 — replica read tier: single-replica QPS and the
+    # 2-replica aggregate (the scale-out claim). Same 0.6 floor as the
+    # serving QPS metrics — TCP client threads are scheduler-noisy
+    "replica_lookup_qps": 0.6,
+    "replica_2rep_aggregate_qps": 0.6,
 }
 
 #: metric -> worst acceptable multiple of the guard value (latency:
@@ -58,6 +63,11 @@ GUARDED_CEIL = {
     # scheduling + one full-table capture, both noisy on a busy host —
     # the guard exists to catch it going O(seconds), not +50%.
     "elastic_rebalance_pause_ms": 4.0,
+    # round 17 — delta fan-out bytes as a share of the full table on
+    # the 1%-churn workload: the acceptance ceiling is 10%; a code
+    # change pushing the measured share past 2x the frozen value means
+    # the churn-scaled-bytes property regressed
+    "replica_delta_vs_full_pct": 2.0,
 }
 
 

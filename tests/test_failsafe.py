@@ -407,6 +407,12 @@ class TestBlockingPathLint:
         # ...and the round-12 shm wire: a transport with spin-waits is
         # exactly where an unbounded block would hide
         assert "parallel/shm_wire.py" in scanned, sorted(scanned)
+        # ...and the round-17 replica plane (rglob pin): the fan-out
+        # thread's ship waits, the reader's attach/fetch loops and the
+        # heartbeat joins must all stay bounded or justified
+        for need in ("replica.py", "publisher.py", "delta.py",
+                     "__init__.py"):
+            assert f"replica/{need}" in scanned, sorted(scanned)
         assert not result.findings, (
             "unbounded blocking calls without a timeout-capable path or "
             "an 'unbounded-ok:' justification:\n"

@@ -55,6 +55,34 @@ class TestWheel:
         for mod in ("flight", "ops", "forensics", "watchdog",
                     "accounting"):
             assert f"multiverso_tpu/telemetry/{mod}.py" in names, names
+        # ...and the round-17 replica plane: the jax-free read tier is
+        # a deployment unit of its own (replica processes install the
+        # SAME wheel)
+        for mod in ("__init__", "delta", "publisher", "replica"):
+            assert f"multiverso_tpu/replica/{mod}.py" in names, names
+
+    def test_replica_import_path_is_jax_free(self):
+        """The replica reader's whole import graph must stay numpy-only
+        — `import multiverso_tpu.replica.replica` may never pull jax
+        (the read tier's scale-out premise: no device bootstrap, no
+        jax import cost, no accidental collectives). Runs against the
+        source tree; the lazy package __init__ (PEP 562) is what makes
+        this possible, so this test also pins that laziness."""
+        check = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import multiverso_tpu.replica.replica as rr\n"
+            "assert 'jax' not in sys.modules, 'jax entered the import "
+            "graph'\n"
+            "assert hasattr(rr, 'Replica') and hasattr(rr, 'main')\n"
+            "import numpy\n"
+            "print('REPLICA-JAXFREE-OK')\n")
+        env = dict(os.environ, PYTHONPATH=ROOT)
+        r = subprocess.run([sys.executable, "-c", check],
+                           capture_output=True, text=True, timeout=120,
+                           env=env)
+        assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
+        assert "REPLICA-JAXFREE-OK" in r.stdout
 
     def test_install_and_import_in_clean_venv(self, wheel, tmp_path):
         env_dir = tmp_path / "venv"

@@ -1,0 +1,526 @@
+"""Replica plane (round 17): delta codec units, live fan-out drills.
+
+Three tiers, mirroring the plane's layering:
+
+* codec units — journals, descriptor merges, base/delta round trips and
+  the mirror store's applicability CHECKs, all pure numpy (the same
+  code the jax-free reader runs);
+* a single-process RELAY drill — the remote-replica transport (the
+  coordinator's socket relay), bit-matching reads across publishes and
+  proving delta fan-out bytes ≪ base bytes on a small-churn workload;
+* the 2-proc trainer + same-host SHM replica drill and the replica-kill
+  drill (lease expiry evicts the subscription; the trainer keeps
+  publishing; /healthz names the departed replica).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_multihost import run_two_process
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_replica(endpoint: str, tmp_path, *, mode: str = "shm",
+                  lease: float = 3.0, name: str = "replica",
+                  keep: int = 2):
+    """Launch one reader process; returns (proc, status dict)."""
+    sf = str(tmp_path / f"{name}.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.replica.replica",
+         "--addr", endpoint, "--mode", mode, "--lease", str(lease),
+         "--keep", str(keep), "--status-file", sf],
+        env=dict(os.environ, PYTHONPATH=ROOT),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 30
+    while not os.path.exists(sf):
+        if proc.poll() is not None or time.time() > deadline:
+            out = proc.communicate(timeout=5)[0]
+            pytest.fail(f"replica never came up:\n{out[-2000:]}")
+        time.sleep(0.05)
+    with open(sf) as f:
+        status = json.load(f)
+    return proc, status
+
+
+def wait_version(client, version: int, timeout: float = 20.0) -> dict:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        last = client.status()
+        if (last["latest"] or -1) >= version:
+            return last
+        time.sleep(0.05)
+    pytest.fail(f"replica never reached v{version}: {last}")
+
+
+class TestJournal:
+    def test_rows_journal_accumulates_and_resets(self):
+        from multiverso_tpu.replica.delta import TableJournal
+        j = TableJournal("rows", num_rows=10)
+        j.mark_rows(np.array([3, 7]))
+        j.mark_rows(np.array([3, 5]))
+        d = j.drain()
+        assert d["kind"] == "rows"
+        assert d["ids"].tolist() == [3, 5, 7]
+        # drained: the next interval starts clean
+        d2 = j.drain()
+        assert d2["kind"] == "rows" and d2["ids"].size == 0
+
+    def test_rows_journal_whole_table_mark(self):
+        from multiverso_tpu.replica.delta import TableJournal
+        j = TableJournal("rows", num_rows=4)
+        j.mark_rows(None)
+        assert j.drain() == {"kind": "all"}
+
+    def test_keys_journal_copies_and_uniques(self):
+        from multiverso_tpu.replica.delta import TableJournal
+        j = TableJournal("keys")
+        src = np.array([9, 2, 9], np.int64)
+        j.mark_keys(src)
+        src[:] = 0          # the journal must have copied
+        j.mark_keys(np.array([2, 11], np.int64))
+        d = j.drain()
+        assert d["kind"] == "keys" and d["keys"].tolist() == [2, 9, 11]
+
+    def test_all_journal_flag(self):
+        from multiverso_tpu.replica.delta import TableJournal
+        j = TableJournal("all")
+        assert j.drain() == {"kind": "none"}
+        j.mark_all()
+        assert j.drain() == {"kind": "all"}
+        assert j.drain() == {"kind": "none"}
+
+    def test_merge_descriptors(self):
+        from multiverso_tpu.replica.delta import merge_descriptors
+        rows = lambda *ids: {"kind": "rows",  # noqa: E731
+                             "ids": np.asarray(ids, np.int64)}
+        m = merge_descriptors([rows(1, 2), {"kind": "none"}, rows(2, 5)])
+        assert m["kind"] == "rows" and m["ids"].tolist() == [1, 2, 5]
+        # an uncovered interval (None) poisons the union to "all"
+        assert merge_descriptors([rows(1), None])["kind"] == "all"
+        assert merge_descriptors([{"kind": "none"}])["kind"] == "none"
+        assert merge_descriptors(
+            [rows(1), {"kind": "all"}])["kind"] == "all"
+
+
+def _snap(version, tables, epoch=7):
+    from multiverso_tpu.serving.snapshot import Snapshot
+    return Snapshot(version=version, created_wall=time.time(),
+                    window_epoch=epoch, tables=tables)
+
+
+class TestCodecRoundTrip:
+    def _tables(self, rng):
+        from multiverso_tpu.serving.snapshot import (KVSnapshot,
+                                                     MatrixSnapshot,
+                                                     VectorSnapshot)
+        rows = rng.standard_normal((12, 3)).astype(np.float32)
+        keys = np.array([4, 1, 9], np.int64)
+        vals = np.array([1.5, -2.0, 3.25], np.float32)
+        vec = rng.standard_normal(6).astype(np.float32)
+        return {0: MatrixSnapshot.host(rows), 1: KVSnapshot(keys, vals),
+                2: VectorSnapshot(vec)}, rows, keys, vals, vec
+
+    def test_base_round_trip_every_family(self):
+        from multiverso_tpu.replica import delta as rd
+        rng = np.random.default_rng(0)
+        tables, rows, keys, vals, vec = self._tables(rng)
+        blob = rd.encode_base(_snap(1, tables))
+        mirrors = rd.MirrorStore()
+        snap = mirrors.apply(rd.decode(blob))
+        assert snap.version == 1 and snap.window_epoch == 7
+        assert np.array_equal(snap.tables[0].lookup_union(
+            np.arange(12)), rows)
+        k, v = snap.tables[1].items()
+        assert k.tolist() == [1, 4, 9]
+        assert np.array_equal(
+            snap.tables[1].lookup_union(np.array([4, 9, 777])),
+            np.array([1.5, 3.25, 0.0], np.float32))
+        assert np.array_equal(snap.tables[2].full(), vec)
+
+    def test_delta_rows_apply_bit_exact(self):
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.serving.snapshot import MatrixSnapshot
+        rng = np.random.default_rng(1)
+        rows1 = rng.standard_normal((256, 8)).astype(np.float32)
+        mirrors = rd.MirrorStore()
+        mirrors.apply(rd.decode(rd.encode_base(
+            _snap(1, {0: MatrixSnapshot.host(rows1)}))))
+        rows2 = rows1.copy()
+        dirty = np.array([2, 17, 100], np.int64)
+        rows2[dirty] += 1.0
+        blob = rd.encode_delta(
+            _snap(2, {0: MatrixSnapshot.host(rows2)}), 1,
+            {0: {"kind": "rows", "ids": dirty}})
+        snap2 = mirrors.apply(rd.decode(blob))
+        assert np.array_equal(
+            snap2.tables[0].lookup_union(np.arange(256)), rows2)
+        # and the delta blob is much smaller than the base would be
+        assert len(blob) < rows2.nbytes / 2
+
+    def test_empty_delta_carries_tables_forward(self):
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.serving.snapshot import MatrixSnapshot
+        rows = np.ones((8, 2), np.float32)
+        mirrors = rd.MirrorStore()
+        s1 = mirrors.apply(rd.decode(rd.encode_base(
+            _snap(1, {0: MatrixSnapshot.host(rows)}))))
+        blob = rd.encode_delta(
+            _snap(2, {0: MatrixSnapshot.host(rows)}), 1,
+            {0: {"kind": "none"}})
+        s2 = mirrors.apply(rd.decode(blob))
+        assert s2.version == 2
+        # clean table: the new version SHARES the previous arrays
+        # (both immutable) — no copy, no bytes on the wire
+        assert s2.tables[0]._rows is s1.tables[0]._rows
+
+    def test_kv_delta_merges_new_and_updated_keys(self):
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.serving.snapshot import KVSnapshot
+        mirrors = rd.MirrorStore()
+        mirrors.apply(rd.decode(rd.encode_base(_snap(1, {
+            0: KVSnapshot(np.array([2, 5], np.int64),
+                          np.array([1.0, 2.0], np.float32))}))))
+        # v2: key 5 updated, key 9 new
+        blob = rd.encode_delta(_snap(2, {
+            0: KVSnapshot(np.array([2, 5, 9], np.int64),
+                          np.array([1.0, 7.0, 4.0], np.float32))}), 1,
+            {0: {"kind": "keys", "keys": np.array([5, 9], np.int64)}})
+        s2 = mirrors.apply(rd.decode(blob))
+        got = s2.tables[0].lookup_union(np.array([2, 5, 9]))
+        assert got.tolist() == [1.0, 7.0, 4.0]
+
+    def test_corrupt_blob_raises_typed(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.serving.snapshot import VectorSnapshot
+        blob = bytearray(rd.encode_base(
+            _snap(1, {0: VectorSnapshot(np.ones(4, np.float32))})))
+        blob[len(blob) // 2] ^= 0x40
+        with pytest.raises(WireCorruption):
+            rd.decode(bytes(blob))
+
+    def test_mirror_rejects_version_gaps_and_replays(self):
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.serving.snapshot import VectorSnapshot
+        mirrors = rd.MirrorStore()
+        base = rd.decode(rd.encode_base(
+            _snap(3, {0: VectorSnapshot(np.ones(4, np.float32))})))
+        mirrors.apply(base)
+        with pytest.raises(Exception, match="not newer"):
+            mirrors.apply(base)
+        gap = rd.decode(rd.encode_delta(
+            _snap(9, {0: VectorSnapshot(np.ones(4, np.float32))}), 8,
+            {0: {"kind": "none"}}))
+        with pytest.raises(Exception, match="resync"):
+            mirrors.apply(gap)
+
+
+class TestRelayMailboxOverflow:
+    """A laggard's mailbox overflow is a RESYNC signal, not a failure:
+    the coordinator drops the queue and flags needs_base, the replica
+    stays live (a slow reader must never be evicted for being slow —
+    only the lease kills)."""
+
+    def test_overflow_drops_queue_and_flags_base(self):
+        from multiverso_tpu.elastic.coordinator import (Coordinator,
+                                                        MemberClient)
+        c = Coordinator("127.0.0.1", 0, lease_s=5.0)
+        try:
+            cl = MemberClient("127.0.0.1", c.port, 0, 5.0)
+            rid = cl.call("replica_join", mode="relay")["rid"]
+            for v in range(1, 5):
+                r = cl.call("replica_put", rid=rid, version=v, blob=b"x")
+                assert not r["overflow"], v
+            r = cl.call("replica_put", rid=rid, version=5, blob=b"x")
+            assert r["overflow"] and not r["evicted"]
+            rec = cl.call("replica_roster")["replicas"][0]
+            assert rec["status"] == "live"      # slow != dead
+            assert rec["needs_base"]            # next ship is a base
+            assert rec["mailbox_depth"] == 0    # queue dropped
+            # the flagged base lands normally afterwards
+            r = cl.call("replica_put", rid=rid, version=6, blob=b"base")
+            assert not r["overflow"]
+            got = cl.call("replica_fetch", rid=rid, timeout=5.0)
+            assert got["version"] == 6 and got["blob"] == b"base"
+        finally:
+            c.stop()
+
+
+class TestSparseJournal:
+    """The sparse family rides the SAME matrix journal hook
+    (_note_add_parts calls super) while its training-side freshness
+    bits keep transitioning independently — two machines, one hook."""
+
+    def test_sparse_marks_journal_and_keeps_freshness(self, mv_env):
+        import multiverso_tpu as mv
+        from multiverso_tpu.replica import delta as rd
+        from multiverso_tpu.tables import SparseMatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+
+        t = mv.MV_CreateTable(SparseMatrixTableOption(num_rows=16,
+                                                      num_cols=2))
+        server = Zoo.Get().server_tables[0]
+        # the plane is off in mv_env: attach a journal by hand (the
+        # publisher does this at RegisterTable when fan-out is on)
+        server._pub_journal = rd.journal_for_table(server)
+        assert server._pub_journal.kind == "rows"
+        t.AddRows(np.array([3, 9], np.int32),
+                  np.ones((2, 2), np.float32))
+        Zoo.Get().DrainServer()
+        d = server._pub_journal.drain()
+        assert d["kind"] == "rows" and d["ids"].tolist() == [3, 9]
+        # ...and the two machines really are independent: with ONE
+        # global worker there is no *other* worker to mark stale, so
+        # the freshness bits stay all-fresh (UpdateAddState excludes
+        # the keeper) — yet the publish journal still caught the rows,
+        # which is exactly why the freshness bitmap alone could never
+        # have fed the fan-out
+        assert server.up_to_date.all()
+
+
+class TestReplicaRelayLive:
+    """Single-process trainer + one RELAY-mode replica: the remote
+    transport path (coordinator socket relay) end to end."""
+
+    def test_relay_replica_bit_matches_and_deltas_stay_small(
+            self, tmp_path):
+        import multiverso_tpu as mv
+        from multiverso_tpu.replica.replica import ReplicaClient
+        from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+        from multiverso_tpu.telemetry import metrics as tmetrics
+
+        R, C = 5000, 16
+        mv.MV_Init(["-mv_replica_fanout=true"])
+        proc = None
+        try:
+            from multiverso_tpu.replica import publisher
+            ep = publisher.publisher_endpoint()
+            assert ep is not None
+            mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R,
+                                                      num_cols=C))
+            kvt = mv.MV_CreateTable(KVTableOption())
+            rng = np.random.default_rng(0)
+            mat.AddRows(np.arange(R, dtype=np.int32),
+                        rng.standard_normal((R, C)).astype(np.float32))
+            kvt.Add(np.array([3, 8], np.int64),
+                    np.array([1.0, 2.0], np.float32))
+            v1 = mv.MV_PublishSnapshot()
+            proc, st = spawn_replica(ep, tmp_path, mode="relay")
+            rc = ReplicaClient("127.0.0.1", st["serve_port"])
+            wait_version(rc, v1)
+
+            def counter(name):
+                return tmetrics.snapshot().get(name, {}).get("value", 0)
+
+            base_bytes = counter("replica.fanout_bytes")
+            assert base_bytes > R * C * 4  # the base carried the table
+
+            # 1% churn -> the delta must be tiny vs the base
+            sel = rng.choice(R, R // 100, replace=False).astype(np.int32)
+            mat.AddRows(sel, np.ones((len(sel), C), np.float32))
+            kvt.Add(np.array([8, 21], np.int64),
+                    np.array([5.0, 6.0], np.float32))
+            v2 = mv.MV_PublishSnapshot()
+            wait_version(rc, v2)
+            delta_bytes = counter("replica.fanout_bytes") - base_bytes
+            assert 0 < delta_bytes <= 0.10 * base_bytes, (
+                f"delta fan-out {delta_bytes}B vs base {base_bytes}B")
+
+            # bit-match: both live versions, both tables
+            ids = np.sort(rng.choice(R, 64, replace=False))
+            for v in (v1, v2):
+                got = rc.lookup(0, ids, version=v)
+                want = mv.MV_ServingLookup(mat, ids, version=v)
+                assert np.array_equal(got, want), f"matrix v{v}"
+            got = rc.lookup(1, [3, 8, 21, 999], version=v2)
+            want = mv.MV_ServingLookup(kvt, [3, 8, 21, 999], version=v2)
+            assert np.array_equal(got, want)
+            # retention carried over: replica holds exactly the keep=2
+            assert rc.status()["live_versions"] == [v1, v2]
+        finally:
+            if proc is not None:
+                proc.terminate()
+                proc.wait(timeout=10)
+            mv.MV_ShutDown()
+
+
+class TestReplicaKillDrill:
+    """Lease expiry evicts the subscription; the trainer keeps
+    publishing; /healthz names the departed replica."""
+
+    def test_dead_replica_is_evicted_and_healthz_names_it(
+            self, tmp_path):
+        import urllib.request
+
+        import multiverso_tpu as mv
+        from multiverso_tpu.replica.replica import ReplicaClient
+        from multiverso_tpu.tables import MatrixTableOption
+
+        mv.MV_Init(["-mv_replica_fanout=true", "-mv_ops_port=0"])
+        proc = None
+        try:
+            from multiverso_tpu.replica import publisher
+            from multiverso_tpu.telemetry import ops as tops
+            ep = publisher.publisher_endpoint()
+            mat = mv.MV_CreateTable(MatrixTableOption(num_rows=64,
+                                                      num_cols=4))
+            mat.AddRows(np.arange(64, dtype=np.int32),
+                        np.ones((64, 4), np.float32))
+            v1 = mv.MV_PublishSnapshot()
+            proc, st = spawn_replica(ep, tmp_path, lease=1.0)
+            rc = ReplicaClient("127.0.0.1", st["serve_port"])
+            wait_version(rc, v1)
+            rid = st["rid"]
+
+            proc.kill()             # silent death — no goodbye RPC
+            proc.wait(timeout=10)
+            proc = None
+            # lease 1s + fan-out poll 0.25s: evicted within a few s
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                rep = publisher.status_report()
+                states = {s["rid"]: s["state"]
+                          for s in rep["subscribers"]}
+                if states.get(rid) in ("dead", "evicted"):
+                    break
+                time.sleep(0.1)
+            assert states.get(rid) in ("dead", "evicted"), rep
+
+            # trainer publishes keep working after the eviction
+            mat.AddRows(np.arange(8, dtype=np.int32),
+                        np.ones((8, 4), np.float32))
+            v2 = mv.MV_PublishSnapshot()
+            assert v2 == v1 + 1
+
+            # /healthz carries the per-replica line, departure included
+            port = tops.port()
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+            subs = {s["rid"]: s for s in body["replica"]["subscribers"]}
+            assert subs[rid]["state"] in ("dead", "evicted"), body
+            assert body["status"] == "ok"   # a departed replica is not
+        finally:                            # a trainer health problem
+            if proc is not None:
+                proc.kill()
+                proc.wait(timeout=10)
+            mv.MV_ShutDown()
+
+
+_TWO_PROC_CHILD = r'''
+import json, os, subprocess, sys, threading, time
+rank, port, cport, statdir = (int(sys.argv[1]), sys.argv[2],
+                              sys.argv[3], sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import multihost
+from multiverso_tpu.tables import MatrixTableOption
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=60",
+            "-mv_replica_fanout=true",
+            f"-mv_replica_addr=127.0.0.1:{cport}"])
+R, C = 256, 8
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+rng = np.random.default_rng(40 + rank)
+ids_all = np.arange(R, dtype=np.int32)
+
+# lockstep training, then the first cut
+for step in range(4):
+    sel = np.sort(rng.choice(R, 16, replace=False)).astype(np.int32)
+    mat.AddRows(sel, rng.standard_normal((16, C)).astype(np.float32))
+mv.MV_Barrier()
+v1 = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v1)
+
+# rank 0 (the fan-out owner) hosts the same-host SHM replica
+proc = rc = None
+if rank == 0:
+    from multiverso_tpu.replica import publisher
+    from multiverso_tpu.replica.replica import ReplicaClient
+    sf = os.path.join(statdir, "rep.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.replica.replica",
+         "--addr", publisher.publisher_endpoint(), "--mode", "shm",
+         "--lease", "5", "--status-file", sf],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    for _ in range(400):
+        if os.path.exists(sf):
+            break
+        time.sleep(0.05)
+    assert os.path.exists(sf), "replica never wrote its status file"
+    rc = ReplicaClient("127.0.0.1", json.load(open(sf))["serve_port"])
+    deadline = time.time() + 30
+    while (rc.status()["latest"] or -1) < v1:
+        assert time.time() < deadline, rc.status()
+        time.sleep(0.05)
+mv.MV_Barrier()
+
+# second publish: the replica must follow via a DELTA
+sel = np.sort(rng.choice(R, 8, replace=False)).astype(np.int32)
+mat.AddRows(sel, rng.standard_normal((8, C)).astype(np.float32))
+mv.MV_Barrier()
+v2 = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v2)
+if rank == 0:
+    deadline = time.time() + 30
+    while (rc.status()["latest"] or -1) < v2:
+        assert time.time() < deadline, rc.status()
+        time.sleep(0.05)
+mv.MV_Barrier()
+
+# quiesce, then prove the replica path adds ZERO host collective
+# rounds: rank 0 reads the replica while rank 1 sits idle; both ranks
+# pin the STATS counter across the window
+from multiverso_tpu.zoo import Zoo
+Zoo.Get().DrainServer()
+mv.MV_Barrier()
+oracle1 = mv.MV_ServingLookup(mat, ids_all, version=v1)
+oracle2 = mv.MV_ServingLookup(mat, ids_all, version=v2)
+before = multihost.STATS["host_collective_rounds"]
+if rank == 0:
+    r = np.random.default_rng(7)
+    for _ in range(25):
+        sel = np.sort(r.choice(R, 32, replace=False)).astype(np.int32)
+        got1 = rc.lookup(0, sel, version=v1)
+        got2 = rc.lookup(0, sel, version=v2)
+        assert np.array_equal(got1, oracle1[sel]), "v1 mismatch"
+        assert np.array_equal(got2, oracle2[sel]), "v2 mismatch"
+else:
+    time.sleep(2.0)
+assert multihost.STATS["host_collective_rounds"] == before, (
+    f"replica serving issued host collectives: {before} -> "
+    f"{multihost.STATS}")
+mv.MV_Barrier()
+if proc is not None:
+    proc.terminate()
+    proc.wait(timeout=10)
+mv.MV_ShutDown()
+print(f"child {rank} REPLICA-2PROC OK", flush=True)
+'''
+
+
+class TestReplicaTwoProc:
+    def test_shm_replica_follows_a_two_proc_trainer(self, tmp_path):
+        """Acceptance drill: a 2-proc SPMD trainer publishes twice; a
+        same-host shm replica (fed by rank 0) bit-matches pinned
+        in-process lookups on BOTH versions, and the whole fan-out +
+        replica-read path adds zero host collective rounds."""
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        cport = s.getsockname()[1]
+        s.close()
+        run_two_process(_TWO_PROC_CHILD, tmp_path, str(cport),
+                        str(tmp_path), expect="REPLICA-2PROC OK")

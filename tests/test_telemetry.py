@@ -314,6 +314,12 @@ class TestNoBarePrintLint:
         # ...and the round-16 analysis plane itself (its CLI writes to
         # stdout via sys.stdout.write, never bare print)
         assert "analysis/cli.py" in scanned, sorted(scanned)
+        # ...and the round-17 replica plane: the rglob pin — every one
+        # of its modules (reader process included, whose stdout is a
+        # service surface) must ride the logger
+        for need in ("replica.py", "publisher.py", "delta.py",
+                     "__init__.py"):
+            assert f"replica/{need}" in scanned, sorted(scanned)
         assert not result.findings, (
             "bare print() in the package — route output through "
             "utils/log.py or the telemetry exporters:\n"

@@ -36,8 +36,8 @@ from multiverso_tpu.telemetry import accounting, flight, metrics, ops
 from multiverso_tpu.telemetry import watchdog as twd
 from multiverso_tpu.telemetry.watchdog import (
     HOLD, ApplyPoolSaturationRule, MailboxBacklogRule, MemoryGrowthRule,
-    Rule, ShardImbalanceRule, ShmBackpressureRule, SnapshotStaleRule,
-    StragglerRule, Watchdog)
+    ReplicaLagRule, Rule, ShardImbalanceRule, ShmBackpressureRule,
+    SnapshotStaleRule, StragglerRule, Watchdog)
 
 from tests.test_multihost import run_two_process
 
@@ -221,6 +221,20 @@ class TestSlopeRules:
         tiny = [{"mem_total": v} for v in (100, 200, 300, 400)]
         assert r.check(tiny) is HOLD        # under the floor
 
+    def test_replica_lag_needs_live_subscribers(self):
+        r = ReplicaLagRule(max_lag=3)
+        behind = [{"replica_subscribers": 2, "replica_lag_versions": 4}]
+        assert isinstance(r.check(behind), str)
+        caught_up = [{"replica_subscribers": 2,
+                      "replica_lag_versions": 1}]
+        assert r.check(caught_up) is None
+        # no subscribers (or the plane off): nothing can lag — HOLD,
+        # never a spurious clear/fire flap
+        nobody = [{"replica_subscribers": 0,
+                   "replica_lag_versions": 0}]
+        assert r.check(nobody) is HOLD
+        assert r.check([{}]) is HOLD
+
     def test_straggler_proxy(self):
         r = StragglerRule(min_windows=3, min_apply_per_window_s=0.01,
                           xw_ratio=3.0)
@@ -313,8 +327,8 @@ class TestEagerRegistrationAndSurfaces:
             assert body["enabled"] is True and body["ticks"] >= 2
             assert sorted(body["rules"]) == [
                 "apply_pool_sat", "mailbox_backlog", "memory_growth",
-                "shard_imbalance", "shm_backpressure", "snapshot_stale",
-                "straggler"]
+                "replica_lag", "shard_imbalance", "shm_backpressure",
+                "snapshot_stale", "straggler"]
             hz = json.loads(_scrape("/healthz")[1])
             assert hz["status"] == "ok" and hz["alerts"] == []
         finally:
