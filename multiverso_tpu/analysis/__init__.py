@@ -12,8 +12,14 @@ turns them into machine-checked laws:
 * :mod:`callgraph` — the package-wide static call graph;
 * :mod:`rules` — the four AST checkers;
 * :mod:`collective` — the call-graph never-collective checker;
-* :mod:`cli` — ``python -m multiverso_tpu.analysis`` (text / ``--json``,
-  exit codes 0 clean / 1 findings / 2 usage).
+* :mod:`threads` — the thread-root inventory: every spawned thread
+  classified into a named concurrency domain, with per-domain BFS
+  closures and the two-way config-rot law (DESIGN.md §18);
+* :mod:`concurrency` — the four domain checkers (cross-domain-state,
+  device-work-domain, lock-order, blocking-domain);
+* :mod:`cli` — ``python -m multiverso_tpu.analysis`` and the
+  ``mvlint`` console script (text / ``--json``, exit codes 0 clean /
+  1 findings / 2 usage).
 
 The analysis modules themselves import neither jax nor any runtime
 state — scanning is pure source analysis, so the CLI also works on a

@@ -80,6 +80,40 @@ _COMMON_METHOD_NAMES = frozenset({
 })
 
 
+def walk_shallow(node: ast.AST):
+    """ast.walk that does NOT descend into nested defs/lambdas — for
+    passes where a nested callback's statements must not masquerade as
+    the enclosing def's (e.g. a nested ``return Worker()`` is not the
+    outer function's return value)."""
+    stack = list(_shallow_children(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(_shallow_children(n))
+
+
+def _shallow_children(node: ast.AST):
+    for child in ast.iter_child_nodes(node):
+        yield child
+
+
+def iter_top_defs(tree: ast.AST):
+    """(qualname, owning ClassDef or None, def node) for every
+    top-level function and method — the ONE place that owns the
+    graph-node granularity rule (flat_body guard flattening; nested
+    defs/lambdas merge into the enclosing def)."""
+    for node in flat_body(tree.body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in flat_body(node.body):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", node, sub
+
+
 def flat_body(body) -> "list":
     """Module/class-body statements with conditional/guard scaffolding
     flattened: a def under a module-level ``if``/``try``/``with`` (the
@@ -119,6 +153,11 @@ class ClassInfo:
     rel: str
     bases: List[Tuple[str, str]] = field(default_factory=list)  # (rel, name)
     methods: Dict[str, int] = field(default_factory=dict)       # name -> line
+    #: instance-attribute types inferred from ``self.X = ClassName(...)``
+    #: assignments in any method; a conflicting re-assignment poisons
+    #: the entry (None) so a wrong type never resolves a chain
+    attr_types: Dict[str, Optional[Tuple[str, str]]] = \
+        field(default_factory=dict)
 
 
 @dataclass
@@ -142,6 +181,8 @@ class CallGraph:
         self.node_lines: Dict[str, Tuple[str, int]] = {}  # node -> (rel, line)
         #: method name -> every "<rel>:<Class.m>" node (fallback targets)
         self.methods_by_name: Dict[str, Set[str]] = {}
+        #: def node -> the in-package class its calls return
+        self.ret_types: Dict[str, Tuple[str, str]] = {}
         self.stats = {"calls": 0, "resolved": 0, "fallback": 0,
                       "dropped": 0}
         self._build()
@@ -167,6 +208,12 @@ class CallGraph:
         # base-class names resolve only after every module's defs exist
         for mi in self.modules.values():
             self._resolve_bases(mi)
+        # return types feed attr types (self.x = factory()) which feed
+        # the edge pass — strict order
+        for mi in self.modules.values():
+            self._infer_return_types(mi)
+        for mi in self.modules.values():
+            self._infer_attr_types(mi)
         for mi in self.modules.values():
             self._collect_edges(mi)
 
@@ -198,13 +245,14 @@ class CallGraph:
                     name = alias.name
                     if name != self.pkg_name \
                             and not name.startswith(pkg_prefix):
-                        # external module: record it so attribute calls
-                        # on it (subprocess.run, np.sum) resolve to
+                        # external module: record it (with its dotted
+                        # origin) so attribute calls on it
+                        # (subprocess.run, np.sum) resolve to
                         # "external" and DON'T hit the method-name
                         # fallback — stdlib receivers must not fan out
                         # to every same-named package method
                         local = alias.asname or name.split(".")[0]
-                        mi.imports.setdefault(local, ("ext",))
+                        mi.imports.setdefault(local, ("ext", name))
                         continue
                     rel = self._dotted_rel(name)
                     if rel is None:
@@ -221,11 +269,14 @@ class CallGraph:
                 target = self._from_target(mi, node)
                 if target is None:
                     if node.level == 0:
-                        # external from-import: same external marker for
-                        # the bound names (threading.Thread, Path, ...)
+                        # external from-import: the external marker
+                        # keeps the source module AND original symbol
+                        # name, so an aliased `from threading import
+                        # Thread as Worker` still reads as a spawn
                         for alias in node.names:
                             mi.imports.setdefault(
-                                alias.asname or alias.name, ("ext",))
+                                alias.asname or alias.name,
+                                ("ext", node.module or "", alias.name))
                     continue
                 for alias in node.names:
                     local = alias.asname or alias.name
@@ -267,6 +318,153 @@ class CallGraph:
                 ref = self._lookup_class(mi, b)
                 if ref is not None:
                     ci.bases.append(ref)
+
+    def _ann_class(self, mi: ModuleInfo,
+                   ann: Optional[ast.AST]) -> Optional[Tuple[str, str]]:
+        """Resolve a return annotation to an in-package class:
+        ``-> Monitor``, ``-> "Monitor"`` (forward ref),
+        ``-> Optional[KvIndex]`` / ``-> KvIndex | None`` unwrap."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return self._class_by_name(mi, ann.value)
+        if isinstance(ann, ast.Name):
+            return self._class_by_name(mi, ann.id)
+        if isinstance(ann, ast.Attribute):
+            return self._lookup_class(mi, ann)
+        if isinstance(ann, ast.Subscript):
+            # Optional[X]: unwrap; other generics (List[X]...) are NOT
+            # the instance itself — skip them
+            base = ann.value
+            name = (base.id if isinstance(base, ast.Name)
+                    else base.attr if isinstance(base, ast.Attribute)
+                    else None)
+            if name == "Optional":
+                return self._ann_class(mi, ann.slice)
+            return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            left = self._ann_class(mi, ann.left)
+            if left is not None:
+                return left
+            return self._ann_class(mi, ann.right)
+        return None
+
+    def _infer_return_types(self, mi: ModuleInfo) -> None:
+        """Factory-return inference: a def whose return ANNOTATION
+        names an in-package class (Optional unwrapped), or whose every
+        class-typed ``return`` agrees on one class (directly or through
+        a ``x = ClassName(...)`` local), types its call results — so
+        ``mon = Dashboard.Get(name)`` resolves ``mon.Add`` through the
+        real Monitor instead of the dynamic-dispatch fallback."""
+        def _infer(qual: str, node: ast.AST) -> None:
+            cref = self._ann_class(mi, node.returns)
+            if cref is None:
+                # SHALLOW walks: a nested callback's assignments and
+                # returns are not the enclosing def's (a nested
+                # `return Worker()` must not type the outer call)
+                local_types: Dict[str, Tuple[str, str]] = {}
+                for sub in walk_shallow(node):
+                    if isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Call) \
+                            and isinstance(sub.value.func, ast.Name):
+                        c = self._class_by_name(mi, sub.value.func.id)
+                        if c is not None:
+                            for tgt in sub.targets:
+                                if isinstance(tgt, ast.Name):
+                                    local_types[tgt.id] = c
+                seen: set = set()
+                for sub in walk_shallow(node):
+                    if not isinstance(sub, ast.Return) \
+                            or sub.value is None:
+                        continue
+                    v = sub.value
+                    if isinstance(v, ast.Call) \
+                            and isinstance(v.func, ast.Name):
+                        seen.add(self._class_by_name(mi, v.func.id))
+                    elif isinstance(v, ast.Name):
+                        seen.add(local_types.get(v.id))
+                    elif isinstance(v, ast.Constant) and v.value is None:
+                        continue
+                    else:
+                        seen.add(None)
+                if len(seen) == 1:
+                    cref = seen.pop()
+            if cref is not None:
+                self.ret_types[f"{mi.rel}:{qual}"] = cref
+
+        for qual, _, node in iter_top_defs(mi.sf.tree):
+            _infer(qual, node)
+
+    def _call_result_type(self, mi: ModuleInfo, call: ast.Call,
+                          local_types=None, own_class=None
+                          ) -> Optional[Tuple[str, str]]:
+        """The in-package class a call returns: a constructor call, or
+        a call to a def with an inferred return type."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            cref = self._class_by_name(mi, fn.id)
+            if cref is not None:
+                return cref
+            state = self._resolve_symbol(mi.rel, fn.id)
+        elif isinstance(fn, ast.Attribute):
+            chain = _attr_chain(fn)
+            if chain is None:
+                return None
+            state = self._chain_resolve(mi, chain, local_types, own_class)
+        else:
+            return None
+        if state is not None and state[0] == "class":
+            return (state[1], state[2])
+        if state is not None and state[0] == "func":
+            return self.ret_types.get(f"{state[1]}:{state[2]}")
+        return None
+
+    def _infer_attr_types(self, mi: ModuleInfo) -> None:
+        """One-pass instance-attribute type inference:
+        ``self.X = ClassName(...)`` (or ``mod.ClassName(...)``) in ANY
+        method types attribute ``X`` for the class, so later chains
+        (``self.store.get(...)``) resolve through the real class
+        instead of dropping to the dynamic-dispatch name fallback.
+        Conflicting re-assignments poison the entry — a wrong type must
+        never resolve a chain."""
+        for _, cls_node, sub in iter_top_defs(mi.sf.tree):
+            if cls_node is None:
+                continue
+            ci = mi.classes[cls_node.name]
+            for st in ast.walk(sub):
+                if not (isinstance(st, ast.Assign)
+                        and isinstance(st.value, ast.Call)):
+                    continue
+                cref = self._call_result_type(mi, st.value)
+                for tgt in st.targets:
+                    if not (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        continue
+                    attr = tgt.attr
+                    if attr in ci.attr_types:
+                        if ci.attr_types[attr] != cref:
+                            ci.attr_types[attr] = None  # conflict
+                    else:
+                        ci.attr_types[attr] = cref
+
+    def _mro_attr_type(self, rel: str, cname: str, attr: str,
+                       _seen=None) -> Optional[Tuple[str, str]]:
+        seen = _seen or set()
+        if (rel, cname) in seen:
+            return None
+        seen.add((rel, cname))
+        mi = self.modules.get(rel)
+        if mi is None or cname not in mi.classes:
+            return None
+        ci = mi.classes[cname]
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        for brel, bname in ci.bases:
+            got = self._mro_attr_type(brel, bname, attr, seen)
+            if got is not None:
+                return got
+        return None
 
     def _lookup_class(self, mi: ModuleInfo,
                       expr: ast.AST) -> Optional[Tuple[str, str]]:
@@ -317,7 +515,7 @@ class CallGraph:
         if imp is None:
             return None
         if imp[0] == "ext":
-            return ("ext",)
+            return imp      # carries (module, origin-symbol) when known
         if imp[0] == "mod":
             return ("mod", imp[1])
         seen = _seen or set()
@@ -358,6 +556,13 @@ class CallGraph:
                     state = self._resolve_symbol(state[1], part)
             elif kind == "class":
                 m = self._mro_method(state[1], state[2], part)
+                if m is None:
+                    # not a method: a typed instance attribute keeps
+                    # the chain resolving (self.store.get -> the real
+                    # SnapshotStore.get, not the name fallback)
+                    at = self._mro_attr_type(state[1], state[2], part)
+                    m = ("class", at[0], at[1]) if at is not None \
+                        else None
                 state = m           # ("func", rel, Class.m) or None
             else:
                 return None         # attribute of a function: opaque
@@ -400,21 +605,84 @@ class CallGraph:
                 # is module-level code
                 self._edges_for_def(mi, mod_owner, node, None)
 
+    def spawn_kind(self, rel: str, call: ast.Call) -> Optional[str]:
+        """"Thread"/"Timer" when ``call`` constructs an EXTERNAL
+        (threading) Thread/Timer — in-package classes sharing the name
+        (the utils Timer stopwatch) resolve through the import table
+        and return None, and an ALIASED from-import (``from threading
+        import Thread as Worker``) still reads as a spawn through the
+        import record's origin symbol."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            state = self._resolve_symbol(rel, fn.id)
+            if state is not None and state[0] == "ext" \
+                    and len(state) >= 3 and state[1] == "threading" \
+                    and state[2] in ("Thread", "Timer"):
+                return state[2]
+            if fn.id in ("Thread", "Timer") \
+                    and (state is None or state[0] == "ext"):
+                return fn.id
+            return None
+        if isinstance(fn, ast.Attribute) and fn.attr in ("Thread",
+                                                         "Timer"):
+            chain = _attr_chain(fn)
+            if chain is None:
+                return None
+            state = self._resolve_symbol(rel, chain[0])
+            if state is None or state[0] == "ext":
+                return fn.attr
+        return None
+
     def _edges_for_def(self, mi: ModuleInfo, owner: str, root: ast.AST,
                        own_class: Optional[ClassInfo]) -> None:
         local_types: Dict[str, Tuple[str, str]] = {}
         # pass 1: one-shot constructor type inference (x = ClassName(...))
+        # plus the THREAD-BOUNDARY CUT: the target= callback of a
+        # threading.Thread/Timer spawn (and every RegisterHandler
+        # argument) runs on the NEW/actor thread, not this one — like
+        # a mailbox hop, the static chain must end at the spawn (the
+        # thread inventory classifies the target's domain explicitly).
+        # The cut covers the callback expression's WHOLE subtree, so a
+        # lambda or functools.partial wrapper is cut too, not just a
+        # bare name/attribute ref. Without the cut, every spawner's
+        # domain swallows its spawned thread's closure.
+        spawn_callbacks: set = set()
+
+        def _cut(expr: ast.AST) -> None:
+            spawn_callbacks.update(ast.walk(expr))
+
         for node in ast.walk(root):
             if isinstance(node, ast.Assign) \
-                    and isinstance(node.value, ast.Call) \
-                    and isinstance(node.value.func, ast.Name):
-                cref = self._class_by_name(mi, node.value.func.id)
+                    and isinstance(node.value, ast.Call):
+                cref = self._call_result_type(mi, node.value,
+                                              local_types, own_class)
                 if cref is not None:
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             local_types[tgt.id] = cref
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self.spawn_kind(mi.rel, node)
+            if kind is not None:
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        _cut(kw.value)
+                if len(node.args) >= 2:
+                    # positional callbacks: Thread(group, target, ...)
+                    # and Timer(interval, function, ...) both carry the
+                    # callable at args[1]; args[0] evaluates on THIS
+                    # thread and keeps its edges
+                    _cut(node.args[1])
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "RegisterHandler":
+                for arg in node.args:
+                    _cut(arg)
+                for kw in node.keywords:
+                    _cut(kw.value)
         # pass 2: calls + callable references
         for node in ast.walk(root):
+            if node in spawn_callbacks:
+                continue
             if isinstance(node, ast.Call):
                 self._edge_for_call(mi, owner, node, local_types, own_class)
             elif isinstance(node, (ast.Name, ast.Attribute)) \
@@ -450,10 +718,25 @@ class CallGraph:
                                             own_class)
             elif isinstance(func.value, ast.Call) \
                     and isinstance(func.value.func, ast.Name):
-                # ClassName(...).method(...)
-                cref = self._class_by_name(mi, func.value.func.id)
-                if cref is not None:
-                    state = self._mro_method(cref[0], cref[1], attr)
+                if func.value.func.id == "super" \
+                        and own_class is not None:
+                    # super().m(...): resolve through the bases only —
+                    # without this, super().ProcessGet used to take the
+                    # name fallback and wire the caller into EVERY
+                    # table's ProcessGet
+                    for brel, bname in own_class.bases:
+                        state = self._mro_method(brel, bname, attr)
+                        if state is not None:
+                            break
+                else:
+                    # ClassName(...).method(...) — or a typed factory
+                    # call result
+                    cref = self._class_by_name(mi, func.value.func.id)
+                    if cref is None:
+                        cref = self._call_result_type(
+                            mi, func.value, local_types, own_class)
+                    if cref is not None:
+                        state = self._mro_method(cref[0], cref[1], attr)
             if state is not None and state[0] != "ext":
                 self._edge_for_state(owner, state, mi)
                 return

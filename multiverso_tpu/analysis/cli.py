@@ -57,7 +57,8 @@ def _out(text: str) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     # checker modules register on import
-    from multiverso_tpu.analysis import collective, rules  # noqa: F401
+    from multiverso_tpu.analysis import (collective, concurrency,  # noqa: F401
+                                         rules, threads)  # noqa: F401
     try:
         args = _parser().parse_args(argv)
     except SystemExit as exc:       # argparse exits 2 on usage errors

@@ -340,7 +340,8 @@ def run_analysis(root: Optional[str] = None,
     import; import them before calling this with ``rules=None``."""
     # the sibling modules register their checkers at import time; pull
     # them in so a bare run_analysis() sees the full registry
-    from multiverso_tpu.analysis import collective, rules as _rules  # noqa: F401
+    from multiverso_tpu.analysis import (collective, concurrency,  # noqa: F401
+                                         rules as _rules, threads)  # noqa: F401
 
     names = rules if rules is not None else all_checker_names()
     if rules is not None and not names:

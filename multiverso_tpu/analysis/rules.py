@@ -21,23 +21,18 @@ import ast
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from multiverso_tpu.analysis.callgraph import flat_body
+from multiverso_tpu.analysis.callgraph import iter_top_defs
 from multiverso_tpu.analysis.core import (Checker, Finding, PackageIndex,
                                           SourceFile, register)
 
 
 def _defs_with_quals(tree: ast.AST) -> Iterable[Tuple[str, ast.AST]]:
     """(qualname, def-node) for every top-level function and method —
-    including defs under module/class-level ``if``/``try`` scaffolding
-    (flat_body); nested defs/lambdas stay inside their enclosing def's
-    subtree."""
-    for node in flat_body(tree.body):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node.name, node
-        elif isinstance(node, ast.ClassDef):
-            for sub in flat_body(node.body):
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    yield f"{node.name}.{sub.name}", sub
+    including defs under module/class-level ``if``/``try`` scaffolding;
+    nested defs/lambdas stay inside their enclosing def's subtree
+    (callgraph.iter_top_defs owns the granularity rule)."""
+    for qual, _, node in iter_top_defs(tree):
+        yield qual, node
 
 
 @register

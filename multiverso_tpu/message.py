@@ -88,6 +88,10 @@ def next_msg_id() -> int:
         return next(_msg_id_counter)
 
 
+#: shared first-reply-wins gate (see Message.reply for why shared)
+_reply_lock = threading.Lock()
+
+
 @dataclass
 class Message:
     msg_type: MsgType = MsgType.Default
@@ -115,11 +119,20 @@ class Message:
     def reply(self, result: Any = None) -> None:
         """First reply wins; later replies (e.g. an engine-level error after
         a successful table reply) are dropped so a request's outcome can't be
-        rewritten or its waiter over-notified."""
-        if self._replied:
-            return
-        self._replied = True
-        self.result = result
+        rewritten or its waiter over-notified. The check-and-set rides a
+        (module-shared) lock: the engine thread's normal reply races the
+        worker-side poison sweep (``Actor._fail_pending`` runs on whichever
+        thread pushed last when the loop is dying), and an unlocked
+        check-then-act could deliver BOTH replies — rewriting the result
+        after a waiter woke, or over-notifying the waiter (found by mvlint
+        cross-domain-state). One shared lock, not per-message: the guarded
+        region is two attribute stores, so contention is nil, and the verb
+        hot path skips a Lock allocation per Message."""
+        with _reply_lock:
+            if self._replied:
+                return
+            self._replied = True
+            self.result = result
         if self.on_reply is not None:
             self.on_reply(self)
         if self.waiter is not None:

@@ -121,11 +121,13 @@ class Model:
             self._timer.Start()
             lr = jnp.float32(self.updater.learning_rate())
             if self.ftrl:
+                # mv-lint: ok(cross-domain-state): the engine-domain writer is the elastic-restore leg (Model.Load via rebuild_world), which runs inside a fenced world transition while training is quiesced — the phases never overlap
                 self.z, self.n, loss = self._ftrl_step(
                     self.z, self.n, jnp.asarray(batch.keys.astype(np.int32)),
                     jnp.asarray(batch.values), jnp.asarray(batch.mask),
                     jnp.asarray(batch.labels), jnp.asarray(batch.weights))
             elif self.config.sparse:
+                # mv-lint: ok(cross-domain-state): same fenced-transition argument as the ftrl branch above
                 self.W, loss = self._sparse_step(
                     self.W, jnp.asarray(batch.keys.astype(np.int32)),
                     jnp.asarray(batch.values), jnp.asarray(batch.mask),

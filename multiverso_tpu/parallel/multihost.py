@@ -137,6 +137,7 @@ _exchange_last = {"enter_m": 0.0, "done_m": 0.0, "done_w": 0.0,
 def _stamp_exchange(enter_m: float, coll_s: float, done_m: float,
                     done_w: float) -> None:
     global _exchange_last
+    # mv-lint: ok(cross-domain-state): one atomic dict-REF store per exchange (the torn-read-free design documented above); the worker-domain reachability is the MA-mode aggregate path, and MA worlds run no engine thread
     _exchange_last = {"enter_m": enter_m, "done_m": done_m,
                       "done_w": done_w, "coll_s": coll_s}
 

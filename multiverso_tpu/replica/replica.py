@@ -109,6 +109,12 @@ class Replica:
         self.mirrors = rdelta.MirrorStore()
         self.rid: Optional[int] = None
         self.latest_known = -1
+        #: guards latest_known/exit_code: the heartbeat thread and the
+        #: main apply loop both advance latest_known with a
+        #: read-max-write — unlocked, a stale read could regress it and
+        #: fire a spurious lag gauge (found by mvlint
+        #: cross-domain-state, regression-tested in test_replica)
+        self._state_lock = threading.Lock()
         self.applies = 0
         self._wire = None
         self._serve_port = int(serve_port)
@@ -152,7 +158,8 @@ class Replica:
 
     def _die(self, code: int, why: str) -> None:
         Log.Error("replica r%s exiting (%d): %s", self.rid, code, why)
-        self.exit_code = code
+        with self._state_lock:
+            self.exit_code = code
         self._stop.set()
         # the recv loop may be parked in an shm exchange with nothing
         # arriving — only a hard exit unblocks a standalone reader
@@ -174,9 +181,16 @@ class Replica:
             fails = 0
             if resp.get("evicted"):
                 self._die(4, "subscription evicted by the trainer")
-            self.latest_known = max(self.latest_known,
-                                    int(resp.get("latest", -1)))
+            self._advance_latest(int(resp.get("latest", -1)))
             self._refresh_lag()
+
+    def _advance_latest(self, version: int) -> None:
+        """Monotonic max-merge of the newest version this replica has
+        HEARD OF — written by the heartbeat thread (coordinator answer)
+        and the apply loop (applied bundle), so the read-max-write must
+        be atomic or a stale read regresses it."""
+        with self._state_lock:
+            self.latest_known = max(self.latest_known, version)
 
     def _refresh_lag(self) -> None:
         if self.latest_known >= 0:
@@ -239,7 +253,7 @@ class Replica:
         snap = self.mirrors.apply(bundle)
         self.store.install(snap)
         self.applies += 1
-        self.latest_known = max(self.latest_known, version)
+        self._advance_latest(version)
         self._t_applies.inc()
         self._t_apply.observe(time.perf_counter() - t0)
         self._t_mirror.set(float(self.mirrors.mirror_bytes()))

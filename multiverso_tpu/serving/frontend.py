@@ -60,6 +60,13 @@ _batch_window_flag = cached_float_flag("mv_serving_batch_window_s", 0.0)
 _IDLE_POLL_S = 0.2
 
 
+#: shared first-fill-wins gate — module-level like message._reply_lock
+#: and for the same reason: the guarded region is two attribute stores,
+#: so contention is nil, and the admission hot path skips a Lock
+#: allocation per ticket
+_fill_lock = threading.Lock()
+
+
 class LookupTicket:
     """Future for one admitted lookup. ``Wait`` is the only blocking
     point of the read path and it is deadline-bounded."""
@@ -75,13 +82,18 @@ class LookupTicket:
     def _fill(self, result: Any) -> None:
         # first fill wins: a per-group error path may sweep tickets the
         # same serve already filled — re-filling would swap a delivered
-        # result for an exception and over-notify the waiter. Same-
-        # thread idempotence suffices: each queue item is popped (and
-        # therefore filled) by exactly one server.
-        if self._done:
-            return
-        self._done = True
-        self._result = result
+        # result for an exception and over-notify the waiter. The
+        # check-and-set rides a lock: a queue item is popped by exactly
+        # one server, but stop()'s fail-queued sweep and a racing
+        # admission (lookup_async's lost-race-with-stop path) fill from
+        # OTHER threads, and an unlocked check-then-act there could
+        # double-notify the waiter (found by mvlint cross-domain-state,
+        # regression-tested in test_concurrency_fixes).
+        with _fill_lock:
+            if self._done:
+                return
+            self._done = True
+            self._result = result
         self._waiter.Notify()
 
     def Wait(self, deadline: Optional[float] = None) -> np.ndarray:

@@ -49,6 +49,7 @@ Residence is picked per table by ``-mv_serving_residence``:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict
@@ -72,10 +73,20 @@ class TableSnapshot:
     """One table's immutable published state. Subclasses implement the
     union read; the front-end slices per caller. ``dispatches`` counts
     fused union gathers actually issued — the micro-batch coalescing
-    tests assert ONE per tick however many callers rode it."""
+    tests assert ONE per tick however many callers rode it. The count
+    rides a lock: the dispatcher thread, a synchronous caller winning
+    the inline-combiner lock, the replica serve threads and the fan-out
+    encoder all read the SAME published snapshot concurrently, and a
+    bare ``+=`` loses increments exactly when the oracle matters
+    (found by mvlint cross-domain-state)."""
 
     def __init__(self):
         self.dispatches = 0
+        self._disp_lock = threading.Lock()
+
+    def _count_dispatch(self) -> None:
+        with self._disp_lock:
+            self.dispatches += 1
 
     def nbytes(self) -> int:
         raise NotImplementedError
@@ -132,7 +143,7 @@ class MatrixSnapshot(TableSnapshot):
                 f"row id out of range [0, {self.num_rows})")
 
     def lookup_union(self, union_ids: np.ndarray) -> np.ndarray:
-        self.dispatches += 1
+        self._count_dispatch()
         if self._rows is not None:
             return self._rows[union_ids]
         data, aux, gather, pad_ids = self._dev
@@ -141,7 +152,7 @@ class MatrixSnapshot(TableSnapshot):
 
     def full(self) -> np.ndarray:
         if self._rows is not None:
-            self.dispatches += 1
+            self._count_dispatch()
             return self._rows.copy()
         # device path: lookup_union counts the one gather it issues.
         # np.array(copy=True): np.asarray of a jax array can be a
@@ -170,11 +181,11 @@ class VectorSnapshot(TableSnapshot):
                 f"index out of range [0, {self._values.size})")
 
     def lookup_union(self, union_ids: np.ndarray) -> np.ndarray:
-        self.dispatches += 1
+        self._count_dispatch()
         return self._values[union_ids]
 
     def full(self) -> np.ndarray:
-        self.dispatches += 1
+        self._count_dispatch()
         return self._values.copy()
 
 
@@ -196,7 +207,7 @@ class KVSnapshot(TableSnapshot):
             raise ValueError("empty key set")
 
     def lookup_union(self, union_keys: np.ndarray) -> np.ndarray:
-        self.dispatches += 1
+        self._count_dispatch()
         if not len(self._keys):
             return np.zeros(len(union_keys), self._values.dtype)
         pos = np.searchsorted(self._keys, union_keys)
@@ -208,7 +219,7 @@ class KVSnapshot(TableSnapshot):
     def full(self) -> np.ndarray:
         # "everything" for a KV table is its value vector in sorted-key
         # order; pair it with items() for the keys
-        self.dispatches += 1
+        self._count_dispatch()
         return self._values.copy()
 
     def items(self):

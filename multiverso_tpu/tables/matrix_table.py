@@ -544,9 +544,11 @@ class MatrixServerTable(ServerTable):
             st = dict(self._state)
             st["data"] = ctx.place(self._to_storage(self._nat_store.get_all()),
                                    self._sharding)
+            # mv-lint: ok(cross-domain-state): one plane per table — the worker-domain writer is the device-plane collective verb path (lockstep app-thread calls), and a device-plane table never takes engine window applies concurrently
             self._state = st
             # cleared only after the sync landed: a placement failure must
             # leave the dirty flag set so retries/later reads still sync
+            # mv-lint: ok(cross-domain-state): same one-plane-per-table argument as _state above
             self._nat_dirty = False
         return self._state
 
@@ -556,6 +558,7 @@ class MatrixServerTable(ServerTable):
         if self._nat_store is not None:
             # a device-path write made the jax state authoritative; the
             # mirror is stale — drop it (rebuilt on the next host verb)
+            # mv-lint: ok(cross-domain-state): same one-plane-per-table argument as the state getter above
             self._nat_store = None
             self._nat_dirty = False
 
@@ -743,6 +746,7 @@ class MatrixServerTable(ServerTable):
         bucket = max(8, 1 << (len(uniq) - 1).bit_length())
         uniq_p = np.full(bucket, -1, np.int32)
         uniq_p[: len(uniq)] = uniq
+        # mv-lint: ok(cross-domain-state): same one-plane-per-table argument as the state getter — engine window applies and device-plane collective verbs never drive one table concurrently
         self.state = self._merged_add_rows(
             self.state, jnp.asarray(uniq_p), jnp.asarray(deltas),
             jnp.asarray(inv.astype(np.int32)), AddOption().as_jnp())

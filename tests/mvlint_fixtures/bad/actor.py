@@ -1,0 +1,17 @@
+"""Fixture twin of the actor runtime: Start spawns the mailbox loop
+(the engine-shard domain's thread boundary)."""
+
+import threading
+
+
+class Actor:
+    def __init__(self, name):
+        self.name = name
+        self._thread = None
+
+    def Start(self):
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    def _main(self):
+        return self.name

@@ -1,0 +1,9 @@
+"""Fixture twin of the public API surface (worker/main domain)."""
+
+from .telemetry.export import StatsReporter
+
+
+def MV_Barrier():
+    rep = StatsReporter(1.0)
+    rep.emit()      # the final flush runs on the caller thread
+    return 0

@@ -1,16 +1,14 @@
 """Fixture: an unbounded join hidden behind an attribute chain."""
 
-import threading
-
 
 class Inner:
-    def __init__(self):
-        self.t = threading.Thread(target=lambda: None, daemon=True)
+    def __init__(self, thread):
+        self.t = thread
 
 
 class Drain:
-    def __init__(self):
-        self.inner = Inner()
+    def __init__(self, thread):
+        self.inner = Inner(thread)
 
     def stop(self):
         self.inner.t.join(

@@ -1,0 +1,21 @@
+"""SEEDED: two locks taken in opposite orders — an acquisition-order
+cycle (thread A in forward, thread B in backward deadlock)."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._l1 = threading.Lock()
+        self._l2 = threading.Lock()
+        self.hits = 0
+
+    def forward(self):
+        with self._l1:
+            with self._l2:
+                self.hits += 1
+
+    def backward(self):
+        with self._l2:
+            with self._l1:
+                self.hits += 1

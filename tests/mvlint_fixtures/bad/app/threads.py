@@ -1,0 +1,13 @@
+"""SEEDED: a thread spawn the domain inventory does not claim."""
+
+import threading
+
+
+def rogue_worker():
+    return 0
+
+
+def start_rogue():
+    t = threading.Thread(target=rogue_worker, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,26 @@
+"""Fixture twin of the elastic coordinator: per-connection RPC
+threads (spawned in __init__) and the member heartbeat thread."""
+
+import threading
+
+
+class Coordinator:
+    def __init__(self, host, port):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        return self._dispatch({})
+
+    def _dispatch(self, req):
+        with self._lock:
+            return {"ok": True, "op": req.get("op")}
+
+
+class MemberClient:
+    def start_heartbeats(self):
+        def _beat():
+            return 0
+
+        self._hb_thread = threading.Thread(target=_beat, daemon=True)
+        self._hb_thread.start()

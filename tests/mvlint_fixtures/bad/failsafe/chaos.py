@@ -1,0 +1,13 @@
+"""Fixture twin of the chaos redelivery timer (helper domain)."""
+
+import threading
+
+
+def schedule_redelivery(deliver, msg, wait):
+    def _redeliver():
+        deliver(msg)
+
+    t = threading.Timer(wait, _redeliver)
+    t.daemon = True
+    t.start()
+    return t

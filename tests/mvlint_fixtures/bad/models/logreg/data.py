@@ -1,0 +1,11 @@
+"""Fixture twin of the logreg async window reader (worker domain)."""
+
+import threading
+
+
+class WindowReader:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        return 0

@@ -1,0 +1,14 @@
+"""Fixture twin of the logreg training loop + its harvest spawn."""
+
+import threading
+
+
+def _log_done():
+    return 0
+
+
+class LogReg:
+    def _train(self):
+        t = threading.Thread(target=_log_done, daemon=True)
+        t.start()
+        return 0

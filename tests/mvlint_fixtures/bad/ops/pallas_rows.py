@@ -1,0 +1,5 @@
+"""Fixture mirror: pallas kernel module (device-zone liveness)."""
+
+
+def pallas_gather(data=None, ids=None):
+    return data

@@ -3,3 +3,7 @@
 
 def use_pallas(data=None, ids=None):
     return False
+
+
+def gather_rows(data=None, ids=None):
+    return data

@@ -2,10 +2,16 @@
 reaches a collective primitive (an allgather from a sampling-style
 thread is exactly the interleaving the never-collective law bans)."""
 
+import threading
+
 from ..parallel import multihost
 
 
 class ReplicaPublisher:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
     def _run(self):
         while True:
             self._tick()

@@ -2,6 +2,8 @@
 a collective (a reader process issuing a host barrier would need an
 SPMD stream it does not have)."""
 
+import threading
+
 from ..parallel import multihost
 
 
@@ -9,3 +11,21 @@ class _LookupHandler:
     def handle(self):
         multihost.host_barrier("replica-serve")
         return {"ok": True}
+
+
+class Replica:
+    def __init__(self):
+        self._server = None
+
+    def start(self):
+        threading.Thread(target=self._hb_loop, daemon=True).start()
+
+    def _start_serve_server(self):
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def _hb_loop(self):
+        return 0
+
+    def recv_loop(self):
+        return 0

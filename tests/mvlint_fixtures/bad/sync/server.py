@@ -1,4 +1,7 @@
-"""Fixture twin of the engine hot path: a registry walk per window."""
+"""Fixture twin of the engine: hot path + the engine-shard/apply-pool
+thread spawns."""
+
+import threading
 
 
 def GetFlag(name):
@@ -9,3 +12,24 @@ class Server:
     def _mh_pack_window(self, verbs):
         budget = int(GetFlag("window_bytes"))  # seeded violation
         return verbs[:budget]
+
+    def _add_entry(self, msg):
+        return msg
+
+
+class _ExchangeStage:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    def _main(self):
+        return 0
+
+
+class _ApplyPool:
+    def __init__(self, workers):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        return 0

@@ -1,10 +1,15 @@
-"""Fixture twin of the stats reporter: the reporter thread is a root."""
+"""Fixture twin of the stats reporter — SEEDED: emit() runs on the
+reporter thread AND the worker-domain final flush, and writes
+shared state with no lock."""
+
+import threading
 
 
 class StatsReporter:
     def __init__(self, interval_s):
         self.interval_s = interval_s
         self._stopped = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self):
         while not self._stopped:
@@ -12,4 +17,5 @@ class StatsReporter:
             break
 
     def emit(self):
+        self.last_line = "telemetry"  # seeded: two domains, no lock
         return {"telemetry": True}

@@ -1,8 +1,29 @@
-"""Fixture twin of the ops plane: the HTTP handler is a restricted root."""
+"""Fixture twin of the ops plane — SEEDED: the handler parks on an
+unbounded wait (the per-line unbounded-ok justification satisfies
+the bounded-blocking law but NOT the handler-thread one)."""
+
+import threading
 
 from . import accounting
 
 
 class _OpsHandler:
     def do_GET(self):
+        self._drain()
         return accounting.memory_report()
+
+    def _drain(self):
+        evt = threading.Event()
+        # unbounded-ok: fixture justification (per-line law only)
+        evt.wait()
+
+
+class OpsServer:
+    def __init__(self, port):
+        import threading
+        self._thread = threading.Thread(target=_serve_forever,
+                                        daemon=True)
+
+
+def _serve_forever():
+    return 0

@@ -1,5 +1,7 @@
 """Fixture twin of the watchdog: tick/_run are restricted roots."""
 
+import threading
+
 
 def collect_sample():
     from ..parallel import multihost
@@ -10,10 +12,17 @@ def collect_sample():
 class Watchdog:
     def __init__(self, interval_s):
         self.interval_s = interval_s
+        self._thread = None
 
     def tick(self):
         sample = collect_sample()
+        from ..ops import rows
+        rows.gather_rows(sample)  # seeded: device work from the tick
         return [k for k in sample]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
 
     def _run(self):
         return self.tick()

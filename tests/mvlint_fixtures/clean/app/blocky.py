@@ -4,8 +4,8 @@ import threading
 
 
 class Drain:
-    def __init__(self):
-        self._t = threading.Thread(target=lambda: None, daemon=True)
+    def __init__(self, thread):
+        self._t = thread
         self._ev = threading.Event()
 
     def stop(self):

@@ -1,0 +1,20 @@
+"""Clean twin: both paths take the locks in ONE agreed order."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._l1 = threading.Lock()
+        self._l2 = threading.Lock()
+        self.hits = 0
+
+    def forward(self):
+        with self._l1:
+            with self._l2:
+                self.hits += 1
+
+    def backward(self):
+        with self._l1:
+            with self._l2:
+                self.hits += 2

@@ -1,0 +1,9 @@
+"""Clean twin: the same work, no unclassified thread."""
+
+
+def rogue_worker():
+    return 0
+
+
+def start_rogue():
+    return rogue_worker()

@@ -1,0 +1,12 @@
+"""Fixture twin of the bounded-call runner (helper domain)."""
+
+import threading
+
+
+class _Runner:
+    def __init__(self):
+        self.busy = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        return 0

@@ -1,0 +1,12 @@
+"""Fixture twin of the wordembedding corpus loader thread."""
+
+import threading
+
+
+def start_loader():
+    def run():
+        return 0
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t

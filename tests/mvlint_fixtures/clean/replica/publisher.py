@@ -1,8 +1,14 @@
 """Fixture twin of the replica publisher: the fan-out thread is a
 restricted never-collective root (it ships beside the engine stream)."""
 
+import threading
+
 
 class ReplicaPublisher:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
     def _run(self):
         while True:
             self._tick()

@@ -2,6 +2,8 @@
 restricted never-collective root (the reader process has no SPMD
 stream at all)."""
 
+import threading
+
 
 class _LookupHandler:
     def handle(self):
@@ -10,3 +12,21 @@ class _LookupHandler:
 
 def _serve_locally(req):
     return {"ok": True, "op": req.get("op")}
+
+
+class Replica:
+    def __init__(self):
+        self._server = None
+
+    def start(self):
+        threading.Thread(target=self._hb_loop, daemon=True).start()
+
+    def _start_serve_server(self):
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def _hb_loop(self):
+        return 0
+
+    def recv_loop(self):
+        return 0

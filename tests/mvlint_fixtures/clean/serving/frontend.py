@@ -1,0 +1,20 @@
+"""Fixture twin of the serving front-end: the dispatcher thread is
+spawned lazily under the thread lock."""
+
+import threading
+
+
+class ServingFrontend:
+    def __init__(self):
+        self._thread = None
+        self._thread_lock = threading.Lock()
+
+    def _ensure_thread(self):
+        with self._thread_lock:
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        return 0

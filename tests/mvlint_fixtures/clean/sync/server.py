@@ -1,4 +1,7 @@
-"""Fixture twin of the engine hot path: flag reads ride cached accessors."""
+"""Fixture twin of the engine: cached flag reads + the
+engine-shard/apply-pool thread spawns."""
+
+import threading
 
 
 def cached_int_flag(name, default):
@@ -14,3 +17,24 @@ class Server:
     def _mh_pack_window(self, verbs):
         budget = int(_budget_flag())
         return verbs[:budget]
+
+    def _add_entry(self, msg):
+        return msg
+
+
+class _ExchangeStage:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    def _main(self):
+        return 0
+
+
+class _ApplyPool:
+    def __init__(self, workers):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        return 0

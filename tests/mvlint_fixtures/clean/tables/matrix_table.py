@@ -1,0 +1,11 @@
+"""Fixture mirror: the matrix table's mirror-syncing state property
+(device-zone liveness)."""
+
+
+class MatrixServerTable:
+    def __init__(self):
+        self._state = {}
+
+    @property
+    def state(self):
+        return self._state

@@ -1,10 +1,16 @@
-"""Fixture twin of the stats reporter: the reporter thread is a root."""
+"""Fixture twin of the stats reporter: the shared emit state rides
+one lock, so the reporter thread and the worker-domain final flush
+cannot race it."""
+
+import threading
 
 
 class StatsReporter:
     def __init__(self, interval_s):
         self.interval_s = interval_s
         self._stopped = False
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self):
         while not self._stopped:
@@ -12,4 +18,6 @@ class StatsReporter:
             break
 
     def emit(self):
+        with self._lock:
+            self.last_line = "telemetry"
         return {"telemetry": True}

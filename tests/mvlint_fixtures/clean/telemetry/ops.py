@@ -1,8 +1,27 @@
-"""Fixture twin of the ops plane: the HTTP handler is a restricted root."""
+"""Fixture twin of the ops plane: the HTTP handler is a restricted
+root, and its one wait is bounded."""
+
+import threading
 
 from . import accounting
 
 
 class _OpsHandler:
     def do_GET(self):
+        self._drain()
         return accounting.memory_report()
+
+    def _drain(self):
+        evt = threading.Event()
+        evt.wait(0.5)
+
+
+class OpsServer:
+    def __init__(self, port):
+        import threading
+        self._thread = threading.Thread(target=_serve_forever,
+                                        daemon=True)
+
+
+def _serve_forever():
+    return 0

@@ -1,5 +1,7 @@
 """Fixture twin of the watchdog: tick/_run are restricted roots."""
 
+import threading
+
 
 def collect_sample():
     return {"mem.process_bytes": 0.0}
@@ -8,10 +10,15 @@ def collect_sample():
 class Watchdog:
     def __init__(self, interval_s):
         self.interval_s = interval_s
+        self._thread = None
 
     def tick(self):
         sample = collect_sample()
         return [k for k in sample]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
 
     def _run(self):
         return self.tick()

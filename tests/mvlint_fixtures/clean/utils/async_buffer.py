@@ -1,0 +1,11 @@
+"""Fixture twin of the async prefetch buffer: the fill thread runs
+caller code (claim-only domain entry)."""
+
+import threading
+
+
+class ASyncBuffer:
+    def _launch(self, fill):
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        return t

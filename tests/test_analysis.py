@@ -8,6 +8,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -285,10 +286,16 @@ class TestFixtureCatches:
 
     EXPECT = {
         "no-bare-print": ("app/printy.py", 5),
-        "bounded-blocking": ("app/blocky.py", 16),
+        "bounded-blocking": ("app/blocky.py", 14),
         "spmd-stream-guard": ("app/spmd.py", 9),
-        "hot-path-flag-cache": ("sync/server.py", 10),
-        "never-collective": ("telemetry/watchdog.py", 14),
+        "hot-path-flag-cache": ("sync/server.py", 13),
+        "never-collective": ("telemetry/watchdog.py", 17),
+        # round 18 — the concurrency-domain rules (DESIGN.md §18)
+        "thread-domains": ("app/threads.py", 11),
+        "cross-domain-state": ("telemetry/export.py", 20),
+        "device-work-domain": ("telemetry/watchdog.py", 27),
+        "lock-order": ("app/locky.py", 15),
+        "blocking-domain": ("telemetry/ops.py", 18),
     }
 
     @pytest.fixture(scope="class")
@@ -449,10 +456,12 @@ class TestWholePackageBaseline:
     def test_package_is_clean_under_every_checker(self):
         res = run_analysis()
         assert res.clean, "\n".join(f.render() for f in res.findings)
-        # the registry really ran all five laws (plus nothing unknown)
+        # the registry really ran all ten laws (plus nothing unknown)
         assert {c.name for c in res.checkers} == {
             "no-bare-print", "bounded-blocking", "hot-path-flag-cache",
-            "spmd-stream-guard", "never-collective"}
+            "spmd-stream-guard", "never-collective",
+            "thread-domains", "cross-domain-state", "device-work-domain",
+            "lock-order", "blocking-domain"}
 
     def test_never_collective_rederives_the_restricted_root_set(self):
         """The checker's root config must cover (at minimum) every
@@ -572,7 +581,9 @@ class TestCLIContract:
         out = capsys.readouterr().out
         for rule in ("no-bare-print", "bounded-blocking",
                      "hot-path-flag-cache", "spmd-stream-guard",
-                     "never-collective"):
+                     "never-collective", "thread-domains",
+                     "cross-domain-state", "device-work-domain",
+                     "lock-order", "blocking-domain"):
             assert rule in out
 
     def test_json_output_and_diag_artifact(self, tmp_path, capsys):
@@ -609,3 +620,617 @@ class TestCLIContract:
             env=dict(os.environ, JAX_PLATFORMS="cpu"))
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "0 finding(s)" in proc.stdout
+
+
+class TestCallGraphPrecision:
+    """The round-18 resolution upgrades: instance-attribute types,
+    factory return types, super() dispatch, and the thread/handler
+    callback cuts — each pinned by the false-edge class it removed."""
+
+    def _graph(self, tmp_path, files):
+        from multiverso_tpu.analysis import callgraph
+        pkg = core.PackageIndex(_write_pkg(tmp_path / "pkg", files))
+        return callgraph.CallGraph(pkg)
+
+    def test_instance_attr_types_resolve_chains(self, tmp_path):
+        """self.store = Store() in __init__ types self.store.probe()
+        precisely — no dynamic-dispatch fan-out to same-named
+        methods."""
+        g = self._graph(tmp_path, {"m.py": """\
+            class Store:
+                def probe(self):
+                    return 1
+
+            class Decoy:
+                def probe(self):
+                    return 2
+
+            class User:
+                def __init__(self):
+                    self.store = Store()
+
+                def read(self):
+                    return self.store.probe()
+            """})
+        edges = g.edges["m.py:User.read"]
+        assert "m.py:Store.probe" in edges
+        assert "m.py:Decoy.probe" not in edges
+
+    def test_conflicting_attr_assignment_poisons_the_type(self, tmp_path):
+        """An attribute assigned two different classes must not resolve
+        through either (the fallback fan-out is the honest answer)."""
+        g = self._graph(tmp_path, {"m.py": """\
+            class A:
+                def probe(self):
+                    return 1
+
+            class B:
+                def probe(self):
+                    return 2
+
+            class User:
+                def __init__(self, fast):
+                    self.impl = A()
+                    if fast:
+                        self.impl = B()
+
+                def read(self):
+                    return self.impl.probe()
+            """})
+        edges = g.edges["m.py:User.read"]
+        # conflict -> name fallback: BOTH probes are candidates
+        assert "m.py:A.probe" in edges and "m.py:B.probe" in edges
+
+    def test_factory_return_annotation_types_locals(self, tmp_path):
+        """mon = Registry.get_monitor(...) resolves mon.observe through
+        the annotated return class (Optional/forward-ref unwrapped)."""
+        g = self._graph(tmp_path, {"m.py": """\
+            from typing import Optional
+
+            class Monitor:
+                def observe(self):
+                    return 1
+
+            class Decoy:
+                def observe(self):
+                    return 2
+
+            class Registry:
+                @classmethod
+                def get_monitor(cls, name) -> "Optional[Monitor]":
+                    return Monitor()
+
+            def use():
+                mon = Registry.get_monitor("x")
+                return mon.observe()
+            """})
+        edges = g.edges["m.py:use"]
+        assert "m.py:Monitor.observe" in edges
+        assert "m.py:Decoy.observe" not in edges
+
+    def test_nested_def_returns_do_not_type_the_enclosing_def(
+            self, tmp_path):
+        """A nested callback's `return Worker()` is not the enclosing
+        function's return value — return inference walks shallow."""
+        g = self._graph(tmp_path, {"m.py": """\
+            class Worker:
+                def run(self):
+                    return 1
+
+            def register(cb):
+                return cb
+
+            def spawn():
+                def cb():
+                    return Worker()
+                register(cb)
+
+            def use():
+                x = spawn()
+                return x.run()
+            """})
+        assert "m.py:spawn" not in g.ret_types, g.ret_types
+        assert "m.py:Worker.run" not in g.edges.get("m.py:use", set())
+
+    def test_super_calls_resolve_through_bases_not_fallback(
+            self, tmp_path):
+        """super().ProcessX() dispatches to the base class — it used to
+        take the name fallback and wire the caller into EVERY
+        same-named method in the package."""
+        g = self._graph(tmp_path, {"m.py": """\
+            class Base:
+                def ProcessX(self):
+                    return 1
+
+            class Other:
+                def ProcessX(self):
+                    return 2
+
+            class Child(Base):
+                def entry(self):
+                    return super().ProcessX()
+            """})
+        edges = g.edges["m.py:Child.entry"]
+        assert "m.py:Base.ProcessX" in edges
+        assert "m.py:Other.ProcessX" not in edges
+
+    def test_thread_spawn_target_is_a_cut_edge(self, tmp_path):
+        """Thread(target=self._run) runs on the NEW thread: the spawner
+        must not inherit the target's closure (the thread inventory
+        classifies the target explicitly)."""
+        g = self._graph(tmp_path, {"m.py": """\
+            import threading
+
+            class Daemon:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    return 0
+            """})
+        assert "m.py:Daemon._run" not in g.edges.get("m.py:Daemon.start",
+                                                     set())
+
+    def test_wrapped_spawn_targets_are_cut_too(self, tmp_path):
+        """target=lambda: ... / target=partial(...) run on the new
+        thread just like a bare ref — the cut covers the callback's
+        whole subtree, not only exact Name/Attribute nodes."""
+        g = self._graph(tmp_path, {"m.py": """\
+            import functools
+            import threading
+
+            class Daemon:
+                def start_wrapped(self):
+                    threading.Thread(target=lambda: self._run()).start()
+
+                def start_partial(self):
+                    threading.Thread(
+                        target=functools.partial(self._run)).start()
+
+                def _run(self):
+                    return 0
+            """})
+        assert "m.py:Daemon._run" not in g.edges.get(
+            "m.py:Daemon.start_wrapped", set())
+        assert "m.py:Daemon._run" not in g.edges.get(
+            "m.py:Daemon.start_partial", set())
+
+    def test_positional_thread_target_is_cut_too(self, tmp_path):
+        """Thread(group, target, ...) — the stdlib positional spelling
+        must get the same boundary cut as target=."""
+        g = self._graph(tmp_path, {"m.py": """\
+            import threading
+
+            class Daemon:
+                def start(self):
+                    threading.Thread(None, self._run).start()
+
+                def _run(self):
+                    return 0
+            """})
+        assert "m.py:Daemon._run" not in g.edges.get("m.py:Daemon.start",
+                                                     set())
+
+    def test_register_handler_callback_is_a_cut_edge(self, tmp_path):
+        """RegisterHandler callbacks run on the actor loop thread, not
+        the registrar's — same boundary as a thread spawn."""
+        g = self._graph(tmp_path, {"m.py": """\
+            class Actor:
+                def RegisterHandler(self, mt, fn):
+                    self._h = fn
+
+            class Engine(Actor):
+                def __init__(self):
+                    self.RegisterHandler(1, self._get_entry)
+
+                def _get_entry(self, msg):
+                    return msg
+            """})
+        assert "m.py:Engine._get_entry" not in g.edges.get(
+            "m.py:Engine.__init__", set())
+
+
+class TestThreadInventory:
+    """The domain inventory and its config-rot law (DESIGN.md §18)."""
+
+    def test_real_package_inventory_is_live_and_fully_claimed(self):
+        """Every INVENTORY root matches a def, every configured spawn
+        site still spawns, and every detected spawn is claimed — the
+        baseline test pins the zero-findings form of this; this one
+        pins the mechanism with its internals exposed."""
+        from multiverso_tpu.analysis import threads
+        inv = threads.inventory_for(core.load_package())
+        assert inv.rot == [], inv.rot
+        assert inv.unclaimed == [], inv.unclaimed
+        # spawn detection saw the package's real thread spawns
+        assert len(inv.spawns) >= 15, inv.spawns
+
+    def test_domain_closures_cover_the_known_thread_bodies(self):
+        from multiverso_tpu.analysis import threads
+        inv = threads.inventory_for(core.load_package())
+        expect = {
+            "fanout": "replica/publisher.py:ReplicaPublisher._tick",
+            "watchdog": "telemetry/watchdog.py:Watchdog.tick",
+            "serving-dispatch":
+                "serving/frontend.py:ServingFrontend._serve_batch",
+            "replica-hb": "replica/replica.py:Replica._advance_latest",
+            "engine-shard": "sync/server.py:Server._local_window",
+            "ops-http": "telemetry/accounting.py:memory_report",
+        }
+        for domain, node in expect.items():
+            assert node in inv.closures[domain], (domain, node)
+
+    def test_ticket_fill_is_multi_domain(self):
+        """The write surface behind the round-18 LookupTicket fix: the
+        dispatcher, the replica serve threads and the worker-side
+        inline combiner all reach _fill — exactly why it now locks."""
+        from multiverso_tpu.analysis import threads
+        inv = threads.inventory_for(core.load_package())
+        doms = inv.domains_of("serving/frontend.py:LookupTicket._fill")
+        assert {"serving-dispatch", "worker"} <= doms, doms
+
+    def test_scratch_tree_reports_inventory_rot(self, tmp_path):
+        """On a tree without the inventoried modules, every entry is
+        config rot — vanished code can never silently retire its
+        classification (anchored at the config source placeholder)."""
+        root = _write_pkg(tmp_path / "p", {"m.py": "X = 1\n"})
+        res = run_analysis(root=root, rules=["thread-domains"])
+        assert res.findings
+        assert all("config rot" in f.message for f in res.findings), \
+            [f.render() for f in res.findings]
+
+    def test_unclassified_spawn_is_a_finding(self, tmp_path):
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            import threading
+
+            def go():
+                threading.Thread(target=lambda: None).start()
+            """})
+        res = run_analysis(root=root, rules=["thread-domains"])
+        hits = [f for f in res.findings
+                if "unclassified thread spawn" in f.message]
+        assert len(hits) == 1 and hits[0].path == "m.py", \
+            [f.render() for f in res.findings]
+
+    def test_aliased_threading_import_is_still_a_spawn(self, tmp_path):
+        """`from threading import Thread as Worker` must not make the
+        spawn invisible — the import record keeps the origin symbol."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            from threading import Thread as Worker
+
+            def go():
+                Worker(target=lambda: None).start()
+            """})
+        res = run_analysis(root=root, rules=["thread-domains"])
+        hits = [f for f in res.findings
+                if "unclassified thread spawn" in f.message]
+        assert len(hits) == 1, [f.render() for f in res.findings]
+
+    def test_colocated_surplus_spawn_is_unclassified(self, tmp_path):
+        """A def whose spawn site one entry claims cannot smuggle a
+        SECOND thread in unclassified — surplus spawns beyond the
+        claim count report (count-based claiming)."""
+        from multiverso_tpu.analysis import threads
+        pkg = core.PackageIndex(_write_pkg(tmp_path / "p", {
+            "replica/replica.py": """\
+                import threading
+
+                class Replica:
+                    def start(self):
+                        threading.Thread(target=self._hb_loop).start()
+                        threading.Thread(target=self._new_loop).start()
+
+                    def _hb_loop(self):
+                        return 0
+
+                    def _new_loop(self):
+                        return 0
+                """}))
+        inv = threads.ThreadInventory(pkg)
+        # one claiming entry (replica-hb), two spawns -> one surplus,
+        # and it is the LATER one in source order
+        surplus = [sp for sp in inv.unclaimed
+                   if sp.qual == "Replica.start"]
+        assert len(surplus) == 1, inv.unclaimed
+        assert "_new_loop" in surplus[0].target, surplus[0]
+
+    def test_in_package_timer_class_is_not_a_spawn(self, tmp_path):
+        """utils.timer.Timer (a stopwatch) shares threading.Timer's
+        name — only EXTERNAL Thread/Timer constructions count."""
+        root = _write_pkg(tmp_path / "p", {
+            "timerlib.py": """\
+                class Timer:
+                    def elapse(self):
+                        return 0.0
+                """,
+            "m.py": """\
+                from .timerlib import Timer
+
+                def work():
+                    t = Timer()
+                    return t.elapse()
+                """})
+        res = run_analysis(root=root, rules=["thread-domains"])
+        assert not [f for f in res.findings
+                    if "unclassified" in f.message], \
+            [f.render() for f in res.findings]
+
+
+class TestConcurrencyRuleUnits:
+    """Scratch-tree semantics of the four domain rules (the fixture
+    trees own the catches; these pin the edge semantics)."""
+
+    #: a minimal two-domain scratch shape: the reporter thread root and
+    #: the worker-domain API surface both reach emit()
+    SHAPE = {
+        "telemetry/export.py": """\
+            import threading
+
+
+            class StatsReporter:
+                def __init__(self, interval_s):
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run,
+                                                    daemon=True)
+
+                def _run(self):
+                    self.emit()
+
+                def emit(self):
+                    {write}
+                    return 0
+            """,
+        "api.py": """\
+            from .telemetry.export import StatsReporter
+
+
+            def MV_Barrier():
+                StatsReporter(1.0).emit()
+                return 0
+            """,
+    }
+
+    def _run_shape(self, tmp_path, write):
+        files = dict(self.SHAPE)
+        files["telemetry/export.py"] = textwrap.dedent(
+            files["telemetry/export.py"]).replace("{write}", write)
+        root = _write_pkg(tmp_path / "p", files)
+        return run_analysis(root=root, rules=["cross-domain-state"])
+
+    def test_unlocked_cross_domain_write_is_a_finding(self, tmp_path):
+        res = self._run_shape(tmp_path, "self.last = 1")
+        assert [f.rule for f in res.findings] == ["cross-domain-state"]
+        msg = res.findings[0].message
+        assert "reporter" in msg and "worker" in msg, msg
+
+    def test_common_lock_scope_passes(self, tmp_path):
+        res = self._run_shape(
+            tmp_path,
+            "with self._lock:\n                        self.last = 1")
+        assert res.clean, [f.render() for f in res.findings]
+
+    def test_init_writes_are_exempt(self, tmp_path):
+        """Construction happens-before thread start — __init__ writes
+        never count (every class would be multi-domain otherwise)."""
+        res = self._run_shape(tmp_path, "pass")
+        assert res.clean, [f.render() for f in res.findings]
+
+    def test_suppression_and_stale_law_cover_the_new_rules(
+            self, tmp_path):
+        files = dict(self.SHAPE)
+        files["telemetry/export.py"] = textwrap.dedent(
+            files["telemetry/export.py"]).replace(
+            "{write}",
+            "self.last = 1  "
+            "# mv-lint: ok(cross-domain-state): fixture reason")
+        root = _write_pkg(tmp_path / "p", files)
+        res = run_analysis(root=root, rules=["cross-domain-state"])
+        assert res.clean and len(res.suppressed) == 1, \
+            [f.render() for f in res.findings]
+
+    def test_lock_order_self_loop_on_plain_lock_only(self, tmp_path):
+        """Re-acquiring threading.Lock under itself is a finding; the
+        same shape on RLock is legal re-entrancy."""
+        for ctor, bad in (("Lock", True), ("RLock", False)):
+            root = _write_pkg(tmp_path / f"p_{ctor}", {"m.py": f"""\
+                import threading
+
+
+                class Box:
+                    def __init__(self):
+                        self._mu = threading.{ctor}()
+
+                    def outer(self):
+                        with self._mu:
+                            return self.inner()
+
+                    def inner(self):
+                        with self._mu:
+                            return 1
+                """})
+            res = run_analysis(root=root, rules=["lock-order"])
+            if bad:
+                assert len(res.findings) == 1 \
+                    and "re-acquired under itself" \
+                        in res.findings[0].message, \
+                    [f.render() for f in res.findings]
+            else:
+                assert res.clean, [f.render() for f in res.findings]
+
+    def test_local_lock_aliases_do_not_merge_into_one_node(
+            self, tmp_path):
+        """Two methods aliasing DIFFERENT member locks to one local
+        name must not merge into a single lock-order node (a spurious
+        cycle) — a bare Name keys as a module lock only when it really
+        is a module global."""
+        root = _write_pkg(tmp_path / "p", {"m.py": """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def left(self):
+                    lk = self._a
+                    with lk:
+                        with self._b:
+                            return 1
+
+                def right(self):
+                    lk = self._b
+                    with lk:
+                        with self._a:
+                            return 2
+            """})
+        res = run_analysis(root=root, rules=["lock-order"])
+        # a module-global-keyed `lk` would read as lk->_b AND lk->_a
+        # with call-composed back-edges manufacturing a cycle; the
+        # name-only key keeps these out of the order graph entirely
+        assert res.clean, [f.render() for f in res.findings]
+
+    def test_blocking_domain_counts_literal_none_bounds(self, tmp_path):
+        """wait(timeout=None) is the unbounded wait spelled out — the
+        reachability rule treats it exactly like wait()."""
+        root = _write_pkg(tmp_path / "p", {"telemetry/ops.py": """\
+            import threading
+
+
+            class _OpsHandler:
+                def do_GET(self):
+                    evt = threading.Event()
+                    # unbounded-ok: fixture (per-line law only)
+                    evt.wait(timeout=None)
+            """})
+        res = run_analysis(root=root, rules=["blocking-domain"])
+        assert [f.rule for f in res.findings] == ["blocking-domain"], \
+            [f.render() for f in res.findings]
+
+    def test_blocking_domain_recv_honors_module_settimeout(
+            self, tmp_path):
+        """.recv() in a module that arms a socket timeout is bounded;
+        without one it reports."""
+        body = """\
+            class _OpsHandler:
+                def do_GET(self, sock):
+                    {extra}
+                    return sock.recv(4096)
+            """
+        for extra, n in (("sock.settimeout(5.0)", 0), ("pass", 1)):
+            root = _write_pkg(tmp_path / f"p{n}", {
+                "telemetry/ops.py": textwrap.dedent(body).replace(
+                    "{extra}", extra)})
+            res = run_analysis(root=root, rules=["blocking-domain"])
+            assert len(res.findings) == n, \
+                (extra, [f.render() for f in res.findings])
+
+    def test_device_zone_module_rot_reports(self, tmp_path):
+        """A tree without the device-zone modules reports config rot
+        anchored at the config placeholder — the HOT_ZONES law applied
+        to the device-sink inventory."""
+        root = _write_pkg(tmp_path / "p", {"m.py": "X = 1\n"})
+        res = run_analysis(root=root, rules=["device-work-domain"])
+        assert res.findings
+        assert all("device-zone config rot" in f.message
+                   for f in res.findings), \
+            [f.render() for f in res.findings]
+
+
+class TestScannedCoveragePins:
+    """The rglob pins (PR 11/12 idiom): the new rules scanned every
+    package module — a restructure can't silently drop files from the
+    concurrency analyses."""
+
+    def test_new_rules_scan_the_whole_package(self):
+        import pathlib
+        pkg_root = pathlib.Path(core.default_root())
+        all_rels = {p.relative_to(pkg_root).as_posix()
+                    for p in pkg_root.rglob("*.py")
+                    if "__pycache__" not in p.parts}
+        res = run_analysis(rules=["thread-domains", "cross-domain-state",
+                                  "device-work-domain", "lock-order",
+                                  "blocking-domain"])
+        for checker in res.checkers:
+            allow = set(getattr(type(checker), "ALLOW", {}))
+            missing = all_rels - checker.scanned - allow
+            assert not missing, (checker.name, sorted(missing)[:10])
+        # the analysis plane's own new modules are part of the scan
+        for checker in res.checkers:
+            assert "analysis/threads.py" in checker.scanned
+            assert "analysis/concurrency.py" in checker.scanned
+        # ...and the cross-package mirrors the fixtures exercise exist
+        for rel in ("replica/publisher.py", "replica/replica.py",
+                    "telemetry/export.py", "telemetry/watchdog.py",
+                    "serving/frontend.py", "elastic/coordinator.py"):
+            assert rel in all_rels, rel
+
+
+class TestMvlintEntryPoint:
+    """The `mvlint` console script (pyproject [project.scripts]) must
+    emit byte-identical --json to `python -m multiverso_tpu.analysis`.
+    The script target is resolved from pyproject and exercised the way
+    the setuptools wrapper runs it (sys.exit(main())); when a real
+    mvlint executable is installed on PATH it is used directly."""
+
+    def _json_of(self, cmd):
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=180, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return json.loads(proc.stdout)
+
+    def test_declared_and_parity_with_python_m(self):
+        import shutil
+        with open(os.path.join(REPO, "pyproject.toml")) as f:
+            pyproject = f.read()
+        assert 'mvlint = "multiverso_tpu.analysis.cli:main"' \
+            in pyproject
+        mod, _, fn = "multiverso_tpu.analysis.cli:main".partition(":")
+        exe = shutil.which("mvlint")
+        if exe:
+            script_cmd = [exe, "--root", CLEAN, "--json"]
+        else:
+            script_cmd = [
+                sys.executable, "-c",
+                f"import sys; from {mod} import {fn} as m; "
+                f"sys.exit(m(sys.argv[1:]))",
+                "--root", CLEAN, "--json"]
+        via_script = self._json_of(script_cmd)
+        via_module = self._json_of(
+            [sys.executable, "-m", "multiverso_tpu.analysis",
+             "--root", CLEAN, "--json"])
+        assert via_script == via_module
+        assert via_script["clean"] is True
+
+
+class TestAnalysisRuntimeBudget:
+    """The whole-package run (all ten rules, caches cold) must stay
+    cheap enough to live in tier-1 forever. Generous wall ceiling +
+    the double-measure rule: a loaded box re-measures once, a genuine
+    cost regression fails both attempts."""
+
+    CEILING_S = 60.0
+
+    def test_full_cold_run_under_ceiling(self):
+        from multiverso_tpu.analysis import (callgraph, concurrency,
+                                             threads)
+        last = None
+        for _attempt in range(2):
+            core._INDEX_CACHE.clear()
+            callgraph._GRAPH_CACHE.clear()
+            threads._INV_CACHE.clear()
+            concurrency._FACTS_CACHE.clear()
+            t0 = time.perf_counter()
+            res = run_analysis()
+            took = time.perf_counter() - t0
+            assert res.clean, "\n".join(f.render() for f in res.findings)
+            if took <= self.CEILING_S:
+                return
+            last = took
+        raise AssertionError(
+            f"whole-package analysis took {last:.1f}s twice — over the "
+            f"{self.CEILING_S:.0f}s tier-1 ceiling; the lint lane must "
+            f"stay cheap (profile the new pass, don't raise the bar "
+            f"first)")

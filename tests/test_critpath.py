@@ -563,17 +563,29 @@ class TestCritpathDrill:
         """Acceptance (round 11): on a clean lockstep run the
         per-window phase sums account for the window wall within the
         documented bound (alignment error + 2x the apply-stage poll
-        granularity + scheduler jitter — DESIGN.md §13)."""
-        rep = self._run(tmp_path, "clean")
-        assert rep["degraded"] is None, rep
-        assert rep["n_windows"] >= 4, rep
-        # alignment error on one host is the collective exit skew —
-        # small, but assert only the documented order of magnitude
-        assert rep["align_err_s"] < 0.05, rep
-        gaps = [w["unaccounted_s"] for w in rep["windows"]
-                if w["unaccounted_s"] is not None]
-        assert gaps, rep
-        bound = rep["align_err_s"] + 2 * 0.002 + 0.010
-        med = sorted(gaps)[len(gaps) // 2]
-        assert med <= bound, (med, bound, rep["windows"])
-        assert rep["accounted_pct"] is not None
+        granularity + scheduler jitter — DESIGN.md §13). The jitter
+        term is a per-run scheduler property, so a failure must
+        REPRODUCE on a second fully independent drill (fresh
+        processes, fresh dump dir): a loaded box that stretched one
+        run's gaps passes the retry, a genuine accounting regression
+        fails both (the round-12 full-suite flake rule)."""
+        last = None
+        for attempt in range(2):
+            d = tmp_path / f"try{attempt}"
+            d.mkdir()
+            rep = self._run(d, "clean")
+            # structural properties hold on ANY run — never retried
+            assert rep["degraded"] is None, rep
+            assert rep["n_windows"] >= 4, rep
+            gaps = [w["unaccounted_s"] for w in rep["windows"]
+                    if w["unaccounted_s"] is not None]
+            assert gaps, rep
+            assert rep["accounted_pct"] is not None
+            # the TIMING-bound pair (exit-skew magnitude + median gap)
+            # is what a loaded box can stretch — both ride the retry
+            bound = rep["align_err_s"] + 2 * 0.002 + 0.010
+            med = sorted(gaps)[len(gaps) // 2]
+            if rep["align_err_s"] < 0.05 and med <= bound:
+                return
+            last = (rep["align_err_s"], med, bound, rep["windows"])
+        raise AssertionError(last)
