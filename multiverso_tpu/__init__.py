@@ -45,6 +45,10 @@ _API_NAMES = (
     "MV_ServerIdToRank",
     "MV_CreateTable",
     "MV_SetFlag",
+    "MV_MultiAdd",
+    "MV_MultiAddAsync",
+    "MV_MultiGet",
+    "MV_MultiGetAsync",
     "MV_Aggregate",
     "MV_NetBind",
     "MV_NetConnect",

@@ -9,10 +9,11 @@ process that already hosts the ``jax.distributed`` coordinator) owns an
 map — and every membership change is a staged transition applied at a
 fenced stream cut, never an in-place mutation.
 
-Protocol: length-framed pickled dicts over TCP with a CRC32 trailer per
-frame (the same corruption posture as the window wire, parallel/
-wire.py) — a torn or bit-flipped control frame raises instead of
-silently desyncing the membership state machine. Every operation is
+Protocol: length-framed pickled dicts over TCP, each sealed with the
+versioned trailer (the same corruption posture as the window wire —
+parallel/seal.py, hardware CRC32C with legacy-CRC32 verify) — a torn
+or bit-flipped control frame raises instead of silently desyncing the
+membership state machine. Every operation is
 idempotent or rendezvous-shaped, so the client may retry transients
 (the ``membership.*`` chaos sites rehearse exactly that):
 
@@ -76,11 +77,16 @@ import socketserver
 import struct
 import threading
 import time
-import zlib
 from typing import Dict, Optional
 
 from multiverso_tpu.failsafe.errors import (MembershipChanged,
-                                            TransientError, WireCorruption)
+                                            TransientError)
+# control frames ride the seal module's VERSIONED trailer (round 19) —
+# the one corruption posture and its one import home: hardware CRC32C
+# when the native engine is loadable, with the legacy CRC32 form still
+# verifying (new readers accept old frames; the direction is one-way —
+# upgrade readers before writers, see seal.py's module docstring)
+from multiverso_tpu.parallel import seal
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -92,9 +98,9 @@ _MAX_FRAME = 1 << 31
 
 
 def _send_frame(sock: socket.socket, obj) -> None:
-    body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    crc = zlib.crc32(body) & 0xFFFFFFFF
-    sock.sendall(_LEN.pack(len(body)) + body + _LEN.pack(crc))
+    blob = seal.seal_frame(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    sock.sendall(_LEN.pack(len(blob)) + blob)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -112,13 +118,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_frame(sock: socket.socket):
     n = _LEN.unpack(_recv_exact(sock, 4))[0]
     CHECK(0 < n < _MAX_FRAME, f"membership frame length insane: {n}")
-    body = _recv_exact(sock, n)
-    crc = _LEN.unpack(_recv_exact(sock, 4))[0]
-    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-        tmetrics.counter("wire.crc_failures").inc()
-        raise WireCorruption(
-            f"membership control frame failed CRC32 ({n} bytes)")
-    return pickle.loads(body)
+    blob = _recv_exact(sock, n)
+    # seal.open_frame verifies the trailer (raising the typed
+    # WireCorruption, counting wire.crc_failures) BEFORE the unpickle
+    return pickle.loads(seal.open_frame(blob))
 
 
 class _MemberRec:

@@ -29,6 +29,14 @@ class MsgType(enum.IntEnum):
 
     Request_Get = 1
     Request_Add = 2
+    # batched verb envelope (round 19, no reference equivalent — the
+    # value extends the to-server range): payload["members"] carries N
+    # pre-built Request_Get/Request_Add messages that enter the engine
+    # window in list order via ONE mailbox hop. The envelope itself is
+    # never a verb-stream position — the engine flattens it at window
+    # drain (sync/server.py _expand_multi), so the members are ordinary
+    # verbs to every downstream layer (dedup, chaos, windows, replies).
+    Request_MultiVerb = 5
     Request_Barrier = 33
     Request_Register = 34
     # table persistence rides the server mailbox so snapshots are ordered

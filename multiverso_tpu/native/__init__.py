@@ -108,6 +108,15 @@ def _configure_signatures(h: ctypes.CDLL) -> None:
     h.MV_HostStoreGetRows.argtypes = [ctypes.c_void_p, i32p, i64, f32p]
     h.MV_HostStorePoolStats.argtypes = [
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    # round 19 — the versioned seal's hardware CRC32C (crc32c.cc);
+    # hasattr-guarded like MV_KvIndexCapacity so a stale prebuilt .so
+    # degrades to the pure-python seal paths instead of failing load
+    if hasattr(h, "MV_Crc32c"):
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        h.MV_Crc32c.restype = ctypes.c_uint32
+        h.MV_Crc32c.argtypes = [u8p, i64, ctypes.c_uint32]
+        h.MV_Crc32cHw.restype = ctypes.c_int
+        h.MV_Crc32cHw.argtypes = []
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     h.MV_KvIndexNew.restype = ctypes.c_void_p
     h.MV_KvIndexNew.argtypes = [i64]
@@ -343,6 +352,60 @@ class KvIndex:
             raise ValueError("set_items slots must be a permutation of "
                              "0..n-1 (native used counter is next-slot)")
         self._h.MV_KvIndexSetItems(self._ptr, keys, slots, len(keys))
+
+
+def crc32c_fn():
+    """The native CRC32C entry point (``MV_Crc32c(data_u8, n, seed)``
+    -> u32, zlib.crc32-style chaining), or None when the native lib is
+    unavailable or predates the export. Returned as the raw callable so
+    the seal's hot loop (parallel/seal.py) pays the capability probe
+    ONCE, not per frame. This module stays jax-free — the replica
+    plane's reader processes verify fan-out seals through it."""
+    h = lib()
+    if h is None or not hasattr(h, "MV_Crc32c"):
+        return None
+    return h.MV_Crc32c
+
+
+_charp_fn = None
+
+
+def crc32c_charp_fn():
+    """MV_Crc32c bound with a ``c_char_p`` first argument — the FAST
+    binding for ``bytes`` inputs (the sealed-frame hot path): ctypes
+    passes a bytes object as char* for ~2.7us/call vs ~6.5us through
+    the ndpointer conversion (measured; the delta is pure argument
+    marshalling). Lives on a second CDLL handle of the same library so
+    the generic ndpointer binding (memoryviews, numpy views — the shm
+    wire's streaming chunks) keeps working. None when unavailable."""
+    global _charp_fn
+    if _charp_fn is None:
+        if lib() is None or not hasattr(lib(), "MV_Crc32c"):
+            return None
+        for path in (_PKG_LIB_PATH, _REPO_LIB_PATH):
+            if os.path.exists(path):
+                try:
+                    h2 = ctypes.CDLL(path)
+                    fn = h2.MV_Crc32c
+                    fn.restype = ctypes.c_uint32
+                    fn.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.c_uint32]
+                    # mv-lint: ok(cross-domain-state): idempotent lazy init — every racing thread binds the same symbol of the same library; a double-store of an equivalent callable is benign
+                    _charp_fn = fn
+                    break
+                except (OSError, AttributeError):
+                    continue
+    return _charp_fn
+
+
+def crc32c(data, value: int = 0) -> Optional[int]:
+    """CRC32C of ``data`` chained from ``value`` (the zlib.crc32 call
+    shape), or None when the native runtime is unavailable."""
+    fn = crc32c_fn()
+    if fn is None:
+        return None
+    arr = np.frombuffer(data, np.uint8)    # zero-copy for bytes/views
+    return int(fn(arr, arr.size, value & 0xFFFFFFFF))
 
 
 def pool_stats() -> Optional[dict]:

@@ -1,0 +1,300 @@
+"""Jax-free flat value codec: header + raw array segments.
+
+The CORE of the window wire's codec (:mod:`multiverso_tpu.parallel.wire`),
+factored out in round 19 so the replica plane's jax-free reader
+processes can speak the same zero-copy framing without importing the
+verb codec (``wire.py`` pulls ``updaters.base`` → jax for its
+Add/GetOption tags — a read-tier process must stay numpy-only). This is
+the round-17 seal factoring applied to the VALUE grammar: one encoder,
+one cursor, one set of tags, with ``wire.py`` layering its option tags
+on top through the extension hook.
+
+Why flat instead of pickle: the serve/lookup payloads are almost
+entirely contiguous ndarrays. Pickle walks the object graph, copies
+every buffer into its stream and walks it again on the far side; this
+codec writes a small header (dtype/shape tags) followed by the raw
+array bytes and decodes arrays ZERO-COPY with ``np.frombuffer`` against
+the received blob (decoded arrays are read-only views — consumers copy
+before mutating). The ROADMAP named the pickled-frames replica lookup
+protocol the read tier's "next 10x"; :func:`encode_frame` /
+:func:`decode_frame` are that flat lookup framing, sealed with the
+versioned trailer (parallel/seal.py — hardware CRC32C) like every
+other byte that crosses a process boundary.
+
+Value tags (same grammar as the window wire — wire.py documents the
+full table)::
+
+    n  None
+    a  ndarray   u8 dtype-str len, dtype str, u8 ndim, i64 dims, raw
+    v  DEFERRED ndarray — same header as 'a', NO raw bytes
+    d  nested dict: u8 count + entries
+    l  list: u32 count + values (tuples pickle — identity must survive)
+    t  bool (u8)    i  int (i64)    f  float (f64)
+    s  str / b  bytes: i64 length + raw
+    p  pickle fallback (exotic tail; extensions run BEFORE this)
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.parallel import seal
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+#: leading byte of a flat FRAME (the serve/lookup protocol unit) —
+#: distinct from wire.py's window/barrier kinds so a misrouted blob
+#: fails loudly at the first byte
+KIND_FLAT = 0x46        # 'F'
+
+
+class Extension:
+    """Hook for domain tags layered over the core grammar (wire.py's
+    Add/GetOption records). ``encode`` appends parts and returns True
+    when it owns ``v``; ``decode`` returns ``(True, value)`` when it
+    owns ``tag``. The core consults extensions BEFORE its pickle
+    fallback, so extension tags always win over 'p'."""
+
+    def encode(self, parts: list, v) -> bool:
+        return False
+
+    def decode(self, tag: bytes, cur: "_Cursor"):
+        return False, None
+
+
+class DeferredArray:
+    """Placeholder for an ndarray whose BYTES did not ride the host
+    wire: the encoder wrote only its dtype/shape header, and the owning
+    rank keeps the real array in ``local`` (None on every other rank
+    after decode). The windowed engine substitutes these for large Add
+    values when the device transport is selected — every rank still
+    sees the full shape metadata (needed for lockstep bucket math), and
+    the values move through the table's device-parts collectives
+    instead of the host staging wire."""
+
+    __slots__ = ("dtype", "shape", "local")
+
+    def __init__(self, dtype, shape, local=None):
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(int(s) for s in shape)
+        self.local = local
+
+    @classmethod
+    def of(cls, arr: np.ndarray) -> "DeferredArray":
+        arr = np.asarray(arr)
+        return cls(arr.dtype, arr.shape, local=arr)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        tag = "local" if self.local is not None else "remote"
+        return f"DeferredArray({self.dtype.str}, {self.shape}, {tag})"
+
+
+def dtype_wire_safe(dt) -> bool:
+    """True when ``dt`` survives the flat wire: its ``.str`` tag decodes
+    back to the SAME dtype. Extension dtypes (e.g. ml_dtypes.bfloat16,
+    which jax registers) stringify as opaque void tags like ``<V2`` —
+    encoding those flat would decode as void (silent corruption), and
+    ``memoryview`` refuses their buffers anyway, so their arrays ride
+    the pickle fallback instead (correct, just slower) and the engine
+    never defers them to the device wire."""
+    dt = np.dtype(dt)
+    try:
+        return not dt.hasobject and np.dtype(dt.str) == dt
+    except TypeError:
+        return False
+
+
+def _norm_array(v: np.ndarray) -> np.ndarray:
+    """Contiguous, little-endian view/copy of ``v`` for the wire."""
+    v = np.ascontiguousarray(v)
+    if v.dtype.byteorder == ">":
+        v = v.astype(v.dtype.newbyteorder("<"))
+    return v
+
+
+def _encode_array_header(parts: list, tag: bytes, dtype: np.dtype,
+                         shape: Tuple[int, ...]) -> None:
+    ds = dtype.str.encode("ascii")
+    parts.append(tag)
+    parts.append(_U8.pack(len(ds)))
+    parts.append(ds)
+    parts.append(_U8.pack(len(shape)))
+    for dim in shape:
+        parts.append(_I64.pack(dim))
+
+
+def encode_value(parts: list, v, ext: Optional[Extension] = None) -> None:
+    if v is None:
+        parts.append(b"n")
+    elif isinstance(v, np.ndarray) and dtype_wire_safe(v.dtype):
+        v = _norm_array(v)
+        _encode_array_header(parts, b"a", v.dtype, v.shape)
+        if v.size == 0:
+            pass                       # no payload bytes
+        elif v.ndim == 0:
+            parts.append(v.tobytes())  # memoryview can't cast 0-d
+        else:
+            parts.append(memoryview(v).cast("B"))
+    elif isinstance(v, DeferredArray):
+        _encode_array_header(parts, b"v", v.dtype, v.shape)
+    elif ext is not None and ext.encode(parts, v):
+        pass
+    elif isinstance(v, dict):
+        if len(v) > 255:
+            raise ValueError("wire dict too wide")
+        parts.append(b"d")
+        parts.append(_U8.pack(len(v)))
+        for key in sorted(v):
+            kb = str(key).encode("utf-8")
+            parts.append(_U8.pack(len(kb)))
+            parts.append(kb)
+            encode_value(parts, v[key], ext)
+    elif isinstance(v, bool):          # before int: bool is an int subtype
+        parts.append(b"t")
+        parts.append(_U8.pack(1 if v else 0))
+    elif isinstance(v, int) and -(2 ** 63) <= v < 2 ** 63:
+        parts.append(b"i")
+        parts.append(_I64.pack(v))
+    elif isinstance(v, float):
+        parts.append(b"f")
+        parts.append(_F64.pack(v))
+    elif isinstance(v, str):
+        sb = v.encode("utf-8")
+        parts.append(b"s")
+        parts.append(_I64.pack(len(sb)))
+        parts.append(sb)
+    elif isinstance(v, bytes):
+        parts.append(b"b")
+        parts.append(_I64.pack(len(v)))
+        parts.append(v)
+    elif type(v) is list:
+        # lists only — a tuple must come back a tuple (pickle keeps
+        # container identity; the flat tag would flatten it to a list)
+        parts.append(b"l")
+        parts.append(_U32.pack(len(v)))
+        for item in v:
+            encode_value(parts, item, ext)
+    else:
+        # option subclasses, huge ints, user table payloads: correctness
+        # over speed for the exotic tail
+        pb = pickle.dumps(v)
+        parts.append(b"p")
+        parts.append(_I64.pack(len(pb)))
+        parts.append(pb)
+
+
+class _Cursor:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def unpack(self, st: struct.Struct):
+        vals = st.unpack_from(self.buf, self.pos)
+        # mv-lint: ok(cross-domain-state): a _Cursor is constructed, walked and dropped inside ONE decode call — instance-local state; the class-level write aggregation is instance-blind here
+        self.pos += st.size
+        return vals
+
+    def take(self, n: int):
+        out = self.buf[self.pos: self.pos + n]
+        if len(out) != n:
+            raise ValueError("wire blob truncated")
+        self.pos += n
+        return out
+
+
+def decode_value(cur: _Cursor, ext: Optional[Extension] = None):
+    tag = cur.take(1)
+    if tag == b"n":
+        return None
+    if tag in (b"a", b"v"):
+        (dlen,) = cur.unpack(_U8)
+        dtype = np.dtype(bytes(cur.take(dlen)).decode("ascii"))
+        (ndim,) = cur.unpack(_U8)
+        shape = tuple(cur.unpack(_I64)[0] for _ in range(ndim))
+        if tag == b"v":
+            return DeferredArray(dtype, shape)
+        count = 1
+        for dim in shape:
+            count *= dim
+        arr = np.frombuffer(cur.buf, dtype, count=count, offset=cur.pos)
+        cur.pos += count * dtype.itemsize
+        return arr.reshape(shape)
+    if tag == b"d":
+        (n,) = cur.unpack(_U8)
+        out = {}
+        for _ in range(n):
+            (klen,) = cur.unpack(_U8)
+            key = bytes(cur.take(klen)).decode("utf-8")
+            out[key] = decode_value(cur, ext)
+        return out
+    if tag == b"t":
+        return bool(cur.unpack(_U8)[0])
+    if tag == b"i":
+        return cur.unpack(_I64)[0]
+    if tag == b"f":
+        return cur.unpack(_F64)[0]
+    if tag == b"s":
+        (n,) = cur.unpack(_I64)
+        return bytes(cur.take(n)).decode("utf-8")
+    if tag == b"b":
+        (n,) = cur.unpack(_I64)
+        return bytes(cur.take(n))
+    if tag == b"l":
+        (n,) = cur.unpack(_U32)
+        return [decode_value(cur, ext) for _ in range(n)]
+    if tag == b"p":
+        (n,) = cur.unpack(_I64)
+        return pickle.loads(bytes(cur.take(n)))
+    if ext is not None:
+        ok, val = ext.decode(tag, cur)
+        if ok:
+            return val
+    raise ValueError(f"unknown wire tag {tag!r}")
+
+
+# -- flat FRAMES (the serve/lookup protocol unit) ---------------------------
+
+def encode_frame(obj) -> bytes:
+    """One flat protocol frame: kind byte + the value grammar + the
+    versioned seal trailer. Replaces a pickled dict one-for-one — any
+    value the grammar speaks rides flat (arrays as raw segments), the
+    exotic tail still pickles per value."""
+    parts: list = [_U8.pack(KIND_FLAT)]
+    encode_value(parts, obj)
+    return seal.seal_frame(b"".join(parts))
+
+
+def decode_frame(blob: bytes):
+    """Verify the seal, check the kind byte, decode the value. Array
+    entries are zero-copy READ-ONLY views into ``blob`` (callers copy
+    before mutating). Raises ``WireCorruption`` on a torn/flipped frame
+    BEFORE any parsing. The cursor walks the original blob (check_crc,
+    not open_frame — slicing the trailer off would copy the whole
+    payload and forfeit the zero-copy decode); the value grammar is
+    self-delimiting, so the unread trailer bytes are never parsed."""
+    seal.check_crc(blob)
+    cur = _Cursor(blob)
+    (kind,) = cur.unpack(_U8)
+    if kind != KIND_FLAT:
+        raise ValueError(f"not a flat frame (leading byte {kind:#x})")
+    return decode_value(cur)

@@ -57,7 +57,6 @@ before — the group transport takes precedence over this wire.
 from __future__ import annotations
 
 import time
-import zlib
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional
 
@@ -65,6 +64,11 @@ import numpy as np
 
 from multiverso_tpu.failsafe import deadline as fdeadline
 from multiverso_tpu.failsafe.errors import WireCorruption
+# checksums ride the seal module's fast_crc (round 19): hardware CRC32C
+# when the native engine is loadable, zlib.crc32 otherwise — legal for
+# this wire because both ends of an shm ring are the same build on the
+# same host, so they always pick the same engine
+from multiverso_tpu.parallel.seal import fast_crc
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -114,11 +118,11 @@ def _peer_loss_probe(what: str):
 
 def _header_crc(seq: int, rnd: int, total: int, off: int, ln: int,
                 crc: int) -> int:
-    """CRC32 over the frame header's logical fields INCLUDING the seq
+    """CRC over the frame header's logical fields INCLUDING the seq
     value the chunk publishes under — always verified (a torn header
     mis-sizes the copy), and cheap: ~50 bytes per chunk."""
-    return zlib.crc32(b"%d|%d|%d|%d|%d|%d"
-                      % (seq, rnd, total, off, ln, crc)) & 0xFFFFFFFF
+    return fast_crc(b"%d|%d|%d|%d|%d|%d"
+                    % (seq, rnd, total, off, ln, crc)) & 0xFFFFFFFF
 
 
 def segment_name(token: str, channel: int, rank: int) -> str:
@@ -198,14 +202,19 @@ class ShmWire:
                  payload_crc: bool = True):
         CHECK(nprocs >= 2, "ShmWire needs a multi-process world")
         CHECK(channels >= 1, "ShmWire needs at least one channel")
-        #: whole-blob CRC32 per frame. The engine install turns this
+        #: whole-blob CRC per frame. The engine install turns this
         #: OFF: every engine window/head-marker blob already carries
-        #: the failsafe wire's CRC32 trailer (parallel/wire.py,
-        #: verified BEFORE parsing), and a second full-blob pass
-        #: roughly halves the wire's bandwidth (crc32 runs ~1 GB/s —
-        #: slower than the memcpy it would guard). The frame HEADER is
-        #: always CRC'd (cheap), and truncation stays structurally
-        #: detected via the total/chunk accounting either way.
+        #: the failsafe wire's seal trailer (parallel/seal.py,
+        #: verified BEFORE parsing), and a second full-blob pass costs
+        #: real bandwidth — zlib.crc32 MEASURED at ~0.8 GB/s on this
+        #: host class (PR 9 bench; slower than the memcpy it would
+        #: guard). Round 19: the pass now rides seal.fast_crc
+        #: (hardware CRC32C, ~8x zlib here), so payload_crc=True is
+        #: merely cheap rather than bandwidth-halving — the engine
+        #: still skips it because the blobs arrive pre-sealed. The
+        #: frame HEADER is always CRC'd (cheap), and truncation stays
+        #: structurally detected via the total/chunk accounting
+        #: either way.
         self.payload_crc = bool(payload_crc)
         self.token = token
         self.rank = rank
@@ -309,7 +318,7 @@ class ShmWire:
             self.frame_hw_bytes = len(blob)
             self._t_hw.set(float(len(blob)))
             self._t_occ.set(min(100.0, 100.0 * len(blob) / self.cap))
-        crc = (zlib.crc32(blob) & 0xFFFFFFFF) if self.payload_crc else 0
+        crc = (fast_crc(blob) & 0xFFFFFFFF) if self.payload_crc else 0
         plan = self._chunks(blob)
         blob_view = memoryview(blob)
         peers = [r for r in range(self.nprocs) if r != self.rank]
@@ -394,7 +403,7 @@ class ShmWire:
                     # and immune to any post-ack overwrite
                     st[0][off:off + ln] = seg.data[:ln].data
                     if self.payload_crc:
-                        st[5] = zlib.crc32(
+                        st[5] = fast_crc(
                             memoryview(st[0])[off:off + ln], st[5])
                 st[2] += 1
                 self._rseq[(channel, r)] = want

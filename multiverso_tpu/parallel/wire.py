@@ -19,6 +19,14 @@ the transport decision (host staging allgather vs device collectives —
 the reference's payload-size-adaptive wire pick,
 allreduce_engine.cpp:31-55) needs no re-serialization.
 
+Round 19 — the VALUE grammar (tags, cursor, array headers,
+DeferredArray) lives jax-free in :mod:`multiverso_tpu.parallel.flat`
+(this module pulls jax via ``updaters.base`` for its option tags; the
+replica serve protocol speaks the same grammar without that import).
+This module layers the engine-specific pieces on top: the window/
+barrier frame kinds, the exchange SEQ stamp, and the Add/GetOption
+record tags via the flat codec's extension hook.
+
 Wire format (all explicitly little-endian; dtype tags carry their own
 byte order, e.g. ``<f4``, so a big-endian array is normalized at encode
 and decodes correctly anywhere):
@@ -35,11 +43,12 @@ and decodes correctly anywhere):
 * u32 verb count, then per verb: u8 kind char, u32 table id, u8 entry
   count, then per entry: u8 key length + key utf8, u8 value tag + the
   tag's body.
-* trailing u32 — CRC32 over everything before it (failsafe subsystem):
-  decode verifies it BEFORE parsing, so a flipped bit or truncated
-  frame raises ``WireCorruption`` instead of decoding garbage.
+* trailing seal (parallel/seal.py, round 19: versioned — hardware
+  CRC32C tagged, legacy CRC32 still verifies): decode verifies it
+  BEFORE parsing, so a flipped bit or truncated frame raises
+  ``WireCorruption`` instead of decoding garbage.
 
-Value tags::
+Value tags (core grammar in flat.py, options added here)::
 
     n  None
     a  ndarray   u8 dtype-str len, dtype str, u8 ndim, i64 dims, raw
@@ -48,6 +57,7 @@ Value tags::
     o  AddOption  (i64 worker_id, f64 momentum/learning_rate/rho/lambda_)
     g  GetOption  (i64 worker_id)
     d  nested dict (compressed payloads): u8 count + entries
+    l  list: u32 count + values
     t  bool (u8)    i  int (i64)    f  float (f64)
     s  str / b  bytes: i64 length + raw
     p  pickle fallback (anything else — exotic options, user payloads,
@@ -57,13 +67,17 @@ Value tags::
 
 from __future__ import annotations
 
-import pickle
 import struct
 from typing import List, Tuple
 
 import numpy as np
 
-from multiverso_tpu.failsafe.errors import WireCorruption
+from multiverso_tpu.failsafe.errors import WireCorruption  # noqa: F401
+# the jax-free codec core (round 19): tags, cursor, array framing —
+# shared with the replica serve protocol's flat frames
+from multiverso_tpu.parallel.flat import (  # noqa: F401
+    DeferredArray, Extension, _Cursor, _norm_array, decode_value,
+    dtype_wire_safe, encode_value)
 # sealing lives in parallel/seal.py (jax-free — the replica plane's
 # reader processes verify fan-out blobs without importing this codec's
 # updater-option tags); re-exported here so every call site keeps one
@@ -80,47 +94,47 @@ KIND_HEAD_BARRIER = 0x42  # 'B'
 _U8 = struct.Struct("<B")
 _U32 = struct.Struct("<I")
 _I64 = struct.Struct("<q")
-_F64 = struct.Struct("<d")
 _VERB = struct.Struct("<BIB")      # kind char, table id, entry count
 _ADD_OPT = struct.Struct("<qdddd")
 
 
-class DeferredArray:
-    """Placeholder for an ndarray whose BYTES did not ride the host
-    wire: the encoder wrote only its dtype/shape header, and the owning
-    rank keeps the real array in ``local`` (None on every other rank
-    after decode). The windowed engine substitutes these for large Add
-    values when the device transport is selected — every rank still
-    sees the full shape metadata (needed for lockstep bucket math), and
-    the values move through the table's device-parts collectives
-    instead of the host staging wire."""
+class _OptionExt(Extension):
+    """The engine's updater-option record tags, layered over the flat
+    core (the one jax-coupled piece of the grammar: the option classes
+    live beside the updaters)."""
 
-    __slots__ = ("dtype", "shape", "local")
+    def encode(self, parts: list, v) -> bool:
+        if type(v) is AddOption:
+            parts.append(b"o")
+            parts.append(_ADD_OPT.pack(
+                int(v.worker_id), float(v.momentum),
+                float(v.learning_rate), float(v.rho), float(v.lambda_)))
+            return True
+        if type(v) is GetOption:
+            parts.append(b"g")
+            parts.append(_I64.pack(int(v.worker_id)))
+            return True
+        return False
 
-    def __init__(self, dtype, shape, local=None):
-        self.dtype = np.dtype(dtype)
-        self.shape = tuple(int(s) for s in shape)
-        self.local = local
+    def decode(self, tag: bytes, cur: _Cursor):
+        if tag == b"o":
+            wid, mom, lr, rho, lam = cur.unpack(_ADD_OPT)
+            return True, AddOption(worker_id=wid, momentum=mom,
+                                   learning_rate=lr, rho=rho, lambda_=lam)
+        if tag == b"g":
+            return True, GetOption(worker_id=cur.unpack(_I64)[0])
+        return False, None
 
-    @classmethod
-    def of(cls, arr: np.ndarray) -> "DeferredArray":
-        arr = np.asarray(arr)
-        return cls(arr.dtype, arr.shape, local=arr)
 
-    @property
-    def size(self) -> int:
-        n = 1
-        for s in self.shape:
-            n *= s
-        return n
+_EXT = _OptionExt()
 
-    @property
-    def nbytes(self) -> int:
-        return self.size * self.dtype.itemsize
 
-    def __repr__(self) -> str:  # pragma: no cover - debugging aid
-        tag = "local" if self.local is not None else "remote"
-        return f"DeferredArray({self.dtype.str}, {self.shape}, {tag})"
+def _encode_value(parts: list, v) -> None:
+    encode_value(parts, v, _EXT)
+
+
+def _decode_value(cur: _Cursor):
+    return decode_value(cur, _EXT)
 
 
 def payload_nbytes(payload: dict) -> int:
@@ -154,99 +168,6 @@ def payload_has_deferred(payload: dict) -> bool:
     return False
 
 
-def dtype_wire_safe(dt) -> bool:
-    """True when ``dt`` survives the flat wire: its ``.str`` tag decodes
-    back to the SAME dtype. Extension dtypes (e.g. ml_dtypes.bfloat16,
-    which jax registers) stringify as opaque void tags like ``<V2`` —
-    encoding those flat would decode as void (silent corruption), and
-    ``memoryview`` refuses their buffers anyway, so their arrays ride
-    the pickle fallback instead (correct, just slower) and the engine
-    never defers them to the device wire."""
-    dt = np.dtype(dt)
-    try:
-        return not dt.hasobject and np.dtype(dt.str) == dt
-    except TypeError:
-        return False
-
-
-def _norm_array(v: np.ndarray) -> np.ndarray:
-    """Contiguous, little-endian view/copy of ``v`` for the wire."""
-    v = np.ascontiguousarray(v)
-    if v.dtype.byteorder == ">":
-        v = v.astype(v.dtype.newbyteorder("<"))
-    return v
-
-
-def _encode_array_header(parts: list, tag: bytes, dtype: np.dtype,
-                         shape: Tuple[int, ...]) -> None:
-    ds = dtype.str.encode("ascii")
-    parts.append(tag)
-    parts.append(_U8.pack(len(ds)))
-    parts.append(ds)
-    parts.append(_U8.pack(len(shape)))
-    for dim in shape:
-        parts.append(_I64.pack(dim))
-
-
-def _encode_value(parts: list, v) -> None:
-    if v is None:
-        parts.append(b"n")
-    elif isinstance(v, np.ndarray) and dtype_wire_safe(v.dtype):
-        v = _norm_array(v)
-        _encode_array_header(parts, b"a", v.dtype, v.shape)
-        if v.size == 0:
-            pass                       # no payload bytes
-        elif v.ndim == 0:
-            parts.append(v.tobytes())  # memoryview can't cast 0-d
-        else:
-            parts.append(memoryview(v).cast("B"))
-    elif isinstance(v, DeferredArray):
-        _encode_array_header(parts, b"v", v.dtype, v.shape)
-    elif type(v) is AddOption:
-        parts.append(b"o")
-        parts.append(_ADD_OPT.pack(int(v.worker_id), float(v.momentum),
-                                   float(v.learning_rate), float(v.rho),
-                                   float(v.lambda_)))
-    elif type(v) is GetOption:
-        parts.append(b"g")
-        parts.append(_I64.pack(int(v.worker_id)))
-    elif isinstance(v, dict):
-        if len(v) > 255:
-            raise ValueError("wire dict too wide")
-        parts.append(b"d")
-        parts.append(_U8.pack(len(v)))
-        for key in sorted(v):
-            kb = str(key).encode("utf-8")
-            parts.append(_U8.pack(len(kb)))
-            parts.append(kb)
-            _encode_value(parts, v[key])
-    elif isinstance(v, bool):          # before int: bool is an int subtype
-        parts.append(b"t")
-        parts.append(_U8.pack(1 if v else 0))
-    elif isinstance(v, int) and -(2 ** 63) <= v < 2 ** 63:
-        parts.append(b"i")
-        parts.append(_I64.pack(v))
-    elif isinstance(v, float):
-        parts.append(b"f")
-        parts.append(_F64.pack(v))
-    elif isinstance(v, str):
-        sb = v.encode("utf-8")
-        parts.append(b"s")
-        parts.append(_I64.pack(len(sb)))
-        parts.append(sb)
-    elif isinstance(v, bytes):
-        parts.append(b"b")
-        parts.append(_I64.pack(len(v)))
-        parts.append(v)
-    else:
-        # option subclasses, huge ints, user table payloads: correctness
-        # over speed for the exotic tail
-        pb = pickle.dumps(v)
-        parts.append(b"p")
-        parts.append(_I64.pack(len(pb)))
-        parts.append(pb)
-
-
 def encode_window(verbs: List[Tuple[str, int, dict]],
                   seq: int = 0) -> bytes:
     """``[(kind, table_id, payload), ...]`` -> wire bytes. ``kind`` is a
@@ -274,79 +195,9 @@ def encode_window(verbs: List[Tuple[str, int, dict]],
     return blob
 
 
-class _Cursor:
-    __slots__ = ("buf", "pos")
-
-    def __init__(self, buf: bytes, pos: int = 0):
-        self.buf = buf
-        self.pos = pos
-
-    def unpack(self, st: struct.Struct):
-        vals = st.unpack_from(self.buf, self.pos)
-        # mv-lint: ok(cross-domain-state): a _Cursor is constructed, walked and dropped inside ONE decode call — instance-local state; the class-level write aggregation is instance-blind here
-        self.pos += st.size
-        return vals
-
-    def take(self, n: int):
-        out = self.buf[self.pos: self.pos + n]
-        if len(out) != n:
-            raise ValueError("wire blob truncated")
-        self.pos += n
-        return out
-
-
-def _decode_value(cur: _Cursor):
-    tag = cur.take(1)
-    if tag == b"n":
-        return None
-    if tag in (b"a", b"v"):
-        (dlen,) = cur.unpack(_U8)
-        dtype = np.dtype(bytes(cur.take(dlen)).decode("ascii"))
-        (ndim,) = cur.unpack(_U8)
-        shape = tuple(cur.unpack(_I64)[0] for _ in range(ndim))
-        if tag == b"v":
-            return DeferredArray(dtype, shape)
-        count = 1
-        for dim in shape:
-            count *= dim
-        arr = np.frombuffer(cur.buf, dtype, count=count, offset=cur.pos)
-        cur.pos += count * dtype.itemsize
-        return arr.reshape(shape)
-    if tag == b"o":
-        wid, mom, lr, rho, lam = cur.unpack(_ADD_OPT)
-        return AddOption(worker_id=wid, momentum=mom, learning_rate=lr,
-                         rho=rho, lambda_=lam)
-    if tag == b"g":
-        return GetOption(worker_id=cur.unpack(_I64)[0])
-    if tag == b"d":
-        (n,) = cur.unpack(_U8)
-        out = {}
-        for _ in range(n):
-            (klen,) = cur.unpack(_U8)
-            key = bytes(cur.take(klen)).decode("utf-8")
-            out[key] = _decode_value(cur)
-        return out
-    if tag == b"t":
-        return bool(cur.unpack(_U8)[0])
-    if tag == b"i":
-        return cur.unpack(_I64)[0]
-    if tag == b"f":
-        return cur.unpack(_F64)[0]
-    if tag == b"s":
-        (n,) = cur.unpack(_I64)
-        return bytes(cur.take(n)).decode("utf-8")
-    if tag == b"b":
-        (n,) = cur.unpack(_I64)
-        return bytes(cur.take(n))
-    if tag == b"p":
-        (n,) = cur.unpack(_I64)
-        return pickle.loads(bytes(cur.take(n)))
-    raise ValueError(f"unknown wire tag {tag!r}")
-
-
 def decode_window_seq(blob: bytes):
     """Wire bytes -> ``(seq, [(kind, table_id, payload), ...])``. Array
-    entries are zero-copy READ-ONLY views into ``blob``. The CRC32
+    entries are zero-copy READ-ONLY views into ``blob``. The seal
     trailer is verified FIRST: corruption raises ``WireCorruption``
     before any byte is parsed."""
     check_crc(blob)

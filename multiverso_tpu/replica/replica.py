@@ -20,9 +20,15 @@ process:
    ``ServingFrontend``: admission bound, micro-batch coalescing into
    one fused union gather, typed ``ServingOverloaded`` shedding — all
    identical to in-process serving, host gather path only;
-4. **answers** a tiny length-prefixed CRC-framed TCP protocol
+4. **answers** a tiny length-prefixed FLAT-framed TCP protocol
    (:class:`ReplicaClient`): ``lookup`` / ``status`` / ``pin`` /
-   ``unpin`` — the QPS surface the bench drives.
+   ``unpin`` — the QPS surface the bench drives. Round 19: the frames
+   ride :mod:`multiverso_tpu.parallel.flat` (the window wire's
+   header+raw-segments grammar, sealed with the versioned CRC32C
+   trailer) instead of pickled dicts — id vectors ship as raw array
+   segments and result rows decode ZERO-COPY (``np.frombuffer`` views
+   into the received buffer), the ROADMAP's named "next 10x" for the
+   read tier.
 
 Lifecycle is lease-symmetric: the trainer evicts a replica whose lease
 expires; the replica exits when its heartbeats report eviction or the
@@ -37,6 +43,7 @@ import json
 import os
 import socket
 import socketserver
+import struct
 import sys
 import threading
 import time
@@ -44,9 +51,9 @@ from typing import List, Optional
 
 import numpy as np
 
-from multiverso_tpu.elastic.coordinator import (MemberClient, _recv_frame,
-                                                _send_frame)
+from multiverso_tpu.elastic.coordinator import MemberClient, _recv_exact
 from multiverso_tpu.failsafe.errors import TransientError
+from multiverso_tpu.parallel import flat
 from multiverso_tpu.replica import delta as rdelta
 from multiverso_tpu.serving.frontend import ServingFrontend
 from multiverso_tpu.serving.store import SnapshotStore
@@ -61,6 +68,29 @@ _HB_FAILS_FATAL = 10
 #: how long the shm attach retries while the publisher discovers this
 #: subscription and creates its ring segment
 _ATTACH_TIMEOUT_S = 60.0
+
+_FLEN = struct.Struct("<I")
+
+#: cap on one lookup frame (guards the length prefix against reading
+#: garbage as a gigabyte allocation — the coordinator frame posture)
+_MAX_LOOKUP_FRAME = 1 << 31
+
+
+def _send_flat(sock: socket.socket, obj) -> None:
+    """One length-prefixed flat protocol frame (parallel/flat.py:
+    header + raw array segments + the versioned seal). Replaced the
+    pickled frames in round 19 — pickle walked and copied every result
+    buffer twice per lookup; the flat frame writes array bytes once and
+    the far side decodes them zero-copy."""
+    blob = flat.encode_frame(obj)
+    sock.sendall(_FLEN.pack(len(blob)) + blob)
+
+
+def _recv_flat(sock: socket.socket):
+    n = _FLEN.unpack(_recv_exact(sock, 4))[0]
+    CHECK(0 < n < _MAX_LOOKUP_FRAME,
+          f"replica lookup frame length insane: {n}")
+    return flat.decode_frame(_recv_exact(sock, n))
 
 
 class _LookupServer(socketserver.ThreadingTCPServer):
@@ -82,7 +112,7 @@ class _LookupHandler(socketserver.BaseRequestHandler):
     def handle(self):
         while True:
             try:
-                req = _recv_frame(self.request)
+                req = _recv_flat(self.request)
             except (ConnectionError, OSError):
                 return          # client closed — normal end of stream
             try:
@@ -90,7 +120,7 @@ class _LookupHandler(socketserver.BaseRequestHandler):
             except Exception as exc:
                 resp = {"err": type(exc).__name__, "msg": str(exc)}
             try:
-                _send_frame(self.request, resp)
+                _send_flat(self.request, resp)
             except OSError:
                 return
 
@@ -320,7 +350,12 @@ class ReplicaClient:
     the TCP handshake rate); reconnects once on a broken stream. A
     client instance serializes its calls under a lock — give each
     reader thread its own instance for concurrency (the server
-    micro-batches across connections anyway)."""
+    micro-batches across connections anyway).
+
+    Round 19: requests/responses are flat frames — ``lookup`` ships its
+    id vector as a raw array segment and the returned rows are a
+    READ-ONLY zero-copy view into the receive buffer (copy before
+    mutating, the window-wire contract)."""
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, int(port)
@@ -344,8 +379,8 @@ class ReplicaClient:
                         (self.host, self.port), timeout=timeout)
                 try:
                     self._sock.settimeout(timeout)
-                    _send_frame(self._sock, req)
-                    resp = _recv_frame(self._sock)
+                    _send_flat(self._sock, req)
+                    resp = _recv_flat(self._sock)
                     break
                 except (ConnectionError, OSError):
                     # server restarted / idle stream dropped: one
@@ -362,9 +397,15 @@ class ReplicaClient:
     def lookup(self, table_id: int, ids=None, *,
                version: Optional[int] = None,
                deadline: Optional[float] = None) -> np.ndarray:
-        ids_l = None if ids is None else np.asarray(ids).tolist()
+        # ids ride the wire as a raw array segment (the flat codec's
+        # 'a' tag) — the old pickled-list spelling re-boxed every id.
+        # Dtype is NOT coerced here: the server's admission validation
+        # owns id typing (a float id vector must fail THERE with the
+        # typed message, not silently truncate in the client)
+        ids_a = None if ids is None else np.ascontiguousarray(
+            np.asarray(ids).ravel())
         return self._call(op="lookup", table_id=int(table_id),
-                          ids=ids_l, version=version,
+                          ids=ids_a, version=version,
                           deadline=deadline)["rows"]
 
     def status(self) -> dict:

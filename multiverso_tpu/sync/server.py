@@ -760,6 +760,12 @@ class Server(Actor):
         self._overlap_lock = threading.Lock()
         self.RegisterHandler(MsgType.Request_Get, self._get_entry)
         self.RegisterHandler(MsgType.Request_Add, self._add_entry)
+        # round 19 — batched verb envelopes flatten into the window at
+        # drain time (_expand_multi), so the window entry handles them;
+        # counters registered eagerly (the PR 6 scrape-at-zero rule)
+        self.RegisterHandler(MsgType.Request_MultiVerb, self._get_entry)
+        self._t_multi = tmetrics.counter("engine.multi_verb_batches")
+        self._t_multi_size = tmetrics.histogram("engine.multi_verb_size")
         self.RegisterHandler(MsgType.Server_Finish_Train, self.ProcessFinishTrain)
         # barrier ping: replies once the mailbox drained up to this point —
         # must NOT touch the BSP clocks, unlike FinishTrain (native
@@ -789,6 +795,48 @@ class Server(Actor):
     #: round accounting — SyncServer overrides both to False.
     GET_CACHE_OK = True
     WRITE_COMBINE_OK = True
+    #: round 19 — whether this engine flattens Request_MultiVerb
+    #: envelopes. The async window engine does (members become ordinary
+    #: window verbs); the BSP SyncServer processes messages strictly
+    #: one at a time, so Zoo.SendToServerMulti falls back to delivering
+    #: the members individually there (same stream order, unbatched).
+    MULTI_VERB_OK = True
+
+    def receive_multi(self, members) -> None:
+        """Accept one batched verb submission: wrap the pre-built
+        member messages in a Request_MultiVerb envelope and push it —
+        ONE mailbox hop for the whole batch. The envelope's on_reply
+        forwards a failure reply (actor death sweep / handler error on
+        the envelope itself) to every member, so batch waiters raise
+        typed instead of hanging when the engine dies mid-flight."""
+        env = Message(msg_type=MsgType.Request_MultiVerb,
+                      payload={"members": list(members)},
+                      on_reply=_fail_multi_members)
+        # straight to the mailbox (poison check + push): routing
+        # already happened — ShardedServer.receive_multi split the
+        # batch per shard before delegating here, and going back
+        # through its Receive override would re-split forever
+        Actor.Receive(self, env)
+
+    def _expand_multi(self, batch: list) -> list:
+        """Flatten Request_MultiVerb envelopes into their member verbs
+        IN PLACE of the envelope's drain position — the members enter
+        the window in submission order, ahead of anything drained after
+        the envelope, which is exactly the serial-stream order N single
+        submits would have produced. Members carry no mailbox enqueue
+        stamp, so note_dequeue skips them (the envelope's one stamp
+        already accounted the hop)."""
+        out: list = []
+        for m in batch:
+            if m.msg_type is MsgType.Request_MultiVerb:
+                self.note_dequeue(m)
+                members = m.payload["members"]
+                self._t_multi.inc()
+                self._t_multi_size.observe(len(members))
+                out.extend(members)
+            else:
+                out.append(m)
+        return out
 
     def RegisterTable(self, server_table) -> int:
         table_id = len(self.store_)
@@ -1214,9 +1262,16 @@ class Server(Actor):
             if not ok:
                 break
             batch.append(nxt)
+        # round 19 — batched verb envelopes flatten here, BEFORE
+        # admission/windowing: each member is an ordinary stream verb
+        # from this point on (dedup slots, chaos draws, window
+        # positions, replies), so one envelope = one admission but N
+        # lockstep stream positions
+        batch = self._expand_multi(batch)
         for m in batch:
             # drained members bypass _dispatch — observe their queue
-            # wait here (idempotent; the head was noted there already)
+            # wait here (idempotent; the head was noted there already,
+            # and multi members carry no enqueue stamp)
             self.note_dequeue(m)
         # failsafe admission (dedup + chaos) BEFORE windowing: a
         # duplicate or chaos-rejected verb must never become a stream
@@ -1520,11 +1575,21 @@ class Server(Actor):
         while fed:
             # opportunistic drain: verbs arriving during an exchange
             # join the stage's pending deque and form the next window
-            # (bounded per spin so applies are never starved)
+            # (bounded per spin so applies are never starved). Batched
+            # envelopes flatten HERE too — without the expansion an
+            # envelope would feed the stage as a barrier, a per-rank
+            # timing artifact that diverges the SPMD streams (review
+            # catch, round 19)
             for _ in range(64):
                 ok, m = self.mailbox.TryPop()
                 if not ok:
                     break
+                if m.msg_type is MsgType.Request_MultiVerb:
+                    for mm in self._expand_multi([m]):
+                        if self._admit(mm):
+                            fed.append(mm)
+                            self._pl_feed(stage, mm)
+                    continue
                 self.note_dequeue(m)
                 if self._admit(m):
                     fed.append(m)
@@ -1591,6 +1656,12 @@ class Server(Actor):
                     self._pl_feed(stage, m)
 
     def _pl_feed(self, stage: _ExchangeStage, m: Message) -> None:
+        # envelopes must have been flattened by every feeding path —
+        # one reaching the stage would become a bogus cross-rank
+        # barrier position
+        CHECK(m.msg_type is not MsgType.Request_MultiVerb,
+              "unexpanded multi-verb envelope fed to the exchange "
+              "stage (engine bug)")
         if m.msg_type in (MsgType.Request_Add, MsgType.Request_Get):
             stage.feed_verbs([m])
         else:
@@ -2454,6 +2525,18 @@ def engine_shard_cap() -> int:
 #: publish, the barrier drain ping, and FinishTrain. Any OTHER
 #: non-verb type dispatches on shard 0 only (unknown types have no
 #: cross-shard ordering to preserve).
+def _fail_multi_members(env: Message) -> None:
+    """on_reply of a Request_MultiVerb envelope: the ONLY reply an
+    envelope ever takes is a failure sweep (actor poison via
+    _fail_pending, or _dispatch's error routing when expansion itself
+    raised) — forward it to every member so batch waiters raise typed
+    instead of hanging on a dead engine. First-reply-wins on each
+    member makes the forward idempotent against normal replies."""
+    if isinstance(env.result, Exception):
+        for m in env.payload.get("members", ()):
+            m.reply(env.result)
+
+
 _CUT_TYPES = (MsgType.Request_StoreLoad, MsgType.Request_Publish,
               MsgType.Request_Barrier, MsgType.Server_Finish_Train)
 
@@ -2654,7 +2737,35 @@ class ShardedServer(Server):
                       1 + len(self._subs), self._shard_cap)
         return table_id
 
+    def receive_multi(self, members) -> None:
+        """Split one batch per shard stream (round 19): routing is by
+        table (``table_id % slots``), so splitting the member list by
+        slot preserves every TABLE's submission order — the guarantee
+        the batched-verb contract makes — while each shard still takes
+        its sub-batch as one envelope. Worst case the batch costs
+        min(len, live shards) pushes instead of one; per-shard verb
+        positions stay lockstep across SPMD ranks because the split is
+        the same rank-agreed arithmetic the router uses."""
+        if not self._subs:
+            return super().receive_multi(members)
+        groups: Dict[int, list] = {}
+        for m in members:
+            slot = m.table_id % self._shard_cap if m.table_id >= 0 else 0
+            groups.setdefault(slot, []).append(m)
+        for slot, ms in groups.items():
+            sub = self._subs.get(slot)
+            if sub is not None:
+                sub.receive_multi(ms)
+            else:
+                Server.receive_multi(self, ms)
+
     def Receive(self, msg: Message) -> None:
+        if msg.msg_type is MsgType.Request_MultiVerb:
+            # a pre-wrapped envelope (tests / direct callers): re-split
+            # it per shard — letting shard 0 expand it would put other
+            # shards' tables into the wrong window stream
+            self.receive_multi(msg.payload["members"])
+            return
         if msg.msg_type in (MsgType.Request_Get, MsgType.Request_Add):
             slot = (msg.table_id % self._shard_cap
                     if msg.table_id >= 0 else 0)
@@ -2715,9 +2826,23 @@ class SyncServer(Server):
     #: accounting ("all workers issue the same number of Gets/Adds")
     GET_CACHE_OK = False
     WRITE_COMBINE_OK = False
+    #: ...and batched envelopes would hide N clock ticks inside one
+    #: message — Zoo.SendToServerMulti delivers members individually
+    MULTI_VERB_OK = False
 
     def __init__(self, num_workers: int):
         super().__init__()
+        # Zoo.SendToServerMulti honors MULTI_VERB_OK and delivers
+        # members individually, but direct callers (Server.receive_multi
+        # is inherited; ShardedServer.Receive documents pre-wrapped
+        # envelopes) could still land one — the inherited registration
+        # points at _get_entry, whose BSP override would feed the
+        # envelope to ProcessGet (table_id -1 → a bogus store_[-1]
+        # dispatch AND a spurious get-clock tick). Re-register a
+        # handler that flattens members strictly one at a time through
+        # the clocked entries instead (review catch, round 19).
+        self.RegisterHandler(MsgType.Request_MultiVerb,
+                             self._multi_entry_bsp)
         self._num_workers = num_workers
         self._get_clocks = VectorClock(num_workers)
         self._add_clocks = VectorClock(num_workers)
@@ -2753,6 +2878,18 @@ class SyncServer(Server):
                 CHECK(not self._get_clocks.Update(get_msg.src),
                       "drained Get must not complete a round")
         self._note_staleness()
+
+    def _multi_entry_bsp(self, msg: Message) -> None:
+        """A batched envelope on the BSP engine: process the members
+        inline, strictly one at a time, through the clocked entries —
+        at the envelope's mailbox position, so member order (and the
+        round accounting, which counts individual messages) is exactly
+        what member-by-member delivery would have produced."""
+        for m in msg.payload["members"]:
+            if m.msg_type is MsgType.Request_Add:
+                self._add_entry(m)
+            else:
+                self._get_entry(m)
 
     def _get_entry(self, msg: Message) -> None:
         # no pipelining window under BSP: the vector-clock protocol's

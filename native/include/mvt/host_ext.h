@@ -24,6 +24,14 @@ void MV_HostStoreGetRows(void* h, const int32_t* ids, int64_t n,
 // or single-threaded), inline_small = under the parallel byte floor
 void MV_HostStorePoolStats(int64_t* out);
 
+// CRC32C (Castagnoli) with zlib.crc32-style chaining (crc32c.cc): the
+// hardware seal behind parallel/seal.py's versioned trailer. MV_Crc32cHw
+// reports whether the SSE4.2 path serves; MV_Crc32cSw forces the
+// slicing-by-8 software path (the selftest's independent oracle).
+uint32_t MV_Crc32c(const uint8_t* data, int64_t n, uint32_t seed);
+uint32_t MV_Crc32cSw(const uint8_t* data, int64_t n, uint32_t seed);
+int MV_Crc32cHw();
+
 void* MV_KvIndexNew(int64_t cap_hint);
 void MV_KvIndexFree(void* h);
 int64_t MV_KvIndexSize(void* h);

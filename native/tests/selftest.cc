@@ -3,6 +3,7 @@
 // world where the whole PS path runs through real actors) plus the util
 // layer (queue/waiter/allocator/blob/flags) and the BSP sync protocol.
 #include <cassert>
+#include <chrono>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -335,6 +336,59 @@ static void test_host_store_pool_concurrent() {
               static_cast<long long>(inline_small));
 }
 
+// round 19 — the versioned seal's hardware CRC32C (crc32c.cc).
+// Agreement: the SSE4.2 path must match the independent slicing-by-8
+// software oracle bit-for-bit (random buffers, every alignment and
+// tail length, chaining splits) AND the known Castagnoli test vector.
+// Throughput: both paths timed over an 8MB buffer — reported, and the
+// hardware path (when present) loosely asserted faster than the
+// oracle (the whole point of the seal upgrade; loose 1.2x bound so a
+// sanitizer-instrumented or preempted run can't flake).
+static void test_crc32c() {
+  // RFC 3720 test vector: crc32c("123456789") = 0xE3069283
+  const char* nine = "123456789";
+  assert(MV_Crc32c(reinterpret_cast<const uint8_t*>(nine), 9, 0) ==
+         0xE3069283u);
+  assert(MV_Crc32cSw(reinterpret_cast<const uint8_t*>(nine), 9, 0) ==
+         0xE3069283u);
+  // agreement across sizes, alignments and chain splits
+  std::vector<uint8_t> buf(4096 + 32);
+  uint32_t x = 123456789u;
+  for (auto& b : buf) {
+    x = x * 1664525u + 1013904223u;
+    b = static_cast<uint8_t>(x >> 24);
+  }
+  for (int off = 0; off < 9; ++off) {
+    for (int64_t n : {0, 1, 7, 8, 9, 63, 64, 65, 1000, 4096}) {
+      const uint8_t* p = buf.data() + off;
+      uint32_t hw = MV_Crc32c(p, n, 0);
+      uint32_t sw = MV_Crc32cSw(p, n, 0);
+      assert(hw == sw);
+      // chaining: crc(p[0:k]) fed as seed for p[k:n] == crc(p[0:n])
+      int64_t k = n / 3;
+      assert(MV_Crc32c(p + k, n - k, MV_Crc32c(p, k, 0)) == hw);
+      assert(MV_Crc32cSw(p + k, n - k, MV_Crc32cSw(p, k, 0)) == sw);
+    }
+  }
+  // throughput over 8MB (the seal bench's top size)
+  const int64_t big_n = 8LL << 20;
+  std::vector<uint8_t> big(big_n, 0xA5);
+  auto time_path = [&](uint32_t (*fn)(const uint8_t*, int64_t, uint32_t)) {
+    uint32_t acc = 0;
+    auto t0 = std::chrono::steady_clock::now();
+    const int reps = 4;
+    for (int r = 0; r < reps; ++r) acc = fn(big.data(), big_n, acc);
+    std::chrono::duration<double> dt = std::chrono::steady_clock::now() - t0;
+    (void)acc;
+    return (reps * big_n / 1e9) / dt.count();  // GB/s
+  };
+  double hw_gbs = time_path(MV_Crc32c);
+  double sw_gbs = time_path(MV_Crc32cSw);
+  std::printf("crc32c (hw=%d) %.2f GB/s vs software oracle %.2f GB/s OK\n",
+              MV_Crc32cHw(), hw_gbs, sw_gbs);
+  if (MV_Crc32cHw()) assert(hw_gbs > 1.2 * sw_gbs);
+}
+
 static void test_kv_index() {
   void* ix = MV_KvIndexNew(4);
   std::vector<int64_t> keys = {42, -7, 42, 1LL << 60, 0};
@@ -380,6 +434,7 @@ int main() {
   test_io_and_serializable();
   test_host_store();
   test_host_store_pool_concurrent();
+  test_crc32c();
   test_kv_index();
   std::printf("ALL NATIVE TESTS OK\n");
   return 0;

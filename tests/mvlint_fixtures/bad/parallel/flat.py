@@ -1,0 +1,11 @@
+"""Fixture twin of the flat codec (round 19) — benign in the bad tree
+too (no new rule seeds here; the mirror satisfies the fixture-mirror
+rot law)."""
+
+
+def encode_frame(obj):
+    return b"F" + repr(obj).encode()
+
+
+def decode_frame(blob):
+    return blob[1:]

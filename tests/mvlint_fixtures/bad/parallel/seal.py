@@ -1,0 +1,11 @@
+"""Fixture twin of the versioned seal (round 19) — bad tree carries
+the same benign module (the seal rules have no seeded violation; the
+mirror exists for the fixture-mirror rot law)."""
+
+
+def seal_frame(body):
+    return body + b"\x00\x00\x00\x00\xc2"
+
+
+def open_frame(blob):
+    return blob[:-5]

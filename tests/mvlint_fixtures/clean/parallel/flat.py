@@ -1,0 +1,10 @@
+"""Fixture twin of the flat codec (round 19): encode/decode helpers,
+no threads, no collectives."""
+
+
+def encode_frame(obj):
+    return b"F" + repr(obj).encode()
+
+
+def decode_frame(blob):
+    return blob[1:]

@@ -1,0 +1,11 @@
+"""Fixture twin of the versioned seal (round 19): pure checksum math,
+no threads, no collectives — present in both trees so the scanned-
+coverage pins exercise the module cross-package."""
+
+
+def seal_frame(body):
+    return body + b"\x00\x00\x00\x00\xc2"
+
+
+def open_frame(blob):
+    return blob[:-5]

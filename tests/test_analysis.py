@@ -1165,6 +1165,12 @@ class TestScannedCoveragePins:
                     "telemetry/export.py", "telemetry/watchdog.py",
                     "serving/frontend.py", "elastic/coordinator.py"):
             assert rel in all_rels, rel
+        # round 19 — the seal/flat codec modules are scanned by every
+        # concurrency rule (the batched-verb plane's waiter plumbing
+        # and the lazy-init seal globals live exactly there)
+        for checker in res.checkers:
+            assert "parallel/seal.py" in checker.scanned
+            assert "parallel/flat.py" in checker.scanned
 
 
 class TestMvlintEntryPoint:

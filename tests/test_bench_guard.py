@@ -49,6 +49,14 @@ GUARDED = {
     # serving QPS metrics — TCP client threads are scheduler-noisy
     "replica_lookup_qps": 0.6,
     "replica_2rep_aggregate_qps": 0.6,
+    # round 19 — the versioned seal's hardware CRC32C (GB/s at 1MB; the
+    # acceptance bar was >= 3x zlib's ~1 GB/s, so even the 0.5 floor of
+    # the frozen ~7 GB/s keeps the 3x claim guarded) and the batched
+    # verb plane (MultiAdd at batch 32; floor 0.6 like every
+    # scheduler-noisy throughput number — the frozen ~28k verbs/s at
+    # 0.6 still guards >= 3x the ~3k blocking wall)
+    "seal_crc32c_GB_s": 0.5,
+    "verb_batch_throughput": 0.6,
 }
 
 #: metric -> worst acceptable multiple of the guard value (latency:

@@ -413,6 +413,11 @@ class TestBlockingPathLint:
         for need in ("replica.py", "publisher.py", "delta.py",
                      "__init__.py"):
             assert f"replica/{need}" in scanned, sorted(scanned)
+        # ...and the round-19 batched-verb + seal surfaces: the
+        # MultiCall wait and the seal/flat codecs must stay in scope
+        assert "parallel/seal.py" in scanned, sorted(scanned)
+        assert "parallel/flat.py" in scanned, sorted(scanned)
+        assert "tables/base.py" in scanned, sorted(scanned)
         assert not result.findings, (
             "unbounded blocking calls without a timeout-capable path or "
             "an 'unbounded-ok:' justification:\n"

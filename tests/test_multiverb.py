@@ -1,0 +1,314 @@
+"""Batched verb plane (round 19; tables/base.py MultiCall +
+sync/server.py Request_MultiVerb).
+
+MultiAdd/MultiGet pack N (table, verb) records into ONE engine mailbox
+envelope and one window admission; the engine flattens the envelope at
+window drain, so the members are ordinary stream verbs — same windows,
+same coalescing/dedup, same replies. This file drives:
+
+* bit-exact parity vs the equivalent serial verb sequence (the batch
+  flattens in submission order — single-proc here, 2-proc drill below
+  with integer deltas per the known float-order rule);
+* the ONE-mailbox-hop claim (actor message counter delta == 1 for a
+  32-member batch on the unsharded engine);
+* cross-table batches, per-member error isolation, fire-and-forget
+  batches, results in submission order;
+* the sharded engine's per-shard batch split (routing law preserved);
+* the BSP fallback (SyncServer counts MESSAGES into its clocks, so
+  MULTI_VERB_OK is False there and members deliver individually);
+* the 2-proc drill: batched vs serial worlds agree bit-exactly with
+  both ranks issuing lockstep batches.
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_multihost import run_two_process
+
+
+def _world(argv):
+    import multiverso_tpu as mv
+    mv.MV_Init(argv)
+    return mv
+
+
+class TestMultiVerbSingleProcess:
+    def test_batched_equals_serial_bit_exact(self):
+        """The core parity claim: MultiAdd of N payloads leaves the
+        same bytes as N serial Adds (integer-valued deltas make f32
+        sums grouping-independent, so this pins the PROTOCOL)."""
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.tables import MatrixTableOption
+        try:
+            a = mv.MV_CreateTable(MatrixTableOption(num_rows=60,
+                                                    num_cols=4))
+            b = mv.MV_CreateTable(MatrixTableOption(num_rows=60,
+                                                    num_cols=4))
+            rng = np.random.default_rng(9)
+            payloads = []
+            for _ in range(24):
+                ids = np.sort(rng.choice(60, 5, replace=False)).astype(
+                    np.int32)
+                payloads.append({"row_ids": ids,
+                                 "values": rng.integers(
+                                     -4, 5, (5, 4)).astype(np.float32)})
+            # serial on table a
+            for p in payloads:
+                a.AddRows(p["row_ids"], p["values"])
+            # batched on table b — same verbs, one submission
+            b.MultiAdd(payloads)
+            all_ids = np.arange(60, dtype=np.int32)
+            np.testing.assert_array_equal(a.GetRows(all_ids),
+                                          b.GetRows(all_ids))
+        finally:
+            mv.MV_ShutDown()
+
+    def test_one_mailbox_hop_per_batch(self):
+        """The wall this plane attacks IS the per-verb mailbox round
+        trip: a 32-member tracked batch must cost ONE engine mailbox
+        message (plus nothing else) on the unsharded engine."""
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.telemetry import metrics
+        from multiverso_tpu.tables import MatrixTableOption
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=30,
+                                                    num_cols=2))
+            ids = np.arange(3, dtype=np.int32)
+            d = np.ones((3, 2), np.float32)
+            t.AddRows(ids, d)               # warm (instrument lazies)
+            ctr = metrics.counter("actor.server.messages")
+            before = ctr.value
+            t.MultiAdd([{"row_ids": ids, "values": d}
+                        for _ in range(32)])
+            assert ctr.value == before + 1, (before, ctr.value)
+            snap = metrics.snapshot()
+            assert snap.get("engine.multi_verb_batches",
+                            {}).get("value", 0) >= 1
+            hist = snap.get("engine.multi_verb_size", {})
+            assert hist.get("count", 0) >= 1
+        finally:
+            mv.MV_ShutDown()
+
+    def test_cross_table_multiget_and_order(self):
+        """MV_MultiGet across tables: results in submission order,
+        equal to the individual Gets; an Add ahead of a Get to the
+        same table within one batch is observed (submission order =
+        stream order)."""
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.tables import KVTableOption, MatrixTableOption
+        try:
+            m = mv.MV_CreateTable(MatrixTableOption(num_rows=20,
+                                                    num_cols=2))
+            kv = mv.MV_CreateTable(KVTableOption())
+            ids = np.arange(4, dtype=np.int32)
+            d = np.full((4, 2), 2.0, np.float32)
+            keys = np.array([5, 7], np.int64)
+            mv.MV_MultiAdd([
+                (m, {"row_ids": ids, "values": d}),
+                (kv, {"keys": keys,
+                      "values": np.array([1.0, 3.0], np.float32)})])
+            got_m, got_kv = mv.MV_MultiGet([
+                (m, {"row_ids": ids}), (kv, {"keys": keys})])
+            np.testing.assert_array_equal(got_m, d)
+            np.testing.assert_array_equal(
+                got_kv, np.array([1.0, 3.0], np.float32))
+            # an Add AHEAD of the same table's Get inside ONE batch is
+            # visible to that Get (the batch flattens in order and the
+            # window applies a table's adds at its first-add position)
+            res = mv.MV_MultiGetAsync([(m, {"row_ids": ids})])
+            mv.MV_MultiAdd([(m, {"row_ids": ids, "values": d})])
+            res.Wait()
+            batch = mv.MV_MultiGet([(m, {"row_ids": ids})])
+            np.testing.assert_array_equal(batch[0], 2 * d)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_member_error_isolated(self):
+        """A bad member fails ITSELF only — per-message error routing
+        survives batching. Wait raises the first error; the per-member
+        view shows the healthy results."""
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.tables import MatrixTableOption
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=10,
+                                                    num_cols=2))
+            good = {"row_ids": np.arange(2, dtype=np.int32)}
+            bad = {"row_ids": np.array([10 ** 7], np.int32)}
+            call = t.MultiGetAsync([good, bad, good])
+            with pytest.raises(Exception):
+                call.Wait()
+            res = call.Wait(return_exceptions=True)
+            assert res[0].shape == (2, 2)
+            assert isinstance(res[1], Exception)
+            assert res[2].shape == (2, 2)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_fire_and_forget_batch(self):
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=10,
+                                                    num_cols=2))
+            ids = np.arange(4, dtype=np.int32)
+            d = np.ones((4, 2), np.float32)
+            call = t.MultiAddAsync([{"row_ids": ids, "values": d}] * 3,
+                                   track=False)
+            assert call.Wait() == [None, None, None]   # nothing tracked
+            Zoo.Get().DrainServer()
+            np.testing.assert_array_equal(t.GetRows(ids), 3 * d)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_sharded_engine_splits_batch_per_shard(self):
+        """A cross-shard batch routes each member to its table's shard
+        stream (the routing law) — results stay correct and BOTH shard
+        streams see traffic."""
+        mv = _world(["-mv_engine_shards=2"])
+        from multiverso_tpu.sync.server import ShardedServer
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        try:
+            t0 = mv.MV_CreateTable(MatrixTableOption(num_rows=12,
+                                                     num_cols=2))
+            t1 = mv.MV_CreateTable(MatrixTableOption(num_rows=12,
+                                                     num_cols=2))
+            eng = Zoo.Get().server_engine
+            assert isinstance(eng, ShardedServer)
+            ids = np.arange(4, dtype=np.int32)
+            d = np.full((4, 2), 3.0, np.float32)
+            mv.MV_MultiAdd([(t0, {"row_ids": ids, "values": d}),
+                            (t1, {"row_ids": ids, "values": d}),
+                            (t0, {"row_ids": ids, "values": d})])
+            r = mv.MV_MultiGet([(t0, {"row_ids": ids}),
+                                (t1, {"row_ids": ids})])
+            np.testing.assert_array_equal(r[0], 2 * d)
+            np.testing.assert_array_equal(r[1], d)
+            assert eng._subs, "no sub-shard spawned"
+        finally:
+            mv.MV_ShutDown()
+
+    def test_bsp_sync_server_fallback(self):
+        """SyncServer counts Get/Add MESSAGES into its vector clocks —
+        MULTI_VERB_OK is False there, so batches deliver member-by-
+        member and the BSP accounting stays sound. A pre-wrapped
+        envelope delivered DIRECTLY (the path zoo's gate doesn't
+        cover) must flatten through the clocked entries too, not reach
+        ProcessGet as a bogus table_id=-1 message (review catch)."""
+        mv = _world(["-sync=true", "-num_workers=1"])
+        from multiverso_tpu.tables import MatrixTableOption
+        from multiverso_tpu.zoo import Zoo
+        try:
+            eng = Zoo.Get().server_engine
+            assert not eng.MULTI_VERB_OK
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=8,
+                                                    num_cols=2))
+            ids = np.arange(2, dtype=np.int32)
+            d = np.ones((2, 2), np.float32)
+            t.MultiAdd([{"row_ids": ids, "values": d}] * 2)
+            got = t.MultiGet([{"row_ids": ids}])
+            np.testing.assert_array_equal(got[0], 2 * d)
+            # direct envelope (bypasses zoo's MULTI_VERB_OK gate): the
+            # BSP engine must process the members one at a time
+            call = __import__(
+                "multiverso_tpu.tables.base", fromlist=["MultiCall"]
+            ).MultiCall(1, 1)
+            member = t._multi_member("G", {"row_ids": ids}, None,
+                                     call, 0, True)
+            eng.receive_multi([member])
+            res = call.Wait(deadline=30.0)
+            np.testing.assert_array_equal(res[0], 2 * d)
+        finally:
+            mv.MV_ShutDown()
+
+    def test_multiget_results_copy_safe(self):
+        """Every member owns its result (the reply machinery's
+        copy_result contract carries over): mutating one member's rows
+        must not corrupt a dedup sibling's."""
+        mv = _world(["-mv_engine_shards=1"])
+        from multiverso_tpu.tables import MatrixTableOption
+        try:
+            t = mv.MV_CreateTable(MatrixTableOption(num_rows=6,
+                                                    num_cols=2))
+            ids = np.arange(3, dtype=np.int32)
+            t.AddRows(ids, np.ones((3, 2), np.float32))
+            r = t.MultiGet([{"row_ids": ids}, {"row_ids": ids}])
+            r[0][:] = 99.0
+            np.testing.assert_array_equal(r[1],
+                                          np.ones((3, 2), np.float32))
+        finally:
+            mv.MV_ShutDown()
+
+
+_MULTIVERB_PARITY_CHILD = r'''
+import os, sys
+rank, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption, KVTableOption
+
+R, C, K, ROUNDS = 120, 4, 8, 8
+
+def world(batched, coord_port):
+    mv.MV_Init([f"-dist_coordinator=127.0.0.1:{coord_port}",
+                f"-dist_rank={rank}", "-dist_size=2",
+                "-mv_deadline_s=60"])
+    mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+    kv = mv.MV_CreateTable(KVTableOption())
+    rng = np.random.default_rng(53 + rank)
+    for i in range(ROUNDS):
+        # integer-valued deltas: f32 sums of small ints are exact under
+        # ANY window grouping, so bit-equality tests the PROTOCOL (the
+        # known float-order rule from the sharded parity drill)
+        ids = np.sort(rng.choice(R, K, replace=False)).astype(np.int32)
+        deltas = rng.integers(-4, 5, (K, C)).astype(np.float32)
+        ids2 = np.sort(rng.choice(R, K, replace=False)).astype(np.int32)
+        deltas2 = rng.integers(-4, 5, (K, C)).astype(np.float32)
+        keys = np.array([i, 700 + rank], np.int64)
+        kvals = np.ones(2, np.float32)
+        # a fire-and-forget burst AHEAD of the batch keeps the engine
+        # mid-pipeline when the envelope lands, exercising the
+        # opportunistic-drain expansion (_mh_pipelined's TryPop loop —
+        # an unexpanded envelope there fed the stage as a bogus
+        # barrier; review catch, round 19)
+        ids3 = np.sort(rng.choice(R, K, replace=False)).astype(np.int32)
+        deltas3 = rng.integers(-4, 5, (K, C)).astype(np.float32)
+        for _ in range(3):
+            mat.AddFireForget(deltas3, row_ids=ids3)
+        if batched:
+            mv.MV_MultiAdd([
+                (mat, {"row_ids": ids, "values": deltas}),
+                (kv, {"keys": keys, "values": kvals}),
+                (mat, {"row_ids": ids2, "values": deltas2})])
+        else:
+            mat.AddRows(ids, deltas)
+            kv.Add(keys, kvals)
+            mat.AddRows(ids2, deltas2)
+    final = mat.GetRows(np.arange(R, dtype=np.int32))
+    keys = np.array(sorted(set(list(range(ROUNDS)) + [700, 701])),
+                    np.int64)
+    kvv = kv.Get(keys)
+    mv.MV_Barrier()
+    mv.MV_ShutDown()
+    return final, kvv
+
+fb, kb = world(True, port)
+fs, ks = world(False, int(port) + 1)
+np.testing.assert_array_equal(fb, fs)
+np.testing.assert_array_equal(kb, ks)
+print(f"child {rank} MULTIVERB-PARITY OK", flush=True)
+'''
+
+
+class TestMultiVerbTwoProc:
+    def test_batched_vs_serial_bit_exact_parity_2proc(self, tmp_path):
+        """The acceptance drill: both ranks issue identical lockstep
+        MultiAdd batches; the final table bytes equal the serial-verb
+        world's exactly (integer deltas — the float-order rule)."""
+        run_two_process(_MULTIVERB_PARITY_CHILD, tmp_path,
+                        expect="MULTIVERB-PARITY OK")

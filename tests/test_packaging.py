@@ -61,6 +61,39 @@ class TestWheel:
         for mod in ("__init__", "delta", "publisher", "replica"):
             assert f"multiverso_tpu/replica/{mod}.py" in names, names
 
+    def test_seal_verify_path_is_jax_free(self):
+        """Round 19: the versioned seal (parallel/seal.py) + flat frame
+        codec (parallel/flat.py) must seal AND verify without jax — the
+        replica reader authenticates fan-out bundles and serve frames
+        through them. When the native library is present the seal must
+        actually take the hardware-CRC32C tagged form (the native
+        binding is jax-free by design)."""
+        check = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import numpy as np\n"
+            "from multiverso_tpu.parallel import flat, seal\n"
+            "blob = seal.seal_frame(b'payload' * 100)\n"
+            "assert seal.open_frame(blob) == b'payload' * 100\n"
+            "from multiverso_tpu import native\n"
+            "if native.lib() is not None:\n"
+            "    assert blob[-1] == seal.TAG_CRC32C, blob[-1]\n"
+            "    assert native.crc32c(b'123456789') == 0xE3069283\n"
+            "legacy = seal.seal_frame_legacy(b'old')\n"
+            "assert seal.open_frame(legacy) == b'old'\n"
+            "f = flat.encode_frame({'rows': np.arange(6.0)})\n"
+            "assert np.array_equal(flat.decode_frame(f)['rows'],\n"
+            "                      np.arange(6.0))\n"
+            "assert 'jax' not in sys.modules, 'jax entered the seal "
+            "import graph'\n"
+            "print('SEAL-JAXFREE-OK')\n")
+        env = dict(os.environ, PYTHONPATH=ROOT)
+        r = subprocess.run([sys.executable, "-c", check],
+                           capture_output=True, text=True, timeout=120,
+                           env=env)
+        assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
+        assert "SEAL-JAXFREE-OK" in r.stdout
+
     def test_replica_import_path_is_jax_free(self):
         """The replica reader's whole import graph must stay numpy-only
         — `import multiverso_tpu.replica.replica` may never pull jax
@@ -106,6 +139,9 @@ class TestWheel:
             "assert mv.__file__.startswith(%r), mv.__file__\n"
             "from multiverso_tpu import native\n"
             "assert native.lib() is not None, 'installed native lib missing'\n"
+            "assert native.crc32c_fn() is not None, "
+            "'wheel .so lacks the MV_Crc32c seal engine'\n"
+            "assert native.crc32c(b'123456789') == 0xE3069283\n"
             "mv.MV_Init([])\n"
             "from multiverso_tpu.tables import ArrayTableOption\n"
             "t = mv.MV_CreateTable(ArrayTableOption(size=8))\n"

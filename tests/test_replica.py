@@ -223,6 +223,64 @@ class TestCodecRoundTrip:
             mirrors.apply(gap)
 
 
+class TestFlatLookupFrames:
+    """Round 19 — the serve protocol's flat frames (parallel/flat.py
+    over replica._send_flat/_recv_flat): id vectors ride as raw array
+    segments, rows decode zero-copy, corruption raises typed before
+    any parse, and the frames carry the versioned seal."""
+
+    def _pair(self):
+        import socket
+        return socket.socketpair()
+
+    def test_lookup_frame_round_trip_zero_copy(self):
+        from multiverso_tpu.replica.replica import (_recv_flat,
+                                                    _send_flat)
+        a, b = self._pair()
+        try:
+            rows = np.arange(64, dtype=np.float32).reshape(16, 4)
+            _send_flat(a, {"op": "lookup", "table_id": 3,
+                           "ids": np.arange(16, dtype=np.int64),
+                           "version": None, "deadline": 0.5})
+            req = _recv_flat(b)
+            assert req["op"] == "lookup" and req["table_id"] == 3
+            assert req["ids"].dtype == np.int64
+            assert req["version"] is None and req["deadline"] == 0.5
+            _send_flat(b, {"rows": rows})
+            resp = _recv_flat(a)
+            np.testing.assert_array_equal(resp["rows"], rows)
+            # zero-copy contract: a view into the receive buffer,
+            # read-only (callers copy before mutating)
+            assert resp["rows"].base is not None
+            assert not resp["rows"].flags.writeable
+        finally:
+            a.close()
+            b.close()
+
+    def test_corrupt_lookup_frame_raises_typed(self):
+        import struct
+
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        from multiverso_tpu.parallel import flat
+        from multiverso_tpu.replica.replica import _recv_flat
+        a, b = self._pair()
+        try:
+            blob = bytearray(flat.encode_frame({"rows": np.ones(8)}))
+            blob[7] ^= 0x04
+            a.sendall(struct.pack("<I", len(blob)) + bytes(blob))
+            with pytest.raises(WireCorruption):
+                _recv_flat(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_frames_carry_the_versioned_seal(self):
+        from multiverso_tpu.parallel import flat, seal
+        blob = flat.encode_frame({"ok": True})
+        if seal._native() is not None:
+            assert blob[-1] == seal.TAG_CRC32C
+
+
 class TestRelayMailboxOverflow:
     """A laggard's mailbox overflow is a RESYNC signal, not a failure:
     the coordinator drops the queue and flags needs_base, the replica

@@ -320,6 +320,11 @@ class TestNoBarePrintLint:
         for need in ("replica.py", "publisher.py", "delta.py",
                      "__init__.py"):
             assert f"replica/{need}" in scanned, sorted(scanned)
+        # ...and the round-19 seal + flat-codec modules: the versioned
+        # trailer and the serve-protocol framing are failure-reporting
+        # surfaces too
+        assert "parallel/seal.py" in scanned, sorted(scanned)
+        assert "parallel/flat.py" in scanned, sorted(scanned)
         assert not result.findings, (
             "bare print() in the package — route output through "
             "utils/log.py or the telemetry exporters:\n"

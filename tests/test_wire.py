@@ -182,9 +182,14 @@ class TestRoundTrip:
         np.testing.assert_array_equal(got, be.astype("<f4"))
 
     def test_empty_window(self):
+        from multiverso_tpu.parallel import seal
         blob, out = roundtrip([])
-        # 1 kind + 4 seq + 4 count + 4 CRC trailer
-        assert out == [] and len(blob) == 9 + wire.CRC_TRAILER_BYTES
+        # 1 kind + 4 seq + 4 count + the versioned seal trailer (round
+        # 19: 5 bytes crc32c-tagged with the native engine, 4 legacy)
+        trailer = (seal.TAGGED_TRAILER_BYTES
+                   if blob[-1] == seal.TAG_CRC32C
+                   else seal.CRC_TRAILER_BYTES)
+        assert out == [] and len(blob) == 9 + trailer
 
     def test_exchange_seq_roundtrips(self):
         """The window's exchange sequence stamp (the engine's lockstep
@@ -278,3 +283,125 @@ class TestRoundTrip:
         for (k, t, p), (k2, t2, p2) in zip(verbs, out):
             assert (k, t) == (k2, t2)
             assert_payloads_equal(p, p2)
+
+
+class TestVersionedSeal:
+    """Round 19 — the versioned seal trailer (parallel/seal.py):
+    hardware CRC32C tagged, legacy CRC32 still verifying, unknown
+    reserved tags failing loudly. These are the corruption drills the
+    rolling-upgrade story rests on."""
+
+    def _seal(self):
+        from multiverso_tpu.parallel import seal
+        return seal
+
+    def test_tagged_roundtrip_and_bitflips(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        seal = self._seal()
+        body = bytes(range(256)) * 41
+        blob = seal.seal_frame(body)
+        assert seal.open_frame(blob) == body
+        if blob[-1] == seal.TAG_CRC32C:     # native engine present
+            assert len(blob) == len(body) + seal.TAGGED_TRAILER_BYTES
+        # every single-bit flip — body, checksum and tag byte — raises
+        for pos in range(len(blob)):
+            bad = bytearray(blob)
+            bad[pos] ^= 0x20
+            with pytest.raises(WireCorruption):
+                seal.open_frame(bytes(bad))
+
+    def test_legacy_crc32_blob_still_verifies(self):
+        """Cross-version round trip: a blob sealed by the pre-round-19
+        CRC32 trailer opens under the new seal (rolling upgrade — a new
+        reader must open old checkpoint-era and mixed-fleet blobs)."""
+        seal = self._seal()
+        body = b"pre-upgrade payload bytes" * 99
+        legacy = seal.seal_frame_legacy(body)
+        assert len(legacy) == len(body) + seal.CRC_TRAILER_BYTES
+        assert seal.open_frame(legacy) == body
+        seal.check_crc(legacy)              # both verify entry points
+
+    def test_legacy_blob_whose_crc_byte_lands_in_tag_range(self):
+        """The discrimination corner: a LEGACY blob whose crc32 high
+        byte happens to equal the crc32c tag value must still verify
+        (the verify order tries the tagged parse, fails its checksum,
+        then falls back to the legacy check)."""
+        import zlib
+        seal = self._seal()
+        # search a body whose legacy crc's last trailer byte == TAG
+        for i in range(100000):
+            body = b"collide%d" % i
+            crc = zlib.crc32(body) & 0xFFFFFFFF
+            if (crc >> 24) == seal.TAG_CRC32C:
+                break
+        else:                               # pragma: no cover
+            pytest.skip("no collision found")
+        legacy = seal.seal_frame_legacy(body)
+        assert legacy[-1] == seal.TAG_CRC32C
+        assert seal.open_frame(legacy) == body
+
+    def test_unknown_trailer_tag_fails_loudly(self):
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        seal = self._seal()
+        body = b"from the future" * 50
+        blob = (body + seal._U32.pack(seal.crc32c(body))
+                + bytes((seal.TAG_BASE + 0x07,)))
+        with pytest.raises(WireCorruption) as exc:
+            seal.open_frame(blob)
+        assert "unknown seal trailer tag" in str(exc.value)
+
+    def test_crc32c_chaining_and_software_agreement(self):
+        """The streaming contract (shm wire chunk reassembly) and the
+        native-vs-python agreement the selftest checks natively."""
+        seal = self._seal()
+        rng = np.random.default_rng(5)
+        a = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, 1700, dtype=np.uint8).tobytes()
+        assert seal.crc32c(a + b) == seal.crc32c(b, seal.crc32c(a))
+        assert seal.fast_crc(a + b) == seal.fast_crc(b, seal.fast_crc(a))
+        assert seal._sw_crc32c(a) == seal.crc32c(a)
+        # RFC 3720 test vector pins the polynomial itself
+        assert seal.crc32c(b"123456789") == 0xE3069283
+        assert seal._sw_crc32c(b"123456789") == 0xE3069283
+        # memoryview inputs take the generic binding, same answer
+        assert seal.crc32c(memoryview(a)) == seal.crc32c(a)
+
+    def test_window_codec_rides_the_tagged_seal(self):
+        """The engine's window blobs carry the versioned trailer when
+        the native engine is present — the seal upgrade reaches the
+        exchange hot path through the one import home."""
+        from multiverso_tpu.parallel import seal
+        blob = wire.encode_window(
+            [("A", 0, {"values": np.ones(16, np.float32)})])
+        if seal._native() is not None:
+            assert blob[-1] == seal.TAG_CRC32C
+        assert len(wire.decode_window(blob)) == 1
+
+    def test_flat_frame_roundtrip_and_zero_copy(self):
+        """The flat serve-protocol frame (parallel/flat.py): dict with
+        arrays round-trips, array decode is a zero-copy READ-ONLY view
+        into the blob, and corruption raises typed."""
+        from multiverso_tpu.failsafe.errors import WireCorruption
+        from multiverso_tpu.parallel import flat
+        rows = np.arange(48, dtype=np.float32).reshape(12, 4)
+        obj = {"op": "lookup", "rows": rows,
+               "ids": np.arange(12, dtype=np.int64),
+               "version": None, "ok": True, "share": 0.25,
+               "tags": ["a", "b", 3], "blob": b"\x00\x01",
+               "nested": {"n": 7}}
+        blob = flat.encode_frame(obj)
+        out = flat.decode_frame(blob)
+        assert np.array_equal(out["rows"], rows)
+        assert out["rows"].base is not None          # view, not copy
+        assert not out["rows"].flags.writeable
+        assert np.array_equal(out["ids"], obj["ids"])
+        assert out["version"] is None and out["ok"] is True
+        assert out["share"] == 0.25 and out["tags"] == ["a", "b", 3]
+        assert out["blob"] == b"\x00\x01" and out["nested"] == {"n": 7}
+        bad = bytearray(blob)
+        bad[9] ^= 1
+        with pytest.raises(WireCorruption):
+            flat.decode_frame(bytes(bad))
+        with pytest.raises(ValueError):
+            # a window blob is not a flat frame: kind byte mismatch
+            flat.decode_frame(wire.encode_window([]))
