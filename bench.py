@@ -934,6 +934,51 @@ def bench_watchdog_overhead(np, rng):
     }
 
 
+def bench_policy(np, rng):
+    """Policy-plane clean-run floor (round 20): a sharded world with a
+    FAST watchdog tick and the policy fully armed (all rules, short
+    sustain/cooldown — far twitchier than any production config) runs
+    a steady balanced blocking round for ~2s. The self-driving loop
+    must fire ZERO actions on healthy traffic — the quoted
+    ``policy_actions_fired`` joins the guard as an exact-zero floor
+    (tests/test_bench_guard.py GUARDED_ZERO): a decider or guard
+    change that starts acting on a clean world is a regression, not a
+    feature. -> dict."""
+    import multiverso_tpu as mv
+    from multiverso_tpu import policy as mvpolicy
+    from multiverso_tpu.tables import MatrixTableOption
+
+    mv.MV_Init(["-mv_engine_shards=2", "-mv_watchdog_s=0.05",
+                "-mv_policy=true", "-mv_policy_sustain=1",
+                "-mv_policy_cooldown_s=0.1"])
+    try:
+        tables = [mv.MV_CreateTable(MatrixTableOption(
+            num_rows=4096, num_cols=N_COLS)) for _ in range(4)]
+        ids = rng.choice(4096, size=512, replace=False).astype(np.int32)
+        deltas = rng.standard_normal((512, N_COLS)).astype(np.float32)
+        t_end = time.perf_counter() + 2.0
+        rounds = 0
+        while time.perf_counter() < t_end:
+            for t in tables:            # balanced across both shards
+                t.AddRows(ids, deltas)
+            tables[0].GetRows(ids)
+            rounds += 1
+        rep = mv.MV_PolicyReport()
+        fired = rep["installed"]        # drains count into installed
+        evals = rep["evals"]
+    finally:
+        mv.MV_ShutDown()
+    return {
+        "policy_actions_fired": int(fired),
+        "policy_clean_evals": int(evals),
+        "policy_clean_config": (
+            f"4 tables x 2 engine shards, balanced blocking "
+            f"AddRows+GetRows for 2s ({rounds} rounds), watchdog tick "
+            f"0.05s, policy armed with sustain=1 cooldown=0.1s (all "
+            f"rules) — actions fired must be exactly 0"),
+    }
+
+
 def bench_host_scaling(np, rng):
     """N worker threads driving the engine (reference
     Test/test_matrix_perf.cpp:129-173 ran multiple MPI workers; here
@@ -1673,6 +1718,7 @@ def main() -> int:
     section(bench_host_plane, fill_host)
     section(bench_flight_overhead, fill_host)
     section(bench_watchdog_overhead, fill_host)
+    section(bench_policy, fill_host)
     section(bench_sparse_matrix, fill_sparse)
     section(bench_kv_table, fill_kv)
     if platform != "tpu":
@@ -2650,10 +2696,14 @@ GUARD_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 #: guard metrics where LOWER is better (latency/bytes ceilings —
 #: tests/test_bench_guard.py GUARDED_CEIL): the ratchet below keeps the
-#: committed ceiling when a refreeze would RAISE it
+#: committed ceiling when a refreeze would RAISE it. Round 20:
+#: ``policy_actions_fired`` rides this ratchet pinned at its floor —
+#: a clean bench world fires ZERO policy actions (the zero-false-
+#: positive standard; test_bench_guard checks it as an exact zero)
 _GUARD_CEIL_KEYS = ("serving_lookup_p99_ms", "serving_lookup_2proc_p99_ms",
                     "elastic_rebalance_pause_ms",
-                    "replica_delta_vs_full_pct")
+                    "replica_delta_vs_full_pct",
+                    "policy_actions_fired")
 
 
 def update_guard(json_path: str = FULL_JSON_PATH) -> int:
@@ -2686,7 +2736,8 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
             "elastic_rebalance_pause_ms",
             "replica_lookup_qps", "replica_2rep_aggregate_qps",
             "replica_delta_vs_full_pct",
-            "seal_crc32c_GB_s", "verb_batch_throughput")
+            "seal_crc32c_GB_s", "verb_batch_throughput",
+            "policy_actions_fired")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key
@@ -2771,6 +2822,35 @@ if __name__ == "__main__":
         sys.exit(0)
     if sys.argv[1:2] == ["--serving"]:
         sys.exit(serving_section_main())
+    if sys.argv[1:2] == ["--policy"]:
+        # standalone policy clean-run floor section (round 20), merged
+        # into the artifact when the platform/host match (the
+        # --serving pattern)
+        jax, platform = _init_jax_guarded()
+        import numpy as np
+        res = bench_policy(np, np.random.default_rng(0))
+        try:
+            with open(FULL_JSON_PATH) as f:
+                data = json.load(f)
+        except Exception as exc:
+            data = None
+            print(f"NOT merged: no readable full-run artifact at "
+                  f"{FULL_JSON_PATH} ({exc!r}) — run `python bench.py` "
+                  f"first")
+        if data is not None:
+            if (data.get("platform") == platform
+                    and data.get("host_cores") == os.cpu_count()):
+                data.update(res)
+                with open(FULL_JSON_PATH, "w") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"merged policy metrics into {FULL_JSON_PATH}")
+            else:
+                print(f"NOT merged: artifact platform/host "
+                      f"{data.get('platform')}/{data.get('host_cores')}"
+                      f" != {platform}/{os.cpu_count()}")
+        print(json.dumps(res, indent=1, sort_keys=True))
+        sys.exit(0)
     if sys.argv[1:2] == ["--replica"]:
         # standalone replica-plane section (same-host shm fan-out sweep
         # + delta-vs-full bytes), merged into the artifact when the

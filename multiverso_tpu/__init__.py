@@ -70,6 +70,9 @@ _API_NAMES = (
     "MV_ElasticJoin",
     "MV_ElasticEpoch",
     "MV_ElasticMembers",
+    "MV_PolicySync",
+    "MV_PolicyReport",
+    "MV_PolicyKill",
     "MV_WorkerContext",
 )
 

@@ -60,6 +60,15 @@ DEFAULT_ROOTS: Dict[str, str] = {
         "replica lookup serve loop (jax-free reader process)",
     "replica/publisher.py:ReplicaPublisher._run":
         "replica fan-out thread (ships beside the engine stream)",
+    # round 20 — the policy plane's evaluation daemon: it STAGES
+    # actions (local queue / coordinator RPC) and, single-process,
+    # installs at an engine cut (a mailbox hand-off) — never a
+    # collective; the collective drain leg lives in MV_PolicySync on
+    # app threads by construction, and this root keeps it there
+    "policy/engine.py:PolicyEngine._run":
+        "policy evaluation daemon (alert->action loop)",
+    "policy/engine.py:PolicyEngine.step":
+        "policy evaluation step (also driven directly by tests)",
 }
 
 #: collective primitives: node id -> what it is

@@ -443,7 +443,7 @@ class DeviceWorkDomainChecker(Checker):
     #: domains that must stay off the device
     RESTRICTED = frozenset({"watchdog", "reporter", "ops-http", "fanout",
                             "replica-reader", "replica-serve",
-                            "replica-hb"})
+                            "replica-hb", "policy"})
     #: in-package defs that ARE device work even without a lexical jnp
     #: touch: (module-rel regex, qualname regex, label)
     DEVICE_ZONES: List[Tuple[str, str, str]] = [
@@ -635,10 +635,12 @@ class BlockingDomainChecker(Checker):
                    "every wait")
 
     #: the threads the runtime cannot afford to park forever: engine
-    #: verb/apply threads (a stuck engine wedges every rank) and
-    #: request handlers (a stuck handler leaks server threads)
+    #: verb/apply threads (a stuck engine wedges every rank), request
+    #: handlers (a stuck handler leaks server threads), and the policy
+    #: daemon (round 20: a parked actuator is a silent dead-man switch)
     RESTRICTED = frozenset({"engine-shard", "apply-pool", "ops-http",
-                            "replica-serve", "replica-hb", "elastic"})
+                            "replica-serve", "replica-hb", "elastic",
+                            "policy"})
     ALLOW = {
         # pallas DMA semaphore waits: device-side copy completion
         # inside traced kernels — not host-thread blocking (the same

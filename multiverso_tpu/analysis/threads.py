@@ -165,6 +165,15 @@ INVENTORY: List[DomainRoot] = [
                "async prefetch fill thread (target: the caller's fill "
                "callable — an attribute, so claim-only)",
                spawn=("utils/async_buffer.py", "ASyncBuffer._launch")),
+    # -- policy plane (round 20): the alert->action daemon. Its
+    # watchdog-listener intake (PolicyEngine.on_watchdog_tick) runs on
+    # the WATCHDOG thread and is enqueue-only by contract; the
+    # decision/staging work all hangs off _run. Actuation in
+    # multi-process worlds happens at MV_PolicySync on app threads
+    # (deliberately NOT a root — the cut-riding exclusion above).
+    DomainRoot("policy", "policy/engine.py", r"^PolicyEngine\._run$",
+               "policy evaluation daemon (alert->action loop)",
+               spawn=("policy/engine.py", "PolicyEngine.start")),
     # -- infrastructure helpers
     DomainRoot("helper", "failsafe/deadline.py", r"^_Runner\._loop$",
                "bounded-call runner thread",

@@ -370,6 +370,42 @@ def MV_ElasticMembers() -> tuple:
     return elastic.members()
 
 
+def MV_PolicySync(timeout: float = 60.0) -> list:
+    """Policy actuation point (requires ``-mv_policy``): a LOCKSTEP
+    call every active member makes at the same loop position (the
+    MV_SaveCheckpoint / MV_ElasticSync discipline). Pulls the ONE
+    agreed staged-action list from the policy control authority's
+    rendezvous, installs route/tune actions at this rank's fenced
+    engine cut, and runs at most one guarded elastic drain (the sick
+    rank's MV_ElasticLeave against the survivors' MV_ElasticSync).
+    Returns the actions actuated ([] while the plane is off —
+    single-process worlds actuate from the policy thread and rarely
+    have anything left to flush here)."""
+    from multiverso_tpu import policy
+    return policy.sync_point(timeout=timeout)
+
+
+def MV_PolicyReport() -> dict:
+    """The policy plane's local action report (the ``/actions`` body):
+    guard settings, install/revert/drain counts, tracked actions under
+    revert watch, and the bounded action history. Never collective."""
+    from multiverso_tpu import policy
+    return policy.actions_report()
+
+
+def MV_PolicyKill() -> None:
+    """Runtime kill switch: flip ``-mv_policy`` off. The plane keeps
+    watching (sustain/burn state stays warm) but installs nothing from
+    the next evaluation on — including actions ALREADY STAGED: the
+    pull rendezvous agrees the kill verdict across ranks, so one
+    disarmed rank vetoes the whole batch world-wide (it is discarded
+    everywhere, never half-installed). Re-arm with
+    ``MV_SetFlag('mv_policy', 'true')``."""
+    SetCMDFlag("mv_policy", "false")
+    Log.Info("policy: kill switch thrown — acting disabled "
+             "(MV_SetFlag('mv_policy','true') re-arms)")
+
+
 def MV_DumpDiagnostics(dir_path: Optional[str] = None) -> Optional[str]:
     """Write the complete postmortem artifact set — flight ring
     (``flight_rank<R>.jsonl``), local telemetry snapshot

@@ -49,6 +49,16 @@ idempotent or rendezvous-shaped, so the client may retry transients
                     world's gloo collectives cannot subset the world;
                     after any transition the group rides this relay).
 * ``state``       — observability snapshot for /healthz + dashboards.
+* ``policy_put`` / ``policy_pull`` — the policy plane's control-op
+                    stager (round 20): actions stage at-most-once keyed
+                    by ``(epoch, action id)`` (duplicate deliveries —
+                    two ranks proposing one content-derived correction,
+                    chaos retransmits — are no-ops) and drain through a
+                    pull RENDEZVOUS that answers every member the same
+                    sorted list, so installs are rank-agreed. Hosted
+                    here even in non-elastic multi-process worlds
+                    (``-mv_policy_addr``): the authority is pure
+                    control plane either way.
 
 **Replica members (round 17).** ``replica_*`` ops implement the plane's
 second member class: a *replica* is a genuinely NEW process (never part
@@ -208,6 +218,24 @@ class Coordinator:
         #: replica subscriptions (role=replica — NOT epoch members)
         self._replicas: Dict[int, _ReplicaRec] = {}
         self._next_rid = 1
+        #: round 20 — policy-plane control-op staging. Every staged
+        #: action (routing-map install, tune, drain request) is keyed
+        #: by (epoch, action id): a duplicate delivery — two SPMD ranks
+        #: proposing the same content-derived id, a chaos-rehearsed
+        #: retransmit — is a NO-OP answered from the seen-set, exactly
+        #: the shard_put at-most-once posture. The seen-set survives
+        #: the pull that consumes an action, so a late re-delivery of
+        #: an already-installed action cannot re-stage it.
+        self._policy_staged: list = []
+        self._policy_seen: set = set()
+        self._policy_dups = 0
+        #: pull rendezvous bookkeeping (the sync-generation pattern:
+        #: a member's n-th pull joins generation n; the first complete
+        #: rendezvous snapshots + clears the staged queue as the
+        #: generation's one agreed answer)
+        self._ppull_counts: Dict[int, int] = {}
+        self._ppull_arrived: Dict[int, set] = {}
+        self._ppull_answer: Dict[int, list] = {}
         #: newest published version the publisher announced (replica
         #: heartbeats answer lag from this without touching the trainer)
         self._replica_latest = -1
@@ -604,6 +632,19 @@ class Coordinator:
                           if e > self.epoch}
         self._cut_seqs.pop(self.epoch, None)
         self._commits.pop(self.epoch, None)
+        # round 20 — the policy control plane's rendezvous era resets
+        # with the epoch: pull generations re-align so a re-admitted
+        # member rendezvouses with the survivors from a common zero
+        # (the sync-counter re-alignment argument; without this the
+        # survivors' counters race ahead while a drained member is out
+        # and every post-rejoin pull times out forever), and actions
+        # staged under the OLD view are dropped as stale evidence —
+        # their (epoch, id) dedup keys remain, so a retransmit cannot
+        # resurrect them
+        self._ppull_counts.clear()
+        self._ppull_arrived.clear()
+        self._ppull_answer.clear()
+        self._policy_staged = []
         tmetrics.gauge("elastic.epoch").set(self.epoch)
         tmetrics.gauge("elastic.members").set(len(self._active()))
         self._cv.notify_all()
@@ -700,9 +741,111 @@ class Coordinator:
                            if self._transition else None),
                 "shard_frames": len(self._shards),
                 "shard_dedup_hits": self._shard_dups,
+                "policy_staged": len(self._policy_staged),
+                "policy_dedup_hits": self._policy_dups,
                 "replicas": {r.rid: r.status
                              for r in self._replicas.values()},
             }
+
+    # -- policy-plane control ops (round 20) ----------------------------------
+
+    def _op_policy_put(self, req: dict) -> dict:
+        """Stage one policy action (routing-map install / tune / drain
+        request), AT-MOST-ONCE keyed by ``(epoch, action id)``: the
+        SPMD ranks derive ids from content, so N ranks proposing the
+        same correction — or a chaos-rehearsed duplicate delivery —
+        stage it exactly once; a re-delivery after the action was
+        pulled/installed answers from the seen-set instead of
+        re-staging (the shard_put posture, DESIGN.md §20)."""
+        with self._lock:
+            action = dict(req["action"])
+            key = (int(req.get("epoch", 0)), str(action["id"]))
+            dup = key in self._policy_seen
+            if dup:
+                self._policy_dups += 1
+                tmetrics.counter("policy.stage_dedup_hits").inc()
+            else:
+                self._policy_seen.add(key)
+                # staged alongside its dedup key: a kill-vetoed batch
+                # un-sees exactly the keys it staged under
+                self._policy_staged.append((key, action))
+                self._cv.notify_all()
+            return {"ok": True, "dup": dup,
+                    "staged": len(self._policy_staged)}
+
+    def _op_policy_pull(self, req: dict) -> dict:
+        """Rendezvous drain of the staged policy actions: a member's
+        n-th pull joins generation n (server-assigned, the sync
+        pattern); when all ``world`` members arrived, the FIRST
+        complete rendezvous snapshots the staged queue — sorted by
+        action id, so every member applies the identical list in the
+        identical order — and clears it; later arrivals read the same
+        answer. This is what makes a policy install rank-agreed: every
+        rank installs exactly this list at its own lockstep
+        MV_PolicySync position.
+
+        The answer also carries the AGREED kill-switch verdict:
+        ``acting`` is True only when EVERY arrival declared itself
+        armed — one disarmed rank vetoes the whole batch (each rank
+        then discards the identical list instead of half of the world
+        installing it, which would diverge the verb streams).
+
+        A TIMED-OUT waiter withdraws its arrival and rolls its
+        generation counter back, so (a) a later completer cannot count
+        the ghost and consume the staged queue into an answer the
+        ghost never reads, and (b) the member's retry re-joins the
+        SAME generation its peers still expect it at."""
+        member = int(req["member"])
+        world = int(req.get("world", 1))
+        armed = bool(req.get("armed", True))
+        deadline = time.monotonic() + float(req.get("timeout") or 60.0)
+        with self._lock:
+            gen = self._ppull_counts.get(member, 0) + 1
+            self._ppull_counts[member] = gen
+            self._ppull_arrived.setdefault(gen, {})[member] = armed
+            self._cv.notify_all()
+            while True:
+                if gen in self._ppull_answer:
+                    acts, acting = self._ppull_answer[gen]
+                    arr = self._ppull_arrived.get(gen, {})
+                    arr.pop(member, None)
+                    if not arr:
+                        self._ppull_arrived.pop(gen, None)
+                        del self._ppull_answer[gen]
+                    return {"actions": list(acts), "acting": acting}
+                # re-register each iteration: an epoch transition's
+                # era reset (_install) may have cleared the slot — the
+                # wait then times out typed instead of KeyError-ing
+                arr = self._ppull_arrived.setdefault(gen, {})
+                arr.setdefault(member, armed)
+                if len(arr) >= world:
+                    staged = sorted(self._policy_staged,
+                                    key=lambda ka:
+                                    str(ka[1].get("id", "")))
+                    self._policy_staged = []
+                    acting = all(arr.values())
+                    if not acting:
+                        # a vetoed batch was never installed: forget
+                        # its dedup keys so the same correction can
+                        # re-stage after the world re-arms (the keys
+                        # exist to stop duplicate DELIVERIES of one
+                        # proposal, not to wedge a discarded one)
+                        for k, _a in staged:
+                            self._policy_seen.discard(k)
+                    self._ppull_answer[gen] = (
+                        [a for _k, a in staged], acting)
+                    self._cv.notify_all()
+                    continue
+                if time.monotonic() > deadline:
+                    arr.pop(member, None)
+                    if not arr:
+                        self._ppull_arrived.pop(gen, None)
+                    if self._ppull_counts.get(member) == gen:
+                        self._ppull_counts[member] = gen - 1
+                    raise TransientError(
+                        f"policy pull rendezvous {gen} timed out "
+                        f"(arrived {sorted(arr)}, world {world})")
+                self._cv.wait(0.1)
 
     # -- replica subscriptions (role=replica — round 17) ---------------------
 

@@ -109,6 +109,52 @@ def plan_moves(count: int, old_members, new_members) -> List[Tuple[int, int, int
     return moves
 
 
+def plan_routing(shard_load: Dict[int, float],
+                 table_verbs: Dict[int, Dict[int, int]],
+                 routing: Dict[int, int],
+                 live_slots,
+                 min_ratio: float = 1.5) -> Tuple[int, int, int] | None:
+    """Pure routing-map decision for the policy plane's
+    ``shard_imbalance`` loop (round 20): given per-ENGINE-SHARD apply-
+    second deltas (``shard_load``), per-shard per-table verb-count
+    deltas (``table_verbs``), the effective table->slot ``routing`` and
+    the live slot set, name ONE move ``(table_id, src_slot, dst_slot)``
+    — the hottest table (by verb delta, smallest id on ties) of the
+    hottest slot, onto the coolest live slot — or None when no move can
+    help:
+
+    * fewer than two live slots (nothing to rebalance onto);
+    * peak/mean load under ``min_ratio`` (the alert's own threshold —
+      the plan must not out-trigger the watchdog);
+    * the hot slot hosts fewer than two tables (one table cannot be
+      split across streams; moving it just relocates the hot spot).
+
+    Deterministic over its inputs (sorted walks, explicit tie-breaks):
+    SPMD ranks feeding it near-identical local tallies converge on one
+    content-derived action id, which is what lets the coordinator's
+    (epoch, action id) dedup collapse N rank proposals into one staged
+    install."""
+    slots = sorted(live_slots)
+    if len(slots) < 2:
+        return None
+    loads = {s: float(shard_load.get(s, 0.0)) for s in slots}
+    peak = max(loads.values())
+    mean = sum(loads.values()) / len(slots)
+    if mean <= 0 or peak / mean < min_ratio:
+        return None
+    src = min(s for s in slots if loads[s] == peak)
+    dst = min(s for s in slots
+              if loads[s] == min(loads[s2] for s2 in slots if s2 != src)
+              and s != src)
+    hosted = sorted(t for t, s in routing.items() if s == src)
+    if len(hosted) < 2:
+        return None
+    verbs = table_verbs.get(src, {})
+    top = max(verbs.get(t, 0) for t in hosted)
+    tid = min(t for t in hosted if verbs.get(t, 0) == top)
+    return (tid, src, dst)
+
+
 def shard_shippers(nshards: int, old_members) -> Dict[int, int]:
     """Which LIVE old-view member ships shard i of the new view: round-
     robin over the old members (every member holds the full logical cut

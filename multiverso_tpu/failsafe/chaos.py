@@ -44,6 +44,18 @@ Spec grammar (comma-separated)::
     membership.join:P          same rehearsal for the admission path
                                (duplicate JOIN staging / shard-move
                                dedup)
+    policy.flap:P[@period]     policy plane (round 20): oscillate an
+                               alert verdict around its rule threshold
+                               at the policy's observation point —
+                               `period` breaching evaluations, then
+                               `period` healthy ones, repeating
+                               (default 1 = alternate every tick; any
+                               P > 0 arms the site). The regression
+                               this rehearses: alert flap must NOT
+                               amplify into action flap — sustain
+                               hysteresis + the install cooldown bound
+                               actions to at most one per cooldown
+                               window
     apply.delay:P[@delay_s]    engine window apply stalled by delay_s
                                BEFORE applying — a PERF fault, not a
                                correctness one: the verb stream stays
@@ -85,7 +97,7 @@ _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "verb.transient", "verb.failack",
           "serving.overload", "serving.delay",
           "membership.leave", "membership.join",
-          "apply.delay")
+          "apply.delay", "policy.flap")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -122,6 +134,11 @@ class ChaosInjector:
         self._rngs = {site: random.Random(
             (self.seed << 32) ^ zlib.crc32(site.encode()))
             for site in _SITES}
+        #: policy.flap consult counter: the oscillation is a pure
+        #: function of the call index (no rng draw — the site models a
+        #: gauge hovering AT a threshold, which is deterministic by
+        #: nature, not probabilistic)
+        self._flap_calls = 0
         # eager registration: an armed injector's sites show at zero in
         # MV_MetricsSnapshot() even before their first fault
         for site in self.spec:
@@ -197,6 +214,23 @@ class ChaosInjector:
         if self._fire("apply.delay"):
             return self.param("apply.delay")
         return 0.0
+
+    def policy_flap(self) -> Optional[bool]:
+        """Consulted once per policy evaluation: None when the site is
+        unarmed; else the injected alert verdict — True (breaching) for
+        ``period`` consecutive evaluations, then False (healthy) for
+        ``period``, repeating. A pure function of the call index (no
+        rng), so every run's flap schedule is identical and the
+        hysteresis/cooldown regression test is exact."""
+        prob, period = self.spec.get("policy.flap", (0.0, 1.0))
+        if prob <= 0.0:
+            return None
+        idx = self._flap_calls
+        self._flap_calls += 1
+        breach = (idx // max(1, int(period))) % 2 == 0
+        if breach:
+            metrics.counter("chaos.policy.flap").inc()
+        return breach
 
     def membership_fault(self, kind: str) -> bool:
         """Consulted once per elastic ``leave``/``join`` control op:

@@ -264,7 +264,12 @@ class _ApplyPool:
 
     def __init__(self, workers: int, name: str):
         self._q: MtQueue = MtQueue()
-        for i in range(max(1, workers)):
+        #: thread count this pool was built with — the adaptive-tuning
+        #: path (round 20 policy plane) compares it against the live
+        #: -mv_apply_workers value and rebuilds the pool between
+        #: windows when they differ
+        self.workers = max(1, workers)
+        for i in range(self.workers):
             threading.Thread(target=self._loop, daemon=True,
                              name=f"mv-apply-{name}-{i}").start()
 
@@ -388,10 +393,12 @@ class _ExchangeStage:
         self._srv = srv
         #: max exchanged-but-not-yet-applied items (-mv_pipeline_depth,
         #: default 2): bounds how far the exchange runs ahead (decoded
-        #: windows pin their blobs in memory). Read once per stage
-        #: life — a window stream never changes depth mid-flight, and
-        #: every rank's stage reads the same flag value at the same
-        #: stream position (creation).
+        #: windows pin their blobs in memory). Round 20: read through
+        #: the listener cache at EVERY gate, not once per stage life —
+        #: the policy plane tunes the flag live, and the cap is pacing
+        #: only (window CONTENT stays the exchanged/agreed prefix), so
+        #: ranks reading different values for a window or two cannot
+        #: diverge the stream; they just fence at different depths.
         self.depth_cap = max(1, _pipeline_depth_flag())
         self._in: MtQueue = MtQueue()
         self.out: MtQueue = MtQueue()
@@ -483,6 +490,10 @@ class _ExchangeStage:
         the stall is classified (the explicit fence's recorded cause,
         or ``depth`` when only the DEPTH cap holds it) and its seconds
         observed — this is the dataset behind raising overlap_pct."""
+        # live depth (round 20): one cached-dict read per gate, so a
+        # policy-plane -mv_pipeline_depth update takes effect at the
+        # NEXT window instead of never
+        self.depth_cap = max(1, _pipeline_depth_flag())
         depth_target = self._emitted - self.depth_cap + 1
         target = max(self._fence_at, depth_target)
         # advisory read (GIL-atomic int): only classifies; correctness
@@ -745,6 +756,16 @@ class Server(Actor):
         #: construction.
         self.apply_busy_s = 0.0
         self.xw_busy_s = 0.0
+        #: round 20 — policy-plane routing inputs, accumulated
+        #: UNCONDITIONALLY on the actor thread (plain dict int/float
+        #: adds; apply-pool jobs return private dicts that merge here,
+        #: so only the engine-shard domain ever writes these):
+        #: per-table verbs this stream processed, and per-table apply
+        #: seconds (multi-process windows). The shard_imbalance ->
+        #: routing-map decider picks the hottest table of the hottest
+        #: stream from exactly these tallies (rebalance.plan_routing).
+        self.table_verbs: Dict[int, int] = {}
+        self.table_apply_s: Dict[int, float] = {}
         self._t_binding_st = None
         self._t_pool_jobs = tmetrics.counter("engine.apply_pool.jobs")
         self._t_pool_inline = tmetrics.counter(
@@ -895,6 +916,11 @@ class Server(Actor):
             "apply_busy_s": round(self.apply_busy_s, 6),
             "xw_busy_s": round(self.xw_busy_s, 6),
             "window_verbs": self.mh_window_verbs,
+            # snapshot copies: the watchdog/policy samplers hold these
+            # across ticks while the actor keeps mutating the originals
+            "table_verbs": dict(self.table_verbs),
+            "table_apply_s": {t: round(v, 6)
+                              for t, v in self.table_apply_s.items()},
             "stage": None if st is None else {
                 "depth": st.depth(),
                 "pending_verbs": st.pending_verbs(),
@@ -1333,6 +1359,10 @@ class Server(Actor):
         for m in batch:
             if m.msg_type in (MsgType.Request_Add, MsgType.Request_Get):
                 segments[-1].append(m)
+                # round 20 — policy routing input (actor thread only)
+                if m.table_id >= 0:
+                    self.table_verbs[m.table_id] = (
+                        self.table_verbs.get(m.table_id, 0) + 1)
             else:
                 segments.append(m)       # barrier marker
                 segments.append([])
@@ -2101,7 +2131,12 @@ class Server(Actor):
             _delay = cz.apply_delay()
             if _delay > 0.0:
                 _time.sleep(_delay)
-        tbl = {} if self._phases_on() else None
+        # round 20 — policy routing inputs: always-on per-table tallies
+        # (one dict add per agreed position + two perf_counter calls
+        # per window op — inside the 2% blocking-round budget)
+        for _k, _tid in descs0:
+            self.table_verbs[_tid] = self.table_verbs.get(_tid, 0) + 1
+        tbl = {}
         # group per table: Add positions, and Get positions split into
         # the before/after segment around the table's one add-run
         add_pos: Dict[int, list] = {}
@@ -2126,7 +2161,10 @@ class Server(Actor):
             self._mh_apply_parallel(ops, parts_at, verbs, my_rank, tbl)
         else:
             self._mh_run_ops(ops, parts_at, verbs, my_rank, tbl)
-        if tbl:
+        for (_tid, _k), _v in tbl.items():
+            self.table_apply_s[_tid] = (self.table_apply_s.get(_tid, 0.0)
+                                        + _v)
+        if tbl and self._phases_on():
             self._ph_tables(tbl, seq, multihost.membership_epoch())
 
     @staticmethod
@@ -2180,6 +2218,21 @@ class Server(Actor):
                 tbl[k] = tbl.get(k, 0.0) + _time.perf_counter() - _tt
         return tbl
 
+    def _ensure_apply_pool(self) -> "_ApplyPool":
+        """The apply-stage worker pool at the LIVE ``-mv_apply_workers``
+        size (round 20): the policy plane tunes the flag at a fenced
+        cut, and the next parallel window rebuilds the pool when the
+        size changed. Safe between windows on the actor thread — every
+        prior window's jobs were waited for, so the retired pool's
+        queue is empty when it closes; its daemon workers just exit."""
+        want = max(2, min(_apply_workers_flag(), 16))
+        pool = self._apply_pool
+        if pool is None or pool.workers != want:
+            if pool is not None:
+                pool.shutdown()
+            pool = self._apply_pool = _ApplyPool(want, self.name)
+        return pool
+
     def _mh_apply_parallel(self, ops, parts_at, verbs, my_rank: int,
                            tbl) -> None:
         """Round 12 — the parallel apply: the shared op list regrouped
@@ -2192,10 +2245,7 @@ class Server(Actor):
         jobs: Dict[int, list] = {}
         for op in ops:
             jobs.setdefault(op[1], []).append(op)
-        pool = self._apply_pool
-        if pool is None:
-            pool = self._apply_pool = _ApplyPool(
-                max(2, min(_apply_workers_flag(), 16)), self.name)
+        pool = self._ensure_apply_pool()
         job_lists = list(jobs.values())
         # the LAST job runs inline on the actor thread: one fewer
         # handoff, and the pool only ever carries n_tables - 1 jobs
@@ -2662,7 +2712,11 @@ class ShardedServer(Server):
     """Round 12 — the sharded engine: this actor IS shard 0 and the
     router. Verbs route to a shard by ``table_id % shard_slots`` (rank-
     agreed arithmetic, so SPMD ranks agree on routing without
-    negotiation); each shard owns an independent window stream with
+    negotiation) unless a ROUTING-MAP override is installed (round 20:
+    the policy plane re-routes hot tables live via
+    :meth:`install_routing`, at a fenced cross-stream cut so the change
+    lands at one agreed position on every rank); each shard owns an
+    independent window stream with
     its own exchange stage, SEQ counter and wire channel, so different
     tables' windows form, exchange and apply CONCURRENTLY — the fix
     for the flat ``host_scaling_Melem_s`` wall (ONE actor serialized
@@ -2686,23 +2740,136 @@ class ShardedServer(Server):
               f"ShardedServer needs >= 2 shard slots, got {shard_cap}")
         self._shard_cap = shard_cap
         self._subs: Dict[int, _EngineShard] = {}
+        #: round 20 — the table->shard ROUTING MAP: overrides on top of
+        #: the ``table_id % shard_cap`` default. Installed ONLY inside
+        #: a cross-stream cut payload (policy plane install_routing:
+        #: every stream fenced, every pre-cut verb applied), so routing
+        #: for a table changes at ONE agreed multi-stream position; in
+        #: SPMD worlds the installing cut is issued at the same
+        #: lockstep app position on every rank (the MV_PolicySync
+        #: discipline), keeping the per-shard verb streams rank-agreed.
+        self._routing: Dict[int, int] = {}
+        #: routing-map installs applied (the /actions + drill probe)
+        self.routing_installs = 0
+        #: the ROUTING FREEZE (round 20 review fix): route-decision +
+        #: mailbox-push must be atomic against cut-fence enqueue, or a
+        #: verb that computed its slot under the OLD map could land
+        #: BEHIND the fence in the old stream while the cut swaps the
+        #: map — splitting one table's verbs across two concurrently
+        #: draining streams (per-table serial order broken). Cuts
+        #: close the gate (under _route_lock) before enqueueing their
+        #: fences and reopen it when the LAST in-flight cut releases;
+        #: verb pushes spin on the gate (bounded waits) and route
+        #: under the same lock. The open-gate fast path costs one
+        #: Event check + one uncontended lock per push.
+        self._route_lock = threading.Lock()
+        self._route_open = threading.Event()
+        self._route_open.set()
+        self._cuts_inflight = 0
         #: cross-stream cuts processed (the sharded sibling of
         #: window_barrier_splits, which counts shard 0's stream only)
         self.cut_count = 0
         for mt in _CUT_TYPES:
             self.RegisterHandler(mt, self._wrap_cut(self._handlers[mt]))
 
+    def _slot_for(self, table_id: int) -> int:
+        """Effective shard slot of ``table_id``: the routing-map
+        override when one is installed, else the rank-agreed modulo
+        default. One dict get on the verb path."""
+        if table_id < 0:
+            return 0
+        slot = self._routing.get(table_id)
+        return (table_id % self._shard_cap) if slot is None else slot
+
+    def install_routing(self, mapping: Dict[int, int]) -> list:
+        """Install table->shard overrides. MUST run as a cross-stream
+        cut payload (Zoo.CallOnEngine): with every stream fenced, every
+        verb admitted before the cut has applied under the OLD map and
+        none after, so a table's window stream migrates between shard
+        channels at one consistent position. Targets are restricted to
+        LIVE slots (0 or a spawned sub-shard) and known tables; the
+        returned ``[(table_id, prev_slot, new_slot), ...]`` names what
+        actually changed (the policy plane's revert input). Idempotent:
+        re-installing the current slot is a no-op entry."""
+        live = {0} | set(self._subs)
+        applied = []
+        for tid, slot in sorted(mapping.items()):
+            tid, slot = int(tid), int(slot)
+            CHECK(0 <= tid < len(self.store_),
+                  f"install_routing: unknown table {tid}")
+            CHECK(slot in live,
+                  f"install_routing: slot {slot} not live (live slots "
+                  f"{sorted(live)})")
+            prev = self._slot_for(tid)
+            if prev == slot:
+                continue
+            self._routing[tid] = slot
+            applied.append((tid, prev, slot))
+        if applied:
+            self.routing_installs += 1
+        return applied
+
+    def routing_report(self) -> dict:
+        """Effective routing of every registered table + live slots
+        (LOCAL probe — the policy decider's and /actions' input)."""
+        return {"shard_cap": self._shard_cap,
+                "live_slots": sorted({0} | set(self._subs)),
+                "installs": self.routing_installs,
+                "overrides": dict(self._routing),
+                "routing": {tid: self._slot_for(tid)
+                            for tid in range(len(self.store_))}}
+
     def _wrap_cut(self, base):
         def entry(msg: Message) -> None:
             fence = getattr(msg, "_mv_cut", None)
             if fence is None:       # no subs were live at routing time
                 return base(msg)
-            fence.arrive_head(list(self._subs.values()))
             try:
+                fence.arrive_head(list(self._subs.values()))
                 base(msg)
             finally:
+                # release + reopen even when the rendezvous aborted (a
+                # dead sub / expired deadline): a stuck freeze would
+                # park every verb push forever
                 fence.release()
+                self._cut_done()
         return entry
+
+    def _cut_done(self) -> None:
+        """One in-flight cut finished: reopen the routing gate when it
+        was the last (cuts may overlap — publish racing a policy
+        install — and the gate must stay closed until ALL fences are
+        resolved)."""
+        with self._route_lock:
+            self._cuts_inflight -= 1
+            if self._cuts_inflight <= 0:
+                self._cuts_inflight = 0
+                self._route_open.set()
+
+    def _route_push(self, msg: Message) -> None:
+        """Route one verb and push it to its stream, atomically
+        against cut-fence enqueue (see the routing-freeze note in
+        __init__). The open-gate path is one Event check + one
+        uncontended lock."""
+        while True:
+            opened = self._route_open.wait(0.5)
+            if not opened and self._poison is not None:
+                # router died mid-cut and the gate will never reopen:
+                # fall through — the push surfaces the typed ActorDied
+                # instead of spinning forever
+                pass
+            elif not opened:
+                continue
+            with self._route_lock:
+                if (self._route_open.is_set()
+                        or self._poison is not None):
+                    sub = self._subs.get(self._slot_for(msg.table_id))
+                    if sub is not None:
+                        # mv-lint: ok(lock-order): sub is an _EngineShard whose Receive IS Actor.Receive (mailbox push, no _route_lock) — the by-name edge to ShardedServer.Receive cannot execute (a sub is never the router)
+                        sub.Receive(msg)    # chaos/poison apply there
+                    else:
+                        super().Receive(msg)
+                    return
 
     def RegisterTable(self, server_table) -> int:
         table_id = super().RegisterTable(server_table)
@@ -2748,16 +2915,28 @@ class ShardedServer(Server):
         the same rank-agreed arithmetic the router uses."""
         if not self._subs:
             return super().receive_multi(members)
-        groups: Dict[int, list] = {}
-        for m in members:
-            slot = m.table_id % self._shard_cap if m.table_id >= 0 else 0
-            groups.setdefault(slot, []).append(m)
-        for slot, ms in groups.items():
-            sub = self._subs.get(slot)
-            if sub is not None:
-                sub.receive_multi(ms)
-            else:
-                Server.receive_multi(self, ms)
+        # route + push under the routing-freeze gate, like every other
+        # verb path (the slot decisions and the pushes must be one
+        # atomic step against a cut's fence enqueue)
+        while True:
+            opened = self._route_open.wait(0.5)
+            if not opened and self._poison is None:
+                continue
+            with self._route_lock:
+                if (not self._route_open.is_set()
+                        and self._poison is None):
+                    continue
+                groups: Dict[int, list] = {}
+                for m in members:
+                    groups.setdefault(self._slot_for(m.table_id),
+                                      []).append(m)
+                for slot, ms in groups.items():
+                    sub = self._subs.get(slot)
+                    if sub is not None:
+                        sub.receive_multi(ms)
+                    else:
+                        Server.receive_multi(self, ms)
+                return
 
     def Receive(self, msg: Message) -> None:
         if msg.msg_type is MsgType.Request_MultiVerb:
@@ -2767,13 +2946,7 @@ class ShardedServer(Server):
             self.receive_multi(msg.payload["members"])
             return
         if msg.msg_type in (MsgType.Request_Get, MsgType.Request_Add):
-            slot = (msg.table_id % self._shard_cap
-                    if msg.table_id >= 0 else 0)
-            sub = self._subs.get(slot)
-            if sub is not None:
-                sub.Receive(msg)    # chaos/poison apply there
-            else:
-                super().Receive(msg)
+            self._route_push(msg)
             return
         subs = list(self._subs.values())
         if not subs or msg.msg_type not in _CUT_TYPES:
@@ -2783,20 +2956,27 @@ class ShardedServer(Server):
         # the head message to shard 0. Per-shard mailbox order is the
         # caller's program order restricted to that shard, so SPMD
         # ranks place every fence at the same per-shard stream
-        # position — the cut is one agreed multi-stream position.
-        self.cut_count += 1
+        # position — the cut is one agreed multi-stream position. The
+        # fences enqueue with the ROUTING GATE closed: a concurrent
+        # verb either pushed before them (ahead of the fence — applied
+        # under the pre-cut routing before any payload runs) or routes
+        # after the cut fully releases (under whatever map the payload
+        # installed) — never with an old decision behind the fence.
+        self.cut_count += 1  # mv-lint: ok(cross-domain-state): diagnostics-only tally; worker cuts and the policy thread's installs may race the GIL int add and at worst under-count a probe nothing gates on
         fence = _CutFence(self, len(subs))
-        for sub in subs:
-            sub.Receive(Message(msg_type=msg.msg_type,
-                                payload={"_mv_fence": fence}))
-        msg._mv_cut = fence
-        super().Receive(msg)
+        with self._route_lock:
+            self._cuts_inflight += 1
+            self._route_open.clear()
+            for sub in subs:
+                sub.Receive(Message(msg_type=msg.msg_type,
+                                    payload={"_mv_fence": fence}))
+            msg._mv_cut = fence
+            super().Receive(msg)
 
     # -- facade points -------------------------------------------------------
 
     def epoch_for_table(self, table_id: int) -> int:
-        slot = table_id % self._shard_cap if table_id >= 0 else 0
-        sub = self._subs.get(slot)
+        sub = self._subs.get(self._slot_for(table_id))
         return (sub or self).window_epoch
 
     def cut_epoch(self) -> int:

@@ -24,6 +24,10 @@ multi-world processes) starts one daemon thread at MV_Init running a
   rule's hysteresis counters; says "off" while ``-mv_watchdog_s`` is
   unarmed. Active alerts also degrade ``/healthz`` to a distinct
   ``warn`` status — still 200 (503 stays death-only).
+* ``GET /actions`` — the policy plane's action report (round 20,
+  multiverso_tpu/policy/): guard settings, install/revert/drain
+  counts, actions under revert watch, and the bounded action history;
+  says "off" while ``-mv_policy`` is unarmed.
 * ``GET /memory`` — the process byte ledger (round 13,
   telemetry/accounting.py): per-table device/mirror/host placement,
   per-version snapshot retention, flight/dedup/buffer estimates, shm
@@ -215,6 +219,16 @@ def health_report() -> dict:
             out["replica"] = rrep
     except Exception:           # replica plane is optional
         pass
+    # round 20 — policy plane: one line naming whether the runtime is
+    # self-driving (armed kill switch), how often it acted, and the
+    # last action. Local engine state only.
+    try:
+        from multiverso_tpu import policy as tpolicy
+        pline = tpolicy.status_line()
+        if pline is not None:
+            out["policy"] = pline
+    except Exception:           # policy plane torn down mid-scrape
+        pass
     rec, drop = flight.stats()
     out["flight"] = {"recorded": rec, "dropped": drop,
                      "enabled": flight.enabled()}
@@ -344,9 +358,15 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(accounting.memory_report(),
                                            indent=1, sort_keys=True),
                            "application/json")
+            elif path == "/actions":
+                from multiverso_tpu import policy as tpolicy
+                self._send(200, json.dumps(tpolicy.actions_report(),
+                                           indent=1, sort_keys=True),
+                           "application/json")
             else:
                 self._send(404, "unknown path (know /metrics /healthz "
-                                "/flight /perf /alerts /memory)\n",
+                                "/flight /perf /alerts /actions "
+                                "/memory)\n",
                            "text/plain")
         except Exception as exc:    # never kill the handler thread
             try:
@@ -371,8 +391,8 @@ class OpsServer:
     def start(self) -> None:
         self._thread.start()
         Log.Info("ops endpoint serving on 127.0.0.1:%d "
-                 "(/metrics /healthz /flight /perf /alerts /memory)",
-                 self.port)
+                 "(/metrics /healthz /flight /perf /alerts /actions "
+                 "/memory)", self.port)
 
     def stop(self, join_s: float = 5.0) -> None:
         """Shut down + join BOUNDED (Zoo.Stop must never hang on a

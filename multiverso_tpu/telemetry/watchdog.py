@@ -94,6 +94,28 @@ HOLD = object()
 _HISTORY = 32
 
 
+def stream_pos() -> tuple:
+    """Best-effort ``(mepoch, head-stream exchange SEQ)`` stamp for
+    alert/action flight events (round 20): forensics aligns a policy
+    action with its triggering alert by exactly this pair, the same
+    (mepoch, seq) keying the membership events ride. ``(0, -1)`` when
+    no engine/world is live (synthetic-sample unit tests)."""
+    mep, seq = 0, -1
+    try:
+        from multiverso_tpu.parallel import multihost
+        mep = int(multihost.membership_epoch())
+    except Exception:
+        pass
+    try:
+        from multiverso_tpu.zoo import Zoo
+        eng = Zoo.Get().server_engine
+        if eng is not None:
+            seq = int(eng._mh_seq)
+    except Exception:
+        pass
+    return mep, seq
+
+
 class Rule:
     """One typed online alert rule. Subclasses implement
     :meth:`check` over the watchdog's sample history (newest last) and
@@ -511,6 +533,13 @@ class Watchdog:
                      "since": None, "detail": None}
             for r in self.rules}
         self.ticks = 0
+        #: round 20 — the alert->action hand-off: tick listeners called
+        #: AFTER every evaluate (outside the lock) with one record
+        #: ``{"ticks", "sample", "fired", "active"}``. The policy plane
+        #: registers here; listeners must be cheap and never raise (a
+        #: listener enqueues for its own thread — the watchdog tick
+        #: thread does no policy work itself).
+        self._tick_listeners: List = []
         self._t_ticks = tmetrics.counter("watchdog.ticks")
         # EAGER registration (the PR 6 rule): the whole alert family
         # scrapes at zero from the first /metrics read
@@ -558,11 +587,26 @@ class Watchdog:
                     st["active"] = True
                     st["since"] = sample.get("t", time.perf_counter())
                     tmetrics.counter(f"alert.{rule.name}").inc()
-                    tflight.record(f"alert.{rule.name}",
+                    # (mepoch, seq) stamped so the policy plane's
+                    # action events align with their triggering alert
+                    # in forensics (round 20)
+                    mep, seq = stream_pos()
+                    tflight.record(f"alert.{rule.name}", seq=seq,
+                                   mepoch=mep,
                                    detail=str(verdict)[:200])
                     Log.Info("[watchdog] ALERT %s: %s", rule.name,
                              verdict)
                     fired.append(rule.name)
+            active = [name for name, st in self._state.items()
+                      if st["active"]]
+            ticks = self.ticks
+            listeners = list(self._tick_listeners)
+        for fn in listeners:        # outside the lock: a listener may
+            try:                    # itself read active_alerts()
+                fn({"ticks": ticks, "sample": sample, "fired": fired,
+                    "active": active})
+            except Exception as exc:    # a buggy listener must not
+                Log.Error("watchdog tick listener failed: %r", exc)
         return fired
 
     def tick(self) -> List[str]:
@@ -570,6 +614,14 @@ class Watchdog:
         evaluate the rules over a fresh sample."""
         refresh_saturation_gauges()
         return self.evaluate(collect_sample())
+
+    def add_tick_listener(self, fn) -> None:
+        """Register an alert->action hand-off listener (round 20 —
+        the policy plane's intake). Called after every evaluate with
+        ``{"ticks", "sample", "fired", "active"}``; must be cheap and
+        never raise."""
+        with self._lock:
+            self._tick_listeners.append(fn)
 
     # -- state surfaces -----------------------------------------------------
 

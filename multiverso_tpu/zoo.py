@@ -39,6 +39,7 @@ from multiverso_tpu.node import ROLE_NAMES, Node, Role
 # silently dropped.
 import multiverso_tpu.elastic  # noqa: F401
 import multiverso_tpu.failsafe  # noqa: F401
+import multiverso_tpu.policy  # noqa: F401
 import multiverso_tpu.replica  # noqa: F401
 import multiverso_tpu.serving  # noqa: F401
 import multiverso_tpu.sync.server  # noqa: F401
@@ -147,6 +148,11 @@ class Zoo:
         # the fan-out thread, every rank reads one cached flag
         from multiverso_tpu import replica as _replica
         _replica.start_plane(self)
+        # policy plane LAST (round 20): it needs the watchdog's tick
+        # listener hook and — multi-process — the elastic coordinator
+        # endpoint (or its own -mv_policy_addr authority) already up
+        from multiverso_tpu import policy as _policy
+        _policy.start_plane(self)
         self.started = True
         Log.Debug("Zoo started: %d servers (mesh devices), %d workers, "
                   "mode=%s", self.num_servers, self.num_workers,
@@ -167,6 +173,10 @@ class Zoo:
         stop_reporter()
         from multiverso_tpu.telemetry.ops import stop_ops
         stop_ops()
+        # policy plane down BEFORE the watchdog that feeds it (no tick
+        # may land on a dead engine) and before the engine it cuts
+        from multiverso_tpu import policy as _policy
+        _policy.shutdown_plane()
         # watchdog down with the other samplers and BOUNDED (its join
         # rides failsafe.deadline.bounded): a tick thread probing the
         # engine must not outlive it

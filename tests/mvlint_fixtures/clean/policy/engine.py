@@ -1,0 +1,31 @@
+"""Fixture twin of the policy engine: _run/step are restricted roots
+(never-collective) and the policy domain is device- and blocking-
+restricted; the spawn site mirrors the INVENTORY entry."""
+
+import threading
+
+
+class PolicyEngine:
+    def __init__(self):
+        self._ticks = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def on_watchdog_tick(self, rec):
+        self._ticks.append(rec)
+        self._wake.set()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._wake.wait(0.2)
+            self._wake.clear()
+            while self._ticks:
+                self.step(self._ticks.pop(0))
+
+    def step(self, rec):
+        return [k for k in rec.get("active", ())]

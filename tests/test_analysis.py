@@ -344,6 +344,23 @@ class TestFixtureCatches:
                     if f.rule == "never-collective"
                     and f.path.startswith("replica/")]
 
+    def test_policy_fixture_is_gated_from_day_one(self, results):
+        """Round 20: the policy plane's thread is inventoried and its
+        domain is blocking-restricted — the seeded UNBOUNDED wait in
+        the bad twin's evaluation loop (a parked actuator is a silent
+        dead-man switch) is a blocking-domain finding, while the clean
+        twin (bounded wake wait, claimed spawn site, collective-free
+        roots) passes every checker."""
+        bad_res, clean_res = results
+        hits = [f for f in bad_res.findings
+                if f.rule == "blocking-domain"
+                and f.path == "policy/engine.py"]
+        assert hits and hits[0].line == 26, \
+            [f.render() for f in bad_res.findings]
+        assert not [f for f in clean_res.findings
+                    if f.path.startswith("policy/")], \
+            [f.render() for f in clean_res.findings]
+
     def test_spmd_catches_all_five_guard_spellings(self, results):
         """Lexical guard (9), guard-clause early return (16, and the
         Get trailing it at 17), short-circuit boolean chain (21),

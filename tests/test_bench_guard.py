@@ -78,6 +78,14 @@ GUARDED_CEIL = {
     "replica_delta_vs_full_pct": 2.0,
 }
 
+#: metrics that must read EXACTLY ZERO in the latest artifact (round
+#: 20 — the policy plane's zero-false-positive floor: a clean bench
+#: world with the self-driving loop fully armed fires no actions).
+#: Checked against the artifact alone whenever present; --update-guard
+#: additionally pins it at 0 in the committed guard via the
+#: ceiling-ratchet (a value can never rise past an earned 0).
+GUARDED_ZERO = ("policy_actions_fired",)
+
 
 def _load(path):
     with open(path) as f:
@@ -120,6 +128,12 @@ def test_bench_no_regression_vs_guard():
         if cur > ceil * base:
             failures.append(f"{metric}: {cur} > {ceil}x the guard's "
                             f"{base} (latency regression)")
+    for metric in GUARDED_ZERO:
+        cur = latest.get(metric)
+        if cur is not None and cur != 0:
+            failures.append(
+                f"{metric}: {cur} != 0 — the policy plane acted on a "
+                f"CLEAN bench world (false-positive actions)")
     assert not failures, (
         "bench regression vs committed guard (docs/BENCH_GUARD.json):\n"
         + "\n".join(failures)

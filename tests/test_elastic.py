@@ -222,6 +222,152 @@ class TestCoordinator:
         finally:
             coord.stop()
 
+    def test_policy_put_is_idempotent_by_epoch_and_action_id(self):
+        """Round 20 control-op audit: a duplicate-delivered policy
+        action (two ranks proposing one content-derived correction, a
+        chaos retransmit) stages ONCE keyed by (epoch, action id) —
+        and the seen-set survives the pull that consumed it, so a late
+        re-delivery of an installed action cannot re-stage it."""
+        coord, (c0, c1) = self._pair()
+        try:
+            act = {"id": "route:t0:s0>s1:g0", "kind": "route",
+                   "rule": "shard_imbalance", "table": 0, "src": 0,
+                   "dst": 1, "conflict": "route:t0"}
+            r1 = c0.call("policy_put", epoch=0, action=act)
+            r2 = c1.call("policy_put", epoch=0, action=act)  # rank dup
+            assert (r1["dup"], r2["dup"]) == (False, True)
+            assert r2["staged"] == 1
+            assert coord._op_state({})["policy_dedup_hits"] == 1
+            # the pull rendezvous answers both members the SAME list
+            out = {}
+
+            def pull(c, who):
+                out[who] = c.call("policy_pull", world=2, timeout=10.0)
+
+            t = threading.Thread(target=pull, args=(c1, 1))
+            t.start()
+            pull(c0, 0)
+            t.join(10)
+            assert out[0]["actions"] == out[1]["actions"]
+            assert [a["id"] for a in out[0]["actions"]] == [act["id"]]
+            # post-pull re-delivery: STILL a no-op (the installed
+            # action must never re-stage)
+            r3 = c0.call("policy_put", epoch=0, action=act)
+            assert r3["dup"] is True and r3["staged"] == 0
+            # ...but the same content under a NEW epoch is a new key
+            r4 = c0.call("policy_put", epoch=1, action=act)
+            assert r4["dup"] is False
+        finally:
+            coord.stop()
+
+    def test_policy_pull_timeout_ghost_withdrawal_and_kill_veto(self):
+        """Round 20 review fixes: (a) a TIMED-OUT pull withdraws its
+        rendezvous arrival and rolls its generation back — the staged
+        queue is never consumed into an answer the ghost can't read,
+        and the retry re-joins the generation its peers expect; (b) the
+        answer carries the AGREED kill verdict — one disarmed rank
+        vetoes the batch for every rank."""
+        from multiverso_tpu.failsafe.errors import TransientError
+        coord, (c0, c1) = self._pair()
+        try:
+            act = {"id": "tune:mv_pipeline_depth:2>3:g0",
+                   "kind": "tune", "rule": "mailbox_backlog",
+                   "flag": "mv_pipeline_depth", "frm": 2, "to": 3,
+                   "conflict": "tune:mv_pipeline_depth"}
+            c0.call("policy_put", epoch=0, action=act)
+            with pytest.raises(TransientError):
+                c0.call("policy_pull", world=2, timeout=0.3)
+            # the ghost neither consumed the queue nor left an arrival
+            assert [a for _k, a in coord._policy_staged] == [act]
+            assert coord._ppull_arrived == {}
+            assert coord._ppull_counts.get(0, 0) == 0   # rolled back
+            # retry joins gen 1 with its peer; rank 1 is DISARMED —
+            # both read the identical list with acting=False
+            out = {}
+
+            def pull(c, who, armed):
+                out[who] = c.call("policy_pull", world=2, armed=armed,
+                                  timeout=10.0)
+
+            t = threading.Thread(target=pull, args=(c1, 1, False))
+            t.start()
+            pull(c0, 0, True)
+            t.join(10)
+            assert out[0]["actions"] == out[1]["actions"]
+            assert [a["id"] for a in out[0]["actions"]] == [act["id"]]
+            assert (out[0]["acting"], out[1]["acting"]) == (False,
+                                                            False)
+            # the veto un-saw the batch's dedup keys: the same
+            # correction may re-stage once the world re-arms
+            assert c0.call("policy_put", epoch=0,
+                           action=act)["dup"] is False
+        finally:
+            coord.stop()
+
+    def test_epoch_install_resets_policy_rendezvous_era(self):
+        """Round 20 review fix: committing an epoch clears the policy
+        pull generations and the staged queue — a re-admitted member
+        rendezvouses with the survivors from a common zero instead of
+        timing out forever against their advanced counters, and
+        stale-view actions never install post-transition (their dedup
+        keys survive, so retransmits stay no-ops)."""
+        coord, (c0, c1) = self._pair()
+        try:
+            # advance rank 0's pull generation past rank 1's
+            for _ in range(3):
+                c0.call("policy_pull", world=1, timeout=5.0)
+            assert coord._ppull_counts[0] == 3
+            stale = {"id": "route:t0:s0>s1:g9", "kind": "route",
+                     "rule": "shard_imbalance", "table": 0, "src": 0,
+                     "dst": 1, "conflict": "route:t0"}
+            c0.call("policy_put", epoch=0, action=stale)
+            # drain member 1 through the real transition machinery
+            c1.call("leave")
+            out = {}
+
+            def arrive(c, who):
+                out[who] = c.call("sync", timeout=10.0)
+
+            t = threading.Thread(target=arrive, args=(c1, 1))
+            t.start()
+            arrive(c0, 0)
+            t.join(10)
+            tr = out[0]["transition"]
+            assert tr["members"] == [0]
+            # the new view (member 0 alone) commits the epoch — the
+            # coordinator state machine needs no cut rendezvous here
+            # (that is the engines' fence, not the authority's)
+            c0.call("commit", epoch=tr["epoch"], timeout=10.0)
+            # the era reset: counters cleared, stale action dropped,
+            # its dedup key retained (a retransmit stays a no-op)
+            assert coord._ppull_counts == {}
+            assert coord._policy_staged == []
+            assert c0.call("policy_put", epoch=0,
+                           action=stale)["dup"] is True
+        finally:
+            coord.stop()
+
+    def test_policy_drain_request_is_deduped_like_leave_staging(self):
+        """Round 20 control-op audit, drain leg: a duplicate drain
+        request is a no-op by (epoch, action id) — the policy twin of
+        the duplicate-LEAVE staging the membership chaos sites already
+        rehearse (pending_leave is a set; both absorb re-delivery)."""
+        coord, (c0, c1) = self._pair()
+        try:
+            drain = {"id": "drain:r1:g0", "kind": "drain",
+                     "rule": "straggler", "rank": 1,
+                     "conflict": "drain"}
+            r1 = c1.call("policy_put", epoch=0, action=drain)
+            r2 = c1.call("policy_put", epoch=0, action=drain)  # retx
+            assert (r1["dup"], r2["dup"]) == (False, True)
+            assert [a for _k, a in coord._policy_staged] == [drain]
+            # the elastic sibling: duplicate leave staging stays a set
+            c1.call("leave")
+            c1.call("leave")
+            assert coord._pending_leave == {1}
+        finally:
+            coord.stop()
+
     def test_lease_expiry_stages_death_transition(self):
         coord, (c0, c1) = self._pair(lease_s=0.3)
         try:
