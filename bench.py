@@ -1208,6 +1208,74 @@ def bench_seal(np, rng):
     return out
 
 
+def bench_compress(np, rng):
+    """-> codec-layer metrics (round 21, tagged compression): lossy
+    delta fan-out bytes at the replica bench's 1%-churn shape, the
+    seal bench's representative window under int8 Add-value packing,
+    and the int8 row-quantizer's raw encode throughput. All
+    in-process (pure codec math — no subprocesses, no device)."""
+    import time
+
+    from multiverso_tpu.parallel import compress, wire
+    from multiverso_tpu.replica import delta as rdelta
+    from multiverso_tpu.serving.snapshot import MatrixSnapshot, Snapshot
+    from multiverso_tpu.utils.configure import SetCMDFlag
+
+    out = {}
+    try:
+        # 1%-churn replica delta: compressed vs plain bytes (the >=3x
+        # acceptance bar lives here as fanout_bytes_pct <= 33)
+        state = rng.standard_normal(
+            (REP_ROWS, REP_COLS)).astype(np.float32)
+        ids = np.sort(rng.choice(REP_ROWS, REP_CHURN,
+                                 replace=False)).astype(np.int64)
+        snap = Snapshot(version=1, created_wall=0.0, window_epoch=0,
+                        tables={0: MatrixSnapshot.host(state)})
+        descs = {0: {"kind": "rows", "ids": ids}}
+        SetCMDFlag("mv_compress", False)
+        plain = rdelta.encode_delta(snap, 0, descs)
+        SetCMDFlag("mv_compress", True)
+        SetCMDFlag("mv_compress_lossy", "0")
+        packed = rdelta.encode_delta(snap, 0, descs)
+        out["compress_fanout_bytes_pct"] = round(
+            100.0 * len(packed) / len(plain), 1)
+        out["compress_fanout_shrink_x"] = round(
+            len(plain) / len(packed), 2)
+
+        # the seal bench's representative ~3MiB window with int8
+        # Add-value packing (deterministic size: header+scales+codes)
+        SetCMDFlag("mv_compress_lossy", "all")
+        n_cols = 64
+        rows = (3 << 20) // 12 // (4 * n_cols)
+        verbs = []
+        for i in range(12):
+            vids = np.arange(rows, dtype=np.int32)
+            vals = rng.standard_normal((rows, n_cols)).astype(np.float32)
+            verbs.append(("A", i % 4, compress.pack_window_values(
+                i % 4, {"row_ids": vids, "values": vals})))
+        out["compress_bytes_per_window"] = len(wire.encode_window(verbs))
+
+        # raw int8 row-quantizer throughput (input-side GB/s)
+        big = rng.standard_normal((64_000, 128)).astype(np.float32)
+        compress.encode_int8_rows(big)          # warm
+        reps = 8
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            compress.encode_int8_rows(big)
+        out["compress_int8_GB_s"] = round(
+            big.nbytes * reps / (time.perf_counter() - t0) / 1e9, 2)
+        out["compress_config"] = (
+            f"fanout = {REP_ROWS}x{REP_COLS} f32 delta at "
+            f"{100 * REP_CHURN / REP_ROWS:.0f}% churn, int8 rows + "
+            f"RLE ids vs plain; window = the seal bench's 12-verb "
+            f"~3MiB shape with -mv_compress_lossy=all; int8 GB/s on "
+            f"a {big.nbytes >> 20}MB f32 matrix (input side)")
+    finally:
+        SetCMDFlag("mv_compress", False)
+        SetCMDFlag("mv_compress_lossy", "")
+    return out
+
+
 def bench_verb_throughput(np, rng):
     """-> batched-verb metrics: the blocking single-verb wall vs
     MultiAdd/MultiGet at batch 8/32/128 (single-process world — the
@@ -1710,6 +1778,7 @@ def main() -> int:
     section(bench_wordembedding, fill_we)
     section(bench_serving, fill_serving)
     section(bench_seal, fill_host)
+    section(bench_compress, fill_host)
     section(bench_verb_throughput, fill_host)
     section(bench_we_app, fill_we_app)
     section(bench_lr_app, fill_lr_app)
@@ -2703,7 +2772,12 @@ GUARD_JSON_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _GUARD_CEIL_KEYS = ("serving_lookup_p99_ms", "serving_lookup_2proc_p99_ms",
                     "elastic_rebalance_pause_ms",
                     "replica_delta_vs_full_pct",
-                    "policy_actions_fired")
+                    "policy_actions_fired",
+                    # round 21 — codec-layer byte ceilings: the lossy
+                    # fan-out share and the packed window size only
+                    # ever ratchet DOWN
+                    "compress_fanout_bytes_pct",
+                    "compress_bytes_per_window")
 
 
 def update_guard(json_path: str = FULL_JSON_PATH) -> int:
@@ -2737,7 +2811,9 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
             "replica_lookup_qps", "replica_2rep_aggregate_qps",
             "replica_delta_vs_full_pct",
             "seal_crc32c_GB_s", "verb_batch_throughput",
-            "policy_actions_fired")
+            "policy_actions_fired",
+            "compress_fanout_bytes_pct", "compress_bytes_per_window",
+            "compress_int8_GB_s")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key

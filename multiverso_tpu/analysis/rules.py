@@ -166,6 +166,14 @@ class HotPathFlagCacheChecker(Checker):
          "worker verb paths / server applies"),
         (r"^telemetry/flight\.py$", r"^record$",
          "flight record rides every verb"),
+        # round 21 — the codec layer's enable/opt-in predicates and
+        # pack/unpack entry points ride every replica bundle, window
+        # exchange, and serve frame
+        (r"^parallel/compress\.py$",
+         r"^(?:enabled|lossy_opted|config_token|pack_payload|"
+         r"unpack_payload|pack_window_values|materialize_window|"
+         r"pack_serve_rows|decode_array)$",
+         "compression codecs ride every hot byte path"),
     ]
 
     def check(self, pkg: PackageIndex) -> List[Finding]:

@@ -31,6 +31,10 @@ full table)::
     l  list: u32 count + values (tuples pickle — identity must survive)
     t  bool (u8)    i  int (i64)    f  float (f64)
     s  str / b  bytes: i64 length + raw
+    q  COMPRESSED ndarray — i64 envelope length + a parallel/compress.py
+       tagged codec envelope; decode is EAGER (the consumer gets the
+       reconstructed ndarray, and an unknown codec tag fails loudly
+       inside the envelope — the seal's "newer writer" posture)
     p  pickle fallback (exotic tail; extensions run BEFORE this)
 """
 
@@ -43,6 +47,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from multiverso_tpu.parallel import seal
+from multiverso_tpu.parallel.compress import CompressedArray, decode_array
 
 _U8 = struct.Struct("<B")
 _U32 = struct.Struct("<I")
@@ -155,6 +160,10 @@ def encode_value(parts: list, v, ext: Optional[Extension] = None) -> None:
             parts.append(memoryview(v).cast("B"))
     elif isinstance(v, DeferredArray):
         _encode_array_header(parts, b"v", v.dtype, v.shape)
+    elif isinstance(v, CompressedArray):
+        parts.append(b"q")
+        parts.append(_I64.pack(len(v.blob)))
+        parts.append(v.blob)
     elif ext is not None and ext.encode(parts, v):
         pass
     elif isinstance(v, dict):
@@ -259,6 +268,9 @@ def decode_value(cur: _Cursor, ext: Optional[Extension] = None):
     if tag == b"b":
         (n,) = cur.unpack(_I64)
         return bytes(cur.take(n))
+    if tag == b"q":
+        (n,) = cur.unpack(_I64)
+        return decode_array(cur.take(n))
     if tag == b"l":
         (n,) = cur.unpack(_U32)
         return [decode_value(cur, ext) for _ in range(n)]

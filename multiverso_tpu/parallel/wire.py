@@ -60,6 +60,11 @@ Value tags (core grammar in flat.py, options added here)::
     l  list: u32 count + values
     t  bool (u8)    i  int (i64)    f  float (f64)
     s  str / b  bytes: i64 length + raw
+    q  COMPRESSED ndarray (parallel/compress.py tagged envelope —
+       int8 row quantization on lossy-opted tables' Add deltas);
+       decode is eager, and the SENDING rank materializes its own
+       window through the same envelope decode so SPMD replicas stay
+       bit-identical under quantization (sync/server.py)
     p  pickle fallback (anything else — exotic options, user payloads,
        extension-dtype arrays whose dtype the flat header cannot
        represent, see dtype_wire_safe): i64 length + pickle bytes
@@ -73,6 +78,9 @@ from typing import List, Tuple
 import numpy as np
 
 from multiverso_tpu.failsafe.errors import WireCorruption  # noqa: F401
+# tagged codec envelopes (round 21): the window byte budget must count
+# a compressed value at its envelope size, not zero
+from multiverso_tpu.parallel.compress import CompressedArray
 # the jax-free codec core (round 19): tags, cursor, array framing —
 # shared with the replica serve protocol's flat frames
 from multiverso_tpu.parallel.flat import (  # noqa: F401
@@ -147,6 +155,8 @@ def payload_nbytes(payload: dict) -> int:
     for v in payload.values():
         if isinstance(v, np.ndarray):
             total += v.nbytes
+        elif isinstance(v, CompressedArray):
+            total += v.nbytes           # the envelope IS the wire cost
         elif isinstance(v, dict):       # compressed-wire payloads
             total += sum(a.nbytes for a in v.values()
                          if isinstance(a, np.ndarray))

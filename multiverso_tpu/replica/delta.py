@@ -26,6 +26,15 @@ parsed):
   thread never touches live tables). Fan-out bytes therefore scale with
   churn, not table size.
 
+Round 21 — under ``-mv_compress`` the payload arrays ride the tagged
+codec envelopes of :mod:`multiverso_tpu.parallel.compress` before
+pickling: dirty-id/key descriptors bitmap-RLE (lossless, always when it
+wins), delta rows int8-per-row-scale and base value rows bf16 (LOSSY —
+only for tables opted in via ``-mv_compress_lossy``). :func:`decode`
+materializes every envelope back to plain arrays, so the mirror logic
+below never sees a compressed value; with the flag off the bundle
+bytes are identical to an uncompressed build.
+
 **Delta applicability.** A delta ``prev → L`` applies to any replica
 state at version W with ``prev <= W <= L``: rows inside the dirty union
 take their version-L values, rows outside are bit-identical in every
@@ -54,7 +63,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from multiverso_tpu.parallel import seal
+from multiverso_tpu.parallel import compress, seal
 from multiverso_tpu.serving.snapshot import (KVSnapshot, MatrixSnapshot,
                                              Snapshot, VectorSnapshot)
 from multiverso_tpu.utils.log import CHECK
@@ -241,9 +250,10 @@ def _bundle(kind: str, snap: Snapshot, prev_version: int,
 
 def encode_base(snap: Snapshot) -> bytes:
     """Full-base blob: every exported table's complete state at
-    ``snap.version`` (first join / resync)."""
+    ``snap.version`` (first join / resync). Value rows ride bf16 for
+    lossy-opted tables under ``-mv_compress``."""
     return _bundle("base", snap, -1,
-                   {tid: _full_payload(ts)
+                   {tid: compress.pack_payload(tid, _full_payload(ts))
                     for tid, ts in snap.tables.items()})
 
 
@@ -259,7 +269,9 @@ def encode_delta(snap: Snapshot, prev_version: int,
         payload = (_full_payload(ts) if desc is None
                    else _delta_payload(ts, desc))
         if payload is not None:
-            tables[tid] = payload
+            # -mv_compress: ids/keys -> bitmap-RLE (lossless); rows ->
+            # int8 (delta) / bf16 (full) for lossy-opted tables only
+            tables[tid] = compress.pack_payload(tid, payload)
     return _bundle("delta", snap, prev_version, tables)
 
 
@@ -272,6 +284,10 @@ def decode(blob: bytes) -> dict:
           and bundle.get("kind") in ("base", "delta"),
           f"unrecognized fan-out bundle "
           f"(v={bundle.get('v') if isinstance(bundle, dict) else '?'})")
+    # materialize any tagged codec envelopes (an unknown codec tag —
+    # a NEWER writer — fails loudly here, before the mirror sees it)
+    for payload in bundle["tables"].values():
+        compress.unpack_payload(payload)
     return bundle
 
 

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
                                             WireCorruption)
+from multiverso_tpu.parallel import compress
 from multiverso_tpu.replica import delta as rdelta
 from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
@@ -87,6 +88,13 @@ class ReplicaPublisher:
         self.fanout_bytes = 0
         self._subs: Dict[int, dict] = {}    #: rid -> local ship state
         self._roster: List[dict] = []       #: last roster (healthz)
+        #: content-addressed encode cache (round 21): N same-lag
+        #: subscribers share ONE encode+compress. Keyed by (kind,
+        #: prev_version, version, codec config); entries for superseded
+        #: versions are dropped at the first encode against a newer
+        #: snapshot. Fan-out-thread-only state (never locked).
+        self._enc_cache: Dict[tuple, bytes] = {}
+        self._enc_version = -1
         self.max_lag = 0
         self._kick = threading.Event()
         self._stop = threading.Event()
@@ -95,6 +103,7 @@ class ReplicaPublisher:
         # scrapes at zero from the first /metrics read
         self._t_bytes = tmetrics.counter("replica.fanout_bytes")
         self._t_blobs = tmetrics.counter("replica.fanout_blobs")
+        self._t_enc_reuse = tmetrics.counter("replica.fanout_encode_reuse")
         self._t_evicted = tmetrics.counter("replica.evictions")
         self._t_subs = tmetrics.gauge("replica.subscribers")
         self._t_lag = tmetrics.gauge("replica.lag_versions")
@@ -239,14 +248,34 @@ class ReplicaPublisher:
     def _encode_for(self, rec: dict, snap):
         """(blob, kind) for one subscriber against the newest retained
         snapshot — delta when the interval is fully journal-covered
-        and the subscriber doesn't need a resync, else base."""
+        and the subscriber doesn't need a resync, else base. Encodes
+        are CONTENT-ADDRESSED by (kind, prev_version, version, codec
+        config): every same-lag subscriber this tick (and across
+        ticks, until the version advances) reuses one encode+compress
+        instead of re-walking the snapshot per subscriber."""
         acked = int(rec["acked"])
         if rec["needs_base"] or acked < 0 or acked >= snap.version:
-            return rdelta.encode_base(snap), "base"
-        descs = self._merged_descs(acked, snap)
-        if descs is None:
-            return rdelta.encode_base(snap), "base"
-        return rdelta.encode_delta(snap, acked, descs), "delta"
+            acked = -1          # every base rider shares one cache key
+            descs = None
+        else:
+            descs = self._merged_descs(acked, snap)
+            if descs is None:
+                acked = -1      # interval pruned: resync with a base
+        kind = "base" if descs is None else "delta"
+        key = (kind, acked, snap.version, compress.config_token())
+        if self._enc_version != snap.version:
+            # superseded interval blobs can never be asked for again
+            # (ships only ever target the NEWEST retained snapshot)
+            self._enc_cache.clear()
+            self._enc_version = snap.version
+        blob = self._enc_cache.get(key)
+        if blob is None:
+            blob = (rdelta.encode_base(snap) if kind == "base"
+                    else rdelta.encode_delta(snap, acked, descs))
+            self._enc_cache[key] = blob
+        else:
+            self._t_enc_reuse.inc()
+        return blob, kind
 
     def _ship(self, rec: dict, st: dict, blob: bytes,
               version: int) -> bool:

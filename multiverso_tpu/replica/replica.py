@@ -53,7 +53,7 @@ import numpy as np
 
 from multiverso_tpu.elastic.coordinator import MemberClient, _recv_exact
 from multiverso_tpu.failsafe.errors import TransientError
-from multiverso_tpu.parallel import flat
+from multiverso_tpu.parallel import compress, flat
 from multiverso_tpu.replica import delta as rdelta
 from multiverso_tpu.serving.frontend import ServingFrontend
 from multiverso_tpu.serving.store import SnapshotStore
@@ -314,12 +314,16 @@ class Replica:
         op = req.get("op")
         if op == "lookup":
             ids = req.get("ids")
+            tid = int(req["table_id"])
             rows = self.frontend.lookup(
-                int(req["table_id"]),
+                tid,
                 None if ids is None else np.asarray(ids),
                 version=req.get("version"),
                 deadline=req.get("deadline"))
-            return {"rows": rows}
+            # -mv_compress + per-table lossy opt-in: f32 result rows
+            # ride bf16 envelopes (flat 'q' tag); the client's eager
+            # flat decode hands back a plain ndarray either way
+            return {"rows": compress.pack_serve_rows(tid, rows)}
         if op == "status":
             return self.status()
         if op == "pin":
@@ -440,6 +444,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--status-file", default="",
                    help="write {rid, serve_port, pid} JSON here once "
                         "up (test/bench discovery)")
+    p.add_argument("--compress", action="store_true",
+                   help="enable the tagged serve-frame codecs "
+                        "(-mv_compress) in this reader; lookup rows "
+                        "compress only for tables named in "
+                        "--compress-lossy")
+    p.add_argument("--compress-lossy", default="",
+                   help="comma-separated table ids (or 'all') whose "
+                        "serve rows may ride the lossy bf16 codec "
+                        "(-mv_compress_lossy)")
     args = p.parse_args(argv)
     # the whole point of this tier: a reader must never pay the jax
     # import (or its device bootstrap) — if this trips, some module on
@@ -451,6 +464,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     CHECK(host and port_s.isdigit(),
           f"--addr must be host:port, got {args.addr!r}")
     SetCMDFlag("mv_serving_keep", args.keep)
+    if args.compress:
+        SetCMDFlag("mv_compress", True)
+    if args.compress_lossy:
+        SetCMDFlag("mv_compress_lossy", args.compress_lossy)
     rep = Replica(host, int(port_s), mode=args.mode,
                   serve_port=args.serve_port,
                   ring_bytes=args.ring_bytes, lease_s=args.lease)

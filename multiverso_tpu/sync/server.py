@@ -37,6 +37,7 @@ from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
                                             MembershipChanged,
                                             TransientError, WireCorruption)
 from multiverso_tpu.message import Message, MsgType, copy_result
+from multiverso_tpu.parallel import compress
 from multiverso_tpu.parallel import multihost
 from multiverso_tpu.parallel import wire
 from multiverso_tpu.telemetry import flight as tflight
@@ -1933,8 +1934,14 @@ class Server(Actor):
                 for i, b in enumerate(blobs):
                     if i == my_rank:
                         # our own verbs verbatim — no decode round-trip,
-                        # and deferred values keep their .local arrays
-                        windows.append(local)
+                        # and deferred values keep their .local arrays.
+                        # COMPRESSED values are the one exception: every
+                        # rank must apply the identical dequantized
+                        # reconstruction (the peers decode eagerly in
+                        # the flat codec; we run the same envelope
+                        # decode here), else lossy codecs would diverge
+                        # the SPMD replicas
+                        windows.append(compress.materialize_window(local))
                         continue
                     head_kind, head_mt = wire.decode_head_kind(b)
                     CHECK(head_kind == "window",
@@ -1994,10 +2001,19 @@ class Server(Actor):
             if kind == "A":
                 payload = self._mh_maybe_defer(m.table_id, payload,
                                                mode, min_bytes)
+                # -mv_compress: int8-quantize a lossy-opted table's Add
+                # values for the host wire (parallel/compress.py tagged
+                # envelope; a no-op for deferred/already-compressed
+                # values). The apply side reconstructs through ONE
+                # decode on every rank, our own included — see the
+                # materialize step in _mh_exchange_decode
+                payload = compress.pack_window_values(m.table_id,
+                                                      payload)
                 if payload is not m.payload:
-                    # keep the deferred form on the message: a verb
-                    # re-led after a short peer prefix / budget cut must
-                    # not re-defer (and re-count) on the next pack pass
+                    # keep the deferred/compressed form on the message:
+                    # a verb re-led after a short peer prefix / budget
+                    # cut must not re-defer, re-compress (or re-count)
+                    # on the next pack pass
                     m.payload = payload
             nbytes = self._payload_bytes(payload)
             if packed + nbytes > self.MH_WINDOW_BYTES and i > 0:

@@ -1,0 +1,22 @@
+"""Fixture twin of the tagged compression codecs (round 21) — bad tree
+seeds a per-blob GetFlag read inside a hot-zone def and a bare print on
+the decode path."""
+
+
+def GetFlag(name):
+    return False
+
+
+def enabled():
+    return bool(GetFlag("mv_compress"))  # seeded violation
+
+
+def pack_payload(table_id, payload):
+    if not enabled():
+        return payload
+    return dict(payload)
+
+
+def decode_array(blob):
+    print("decoding", len(blob))  # seeded violation
+    return blob[1:]

@@ -1,0 +1,27 @@
+"""Fixture twin of the tagged compression codecs (round 21): the
+enable/opt-in predicates are hot-zone defs (they ride every replica
+bundle, window exchange, and serve frame) — the clean twin reads flags
+through listener-cached accessors only."""
+
+
+def cached_bool_flag(name, default):
+    def read():
+        return default
+    return read
+
+
+_enabled_flag = cached_bool_flag("mv_compress", False)
+
+
+def enabled():
+    return _enabled_flag()
+
+
+def pack_payload(table_id, payload):
+    if not enabled():
+        return payload
+    return dict(payload)
+
+
+def decode_array(blob):
+    return blob[1:]

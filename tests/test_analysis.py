@@ -1188,6 +1188,10 @@ class TestScannedCoveragePins:
         for checker in res.checkers:
             assert "parallel/seal.py" in checker.scanned
             assert "parallel/flat.py" in checker.scanned
+        # round 21 — the compression codec module joins the pinned
+        # wire-plane set (its enable predicates are hot-zone defs)
+        for checker in res.checkers:
+            assert "parallel/compress.py" in checker.scanned
 
 
 class TestMvlintEntryPoint:

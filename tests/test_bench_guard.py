@@ -57,6 +57,9 @@ GUARDED = {
     # 0.6 still guards >= 3x the ~3k blocking wall)
     "seal_crc32c_GB_s": 0.5,
     "verb_batch_throughput": 0.6,
+    # round 21 — the int8 row-quantizer's encode throughput (pure numpy
+    # codec math; same 0.5 memory-subsystem floor as the seal's CRC)
+    "compress_int8_GB_s": 0.5,
 }
 
 #: metric -> worst acceptable multiple of the guard value (latency:
@@ -76,6 +79,14 @@ GUARDED_CEIL = {
     # change pushing the measured share past 2x the frozen value means
     # the churn-scaled-bytes property regressed
     "replica_delta_vs_full_pct": 2.0,
+    # round 21 — tagged compression byte ceilings. fanout_bytes_pct is
+    # the lossy 1%-churn delta's share of the plain delta: the
+    # acceptance bar is >=3x shrink (<= 33%), and the frozen ~27% at
+    # 1.3x slack keeps every later run under that bar. bytes_per_window
+    # is DETERMINISTIC (header+scales+codes of a fixed shape), so the
+    # slack only absorbs codec framing tweaks, not noise
+    "compress_fanout_bytes_pct": 1.3,
+    "compress_bytes_per_window": 1.1,
 }
 
 #: metrics that must read EXACTLY ZERO in the latest artifact (round

@@ -1,0 +1,429 @@
+"""Tagged compression codecs (round 21): property tests + path drills.
+
+Four tiers, mirroring the layer's contract surface:
+
+* codec properties — round-trip BIT-exactness for the lossless codecs
+  (raw, bitmap-RLE) and bounded max-abs error for the lossy ones
+  (int8-per-row-scale, bf16) across dtypes/shapes/empty-row edges, plus
+  the loud-failure posture for a reserved-but-unknown codec tag (the
+  seal's "written by a newer writer" drill, one nibble up);
+* replica bundles — lossless configs keep the mirror BIT-identical to
+  an uncompressed build, the 1%-churn lossy delta shrinks >= 3x (the
+  acceptance bar bench ratchets), and ``-mv_compress`` off leaves the
+  pickled bundle grammar untouched (no envelope ever appears);
+* the window wire — an int8-compressed Add value decodes on a peer to
+  EXACTLY what the sending rank's materialize step reconstructs (the
+  SPMD lossy-consistency contract), and the byte budget counts the
+  envelope, not zero;
+* the serve frames + the publisher's content-addressed encode cache,
+  and the convergence drill: a logreg trained through quantized delta
+  fan-out serves a loss within tolerance of the lossless oracle.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.failsafe.errors import WireCorruption
+from multiverso_tpu.parallel import compress as C
+from multiverso_tpu.parallel import flat, seal, wire
+from multiverso_tpu.replica import delta as rdelta
+from multiverso_tpu.serving.snapshot import (KVSnapshot, MatrixSnapshot,
+                                             Snapshot, VectorSnapshot)
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+
+@pytest.fixture
+def compress_flags():
+    """Flip -mv_compress* for one test; always restore the defaults."""
+    def _set(on: bool, lossy: str = ""):
+        SetCMDFlag("mv_compress", on)
+        SetCMDFlag("mv_compress_lossy", lossy)
+    yield _set
+    SetCMDFlag("mv_compress", False)
+    SetCMDFlag("mv_compress_lossy", "")
+
+
+def _snap(version: int, tables: dict) -> Snapshot:
+    return Snapshot(version=version, created_wall=0.0, window_epoch=0,
+                    tables=tables)
+
+
+# -- codec properties --------------------------------------------------------
+
+
+class TestLosslessCodecs:
+    @pytest.mark.parametrize("arr", [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(6, dtype=np.float64),
+        np.arange(8, dtype=np.int64).reshape(2, 2, 2),
+        np.empty((0, 4), np.float32),
+        np.array(3.5, np.float32),          # 0-d
+        np.array([True, False]),
+    ], ids=["f32_2d", "f64_1d", "i64_3d", "empty", "scalar", "bool"])
+    def test_raw_round_trip_bit_exact(self, arr):
+        out = C.decode_array(C.encode_raw(arr))
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+    @pytest.mark.parametrize("ids", [
+        np.empty(0, np.int64),
+        np.array([0], np.int64),
+        np.array([7, 8, 9, 100, 101], np.int64),
+        np.arange(20_000, dtype=np.int64),              # dense: tiny
+        None,                                           # random churn
+    ], ids=["empty", "single", "runs", "dense", "churn"])
+    def test_rle_round_trip_bit_exact(self, ids):
+        if ids is None:
+            rng = np.random.default_rng(7)
+            ids = np.unique(rng.integers(0, 20_000, 200)).astype(np.int64)
+        assert C.rle_encodable(ids)
+        out = C.decode_array(C.encode_rle_ids(ids))
+        assert out.dtype == np.int64
+        assert np.array_equal(out, ids)
+
+    def test_rle_wins_on_churn_and_dense(self):
+        rng = np.random.default_rng(3)
+        churn = np.unique(rng.integers(0, 20_000, 200)).astype(np.int64)
+        assert len(C.encode_rle_ids(churn)) < churn.nbytes / 2
+        dense = np.arange(20_000, dtype=np.int64)
+        assert len(C.encode_rle_ids(dense)) < 16  # one run, varint-coded
+
+    def test_rle_contract_gate(self):
+        # unsorted / negative / wrong dtype sets fall back to raw
+        assert not C.rle_encodable(np.array([3, 1], np.int64))
+        assert not C.rle_encodable(np.array([1, 1, 2], np.int64))
+        assert not C.rle_encodable(np.array([-1, 2], np.int64))
+        assert not C.rle_encodable(np.array([1.0, 2.0]))
+        assert not C.rle_encodable(np.array([1, 2], np.int32))
+
+
+class TestLossyCodecs:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("shape", [(50, 64), (64,), (5, 1), (1, 5)])
+    def test_int8_error_bound(self, dtype, shape):
+        rng = np.random.default_rng(11)
+        x = (rng.standard_normal(shape) * 10).astype(dtype)
+        out = C.decode_array(C.encode_int8_rows(x))
+        assert out.dtype == x.dtype and out.shape == x.shape
+        rows = x.reshape(1, -1) if x.ndim == 1 else x
+        got = out.reshape(rows.shape)
+        # per element: |err| <= scale/2, scale = max|row|/127
+        bound = np.abs(rows).max(axis=1, keepdims=True) / 127.0
+        assert (np.abs(got - rows) <= 0.5 * bound + 1e-5).all()
+
+    @pytest.mark.parametrize("shape", [(0, 4), (4, 0), (0,)],
+                             ids=["no_rows", "no_cols", "empty_1d"])
+    def test_int8_empty_edges(self, shape):
+        x = np.empty(shape, np.float32)
+        out = C.decode_array(C.encode_int8_rows(x))
+        assert out.shape == shape and out.dtype == np.float32
+
+    def test_int8_zero_rows_exact(self):
+        x = np.zeros((3, 8), np.float32)
+        x[1] = np.linspace(-2, 2, 8)
+        out = C.decode_array(C.encode_int8_rows(x))
+        assert np.array_equal(out[0], np.zeros(8))
+        assert np.array_equal(out[2], np.zeros(8))
+
+    def test_int8_shrinks_4x(self):
+        x = np.random.default_rng(0).standard_normal(
+            (200, 64)).astype(np.float32)
+        assert x.nbytes / len(C.encode_int8_rows(x)) > 3.5
+
+    def test_bf16_error_bound_and_exact_powers(self):
+        rng = np.random.default_rng(5)
+        x = (rng.standard_normal((40, 16)) * 100).astype(np.float32)
+        out = C.decode_array(C.encode_bf16(x))
+        assert out.dtype == np.float32 and out.shape == x.shape
+        # round-to-nearest-even: relative error <= 2**-8
+        assert (np.abs(out - x) <= np.abs(x) * 2.0 ** -8 + 1e-30).all()
+        pow2 = np.array([1.0, -2.0, 0.5, 65536.0, 0.0], np.float32)
+        assert np.array_equal(C.decode_array(C.encode_bf16(pow2)), pow2)
+
+    def test_bf16_specials_survive(self):
+        x = np.array([np.nan, np.inf, -np.inf, 0.0], np.float32)
+        out = C.decode_array(C.encode_bf16(x))
+        assert np.isnan(out[0])
+        assert out[1] == np.inf and out[2] == -np.inf and out[3] == 0.0
+
+
+class TestEnvelopePosture:
+    def test_unknown_reserved_tag_fails_loud(self):
+        # the seal's "newer writer" drill, one nibble up: a tag from
+        # the RESERVED range this build does not implement must refuse
+        # to parse with the rollout-order message
+        for tag in (0xD9, 0xDF):
+            with pytest.raises(WireCorruption, match="newer writer"):
+                C.decode_array(bytes([tag]) + b"\x00" * 8)
+
+    def test_non_envelope_byte_fails_loud(self):
+        with pytest.raises(WireCorruption):
+            C.decode_array(b"\x41garbage")
+        with pytest.raises(WireCorruption):
+            C.decode_array(b"")
+
+    def test_flat_q_tag_decodes_eagerly(self):
+        x = np.random.default_rng(1).standard_normal(
+            (8, 4)).astype(np.float32)
+        w = C.CompressedArray(C.encode_raw(x))
+        out = flat.decode_frame(flat.encode_frame({"rows": w}))
+        assert isinstance(out["rows"], np.ndarray)
+        assert np.array_equal(out["rows"], x)
+
+    def test_wrapper_pickles(self):
+        w = C.CompressedArray(C.encode_rle_ids(np.arange(5)))
+        w2 = pickle.loads(pickle.dumps(w))
+        assert w2.blob == w.blob and w2.nbytes == len(w.blob)
+
+
+# -- replica bundle path -----------------------------------------------------
+
+
+def _matrix_world(rows=2000, cols=32, seed=0):
+    rng = np.random.default_rng(seed)
+    state = rng.standard_normal((rows, cols)).astype(np.float32)
+    return rng, state
+
+
+class TestBundlePath:
+    def test_off_keeps_bundle_grammar_untouched(self, compress_flags):
+        compress_flags(False)
+        _, state = _matrix_world()
+        ids = np.arange(0, 2000, 97, dtype=np.int64)
+        blob = rdelta.encode_delta(
+            _snap(1, {0: MatrixSnapshot.host(state)}), 0,
+            {0: {"kind": "rows", "ids": ids}})
+        # unpickle WITHOUT the materialize pass: the raw grammar must
+        # hold plain ndarrays only — i.e. the off wire is byte-for-byte
+        # the pre-compression format (modulo its own timestamps)
+        bundle = pickle.loads(seal.open_frame(blob))
+        for payload in bundle["tables"].values():
+            for v in payload.values():
+                assert not isinstance(v, C.CompressedArray)
+
+    def test_lossless_config_mirror_bit_exact(self, compress_flags):
+        rng, state = _matrix_world()
+        oracle, mirrored = rdelta.MirrorStore(), rdelta.MirrorStore()
+        prev = -1
+        for version in range(3):
+            snap = _snap(version, {0: MatrixSnapshot.host(state.copy())})
+            if version == 0:
+                compress_flags(False)
+                base = rdelta.encode_base(snap)
+                oracle.apply(rdelta.decode(base))
+                compress_flags(True)        # lossless: RLE ids only
+                mirrored.apply(rdelta.decode(rdelta.encode_base(snap)))
+            else:
+                ids = np.unique(rng.integers(0, 2000, 20)).astype(np.int64)
+                state[ids] += 1.0
+                snap = _snap(version,
+                             {0: MatrixSnapshot.host(state.copy())})
+                descs = {0: {"kind": "rows", "ids": ids}}
+                compress_flags(False)
+                oracle.apply(rdelta.decode(
+                    rdelta.encode_delta(snap, prev, descs)))
+                compress_flags(True)
+                blob = rdelta.encode_delta(snap, prev, descs)
+                mirrored.apply(rdelta.decode(blob))
+            prev = version
+        assert np.array_equal(oracle._tables[0]["rows"],
+                              mirrored._tables[0]["rows"])
+        assert np.array_equal(mirrored._tables[0]["rows"], state)
+
+    def test_lossy_delta_shrinks_3x_at_1pct_churn(self, compress_flags):
+        rng, state = _matrix_world(rows=20_000, cols=64)
+        ids = np.unique(rng.integers(0, 20_000, 200)).astype(np.int64)
+        snap = _snap(1, {0: MatrixSnapshot.host(state)})
+        descs = {0: {"kind": "rows", "ids": ids}}
+        compress_flags(False)
+        plain = rdelta.encode_delta(snap, 0, descs)
+        compress_flags(True, lossy="0")
+        packed = rdelta.encode_delta(snap, 0, descs)
+        assert len(plain) / len(packed) >= 3.0, \
+            f"lossy delta only {len(plain) / len(packed):.2f}x smaller"
+        # and the mirror error stays inside the int8 bound
+        m = rdelta.MirrorStore()
+        compress_flags(True, lossy="0")
+        m.apply(rdelta.decode(rdelta.encode_base(
+            _snap(0, {0: MatrixSnapshot.host(state)}))))
+        m.apply(rdelta.decode(packed))
+        got = m._tables[0]["rows"][ids]
+        want = state[ids]
+        bound = np.abs(want).max(axis=1, keepdims=True) / 127.0
+        assert (np.abs(got - want) <= 0.5 * bound + 1e-5).all()
+
+    def test_kv_and_vector_payloads_round_trip(self, compress_flags):
+        compress_flags(True)    # lossless: keys ride RLE
+        keys = np.arange(100, 400, dtype=np.int64)
+        vals = np.random.default_rng(2).standard_normal(
+            (300, 8)).astype(np.float32)
+        vec = np.linspace(0, 1, 64).astype(np.float32)
+        snap = _snap(0, {1: KVSnapshot(keys, vals),
+                         2: VectorSnapshot(vec)})
+        m = rdelta.MirrorStore()
+        m.apply(rdelta.decode(rdelta.encode_base(snap)))
+        assert np.array_equal(m._tables[1]["keys"], keys)
+        assert np.array_equal(m._tables[1]["values"], vals)
+        assert np.array_equal(m._tables[2]["values"], vec)
+
+    def test_unknown_codec_tag_in_bundle_fails_loud(self, compress_flags):
+        compress_flags(True)
+        _, state = _matrix_world(rows=100, cols=8)
+        snap = _snap(0, {0: MatrixSnapshot.host(state)})
+        blob = rdelta.encode_base(snap)
+        body = pickle.loads(seal.open_frame(blob))
+        body["tables"][0]["rows"] = C.CompressedArray(
+            bytes([0xDE]) + b"\x00" * 4)
+        forged = seal.seal_frame(pickle.dumps(body))
+        with pytest.raises(WireCorruption, match="newer writer"):
+            rdelta.decode(forged)
+
+
+# -- window wire path --------------------------------------------------------
+
+
+class TestWindowPath:
+    def _add_verbs(self, tid=3):
+        rng = np.random.default_rng(9)
+        payload = {
+            "row_ids": np.arange(64, dtype=np.int64),
+            "values": (rng.standard_normal((64, 32)) * 0.1
+                       ).astype(np.float32),
+        }
+        return [("A", tid, payload)]
+
+    def test_off_leaves_payload_object_alone(self, compress_flags):
+        compress_flags(False)
+        verbs = self._add_verbs()
+        assert C.pack_window_values(3, verbs[0][2]) is verbs[0][2]
+        compress_flags(True)    # on, but table NOT lossy-opted
+        assert C.pack_window_values(3, verbs[0][2]) is verbs[0][2]
+
+    def test_sender_and_peer_reconstruct_identically(self, compress_flags):
+        compress_flags(True, lossy="3")
+        kind, tid, payload = self._add_verbs()[0]
+        packed = C.pack_window_values(tid, payload)
+        assert isinstance(packed["values"], C.CompressedArray)
+        local = [(kind, tid, packed)]
+        # peer: eager decode inside the flat window codec
+        peer = wire.decode_window(wire.encode_window(local, seq=0))
+        # sender: the materialize step (sync/server.py own-rank path)
+        own = C.materialize_window(local)
+        assert isinstance(peer[0][2]["values"], np.ndarray)
+        assert np.array_equal(peer[0][2]["values"], own[0][2]["values"])
+        # and the sender's message keeps the COMPRESSED form (re-pack)
+        assert isinstance(packed["values"], C.CompressedArray)
+        # quantization error stays inside the int8 bound
+        want = payload["values"]
+        bound = np.abs(want).max(axis=1, keepdims=True) / 127.0
+        assert (np.abs(own[0][2]["values"] - want)
+                <= 0.5 * bound + 1e-6).all()
+
+    def test_budget_counts_envelope_bytes(self, compress_flags):
+        compress_flags(True, lossy="3")
+        kind, tid, payload = self._add_verbs()[0]
+        packed = C.pack_window_values(tid, payload)
+        plain = wire.payload_nbytes(payload)
+        squeezed = wire.payload_nbytes(packed)
+        env = packed["values"].nbytes
+        assert squeezed == plain - payload["values"].nbytes + env
+        assert 0 < env < payload["values"].nbytes / 3
+
+
+# -- serve frames, publisher cache, convergence ------------------------------
+
+
+class TestServeAndPublisher:
+    def test_serve_rows_compress_and_decode(self, compress_flags):
+        rows = np.random.default_rng(4).standard_normal(
+            (32, 16)).astype(np.float32)
+        compress_flags(False)
+        assert C.pack_serve_rows(0, rows) is rows
+        compress_flags(True, lossy="0")
+        packed = C.pack_serve_rows(0, rows)
+        assert isinstance(packed, C.CompressedArray)
+        out = flat.decode_frame(flat.encode_frame({"rows": packed}))
+        assert (np.abs(out["rows"] - rows)
+                <= np.abs(rows) * 2.0 ** -8 + 1e-30).all()
+
+    def test_publisher_content_addressed_encode_cache(self, compress_flags):
+        compress_flags(True)
+        from multiverso_tpu.replica.publisher import ReplicaPublisher
+        pub = ReplicaPublisher(zoo=None, active=True)
+        _, state = _matrix_world(rows=500, cols=8)
+        snap = _snap(2, {0: MatrixSnapshot.host(state)})
+        ids = np.arange(0, 500, 50, dtype=np.int64)
+        with pub._lock:
+            pub._dirty[1] = {0: {"kind": "rows", "ids": ids}}
+            pub._dirty[2] = {0: {"kind": "rows", "ids": ids + 1}}
+            pub.latest = 2
+        rec = {"acked": 0, "needs_base": False}
+        blob1, kind1 = pub._encode_for(rec, snap)
+        blob2, kind2 = pub._encode_for(dict(rec), snap)
+        assert kind1 == kind2 == "delta"
+        assert blob2 is blob1           # ONE encode for same-lag subs
+        # a different lag is a different interval: its own entry
+        blob3, _ = pub._encode_for({"acked": 1, "needs_base": False},
+                                   snap)
+        assert blob3 is not blob1
+        # flag flip invalidates (codec config rides the key)
+        compress_flags(True, lossy="0")
+        blob4, _ = pub._encode_for(dict(rec), snap)
+        assert blob4 is not blob1 and len(blob4) < len(blob1)
+        # version advance clears superseded entries
+        snap3 = _snap(3, {0: MatrixSnapshot.host(state)})
+        with pub._lock:
+            pub._dirty[3] = {0: {"kind": "rows", "ids": ids}}
+        pub._encode_for({"acked": -1, "needs_base": True}, snap3)
+        assert all(k[2] == 3 for k in pub._enc_cache)
+
+    def test_logreg_quantized_fanout_convergence(self, compress_flags):
+        """The ROADMAP's converging-loss drill: train a logreg whose
+        weight table fans out through int8-quantized deltas; the
+        replica mirror's serving loss must land within tolerance of
+        the trainer's (lossless oracle) loss."""
+        rng = np.random.default_rng(42)
+        dim, n = 64, 512
+        w_true = rng.standard_normal(dim)
+        X = np.zeros((n, dim), np.float32)
+        for i in range(n):     # sparse rows: 8 active features each
+            X[i, rng.choice(dim, 8, replace=False)] = \
+                rng.standard_normal(8).astype(np.float32)
+        y = (X @ w_true > 0).astype(np.float32)
+
+        def loss(w):
+            z = X @ w.ravel()
+            p = 1.0 / (1.0 + np.exp(-z))
+            p = np.clip(p, 1e-7, 1 - 1e-7)
+            return float(-np.mean(y * np.log(p)
+                                  + (1 - y) * np.log(1 - p)))
+
+        compress_flags(True, lossy="0")
+        W = np.zeros((dim, 1), np.float32)
+        journal = rdelta.TableJournal("rows", num_rows=dim)
+        mirror = rdelta.MirrorStore()
+        mirror.apply(rdelta.decode(rdelta.encode_base(
+            _snap(0, {0: MatrixSnapshot.host(W.copy())}))))
+        prev = 0
+        for epoch in range(25):
+            for s in range(0, n, 64):
+                xb, yb = X[s:s + 64], y[s:s + 64]
+                p = 1.0 / (1.0 + np.exp(-(xb @ W.ravel())))
+                g = xb.T @ (p - yb) / len(yb)
+                touched = np.flatnonzero(g)
+                W[:, 0] -= 1.0 * g
+                journal.mark_rows(touched)
+            version = epoch + 1
+            snap = _snap(version, {0: MatrixSnapshot.host(W.copy())})
+            desc = journal.drain()
+            blob = rdelta.encode_delta(snap, prev, {0: desc})
+            mirror.apply(rdelta.decode(blob))
+            prev = version
+        oracle_loss = loss(W)
+        mirror_loss = loss(mirror._tables[0]["rows"])
+        assert oracle_loss < 0.3, f"oracle never converged: {oracle_loss}"
+        assert abs(mirror_loss - oracle_loss) <= 0.02, \
+            f"quantized fan-out loss {mirror_loss:.4f} vs lossless " \
+            f"oracle {oracle_loss:.4f}"
