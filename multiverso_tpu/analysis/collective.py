@@ -69,6 +69,14 @@ DEFAULT_ROOTS: Dict[str, str] = {
         "policy evaluation daemon (alert->action loop)",
     "policy/engine.py:PolicyEngine.step":
         "policy evaluation step (also driven directly by tests)",
+    # round 22 — the fleet plane's two legs: rollup builds run on lease
+    # heartbeat daemons (a collective there deadlocks the beat against
+    # the engine stream), and the coordinator-side fold runs on RPC
+    # handler threads serving members that are mid-collective
+    "telemetry/fleet.py:build_rollup":
+        "fleet rollup build (lease heartbeat daemon threads)",
+    "telemetry/fleet.py:FleetAccumulator.ingest":
+        "coordinator-side fleet rollup fold (RPC handler threads)",
 }
 
 #: collective primitives: node id -> what it is

@@ -97,6 +97,7 @@ from multiverso_tpu.failsafe.errors import (MembershipChanged,
 # verifying (new readers accept old frames; the direction is one-way —
 # upgrade readers before writers, see seal.py's module docstring)
 from multiverso_tpu.parallel import seal
+from multiverso_tpu.telemetry import fleet as tfleet
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -386,6 +387,12 @@ class Coordinator:
             return {"epoch": self.epoch, "members": self._active()}
 
     def _op_hb(self, req: dict) -> dict:
+        # round 22: fleet rollups piggyback on the beats that already
+        # flow — fold OUTSIDE the membership lock (the accumulator has
+        # its own, and a slow decode must not stall the authority)
+        blob = req.get("rollup")
+        if blob:
+            tfleet.ingest(blob)
         with self._lock:
             rec = self.members.get(int(req["member"]))
             if rec is not None and rec.status not in ("dead",):
@@ -865,6 +872,8 @@ class Coordinator:
                           "declared dead", rec.rid, rec.lease_s)
         if dead:
             tmetrics.counter("replica.lease_expirations").inc(len(dead))
+            for rid in dead:
+                tfleet.forget(f"replica:{rid}")
             self._cv.notify_all()
         return dead
 
@@ -888,8 +897,15 @@ class Coordinator:
             if rec is None or rec.status != "live":
                 return {"evicted": True, "latest": self._replica_latest}
             rec.last_hb = time.monotonic()
-            return {"evicted": False, "latest": self._replica_latest,
+            resp = {"evicted": False, "latest": self._replica_latest,
                     "acked": rec.acked_version}
+        # the reader's fleet rollup rides its lease beat (round 22);
+        # folded outside the lock, and only for LIVE subscriptions — a
+        # forgotten (evicted) member must not resurrect in /fleet
+        blob = req.get("rollup")
+        if blob:
+            tfleet.ingest(blob)
+        return resp
 
     def _op_replica_ack(self, req: dict) -> dict:
         with self._lock:
@@ -906,6 +922,12 @@ class Coordinator:
         """Publisher-side poll: announce the newest published version,
         reap expired replica leases, and return the full subscription
         roster (dead/evicted included — /healthz names departures)."""
+        blob = req.get("rollup")
+        if blob:
+            # the trainer-side publisher's own rollup rides its roster
+            # poll (round 22) — the one control message a replica-plane
+            # trainer is guaranteed to send even outside elastic runs
+            tfleet.ingest(blob)
         with self._lock:
             if "latest" in req and req["latest"] is not None:
                 self._replica_latest = max(self._replica_latest,
@@ -915,7 +937,10 @@ class Coordinator:
                 {"rid": r.rid, "mode": r.mode, "token": r.token,
                  "ring_bytes": r.ring_bytes, "status": r.status,
                  "acked": r.acked_version, "needs_base": r.needs_base,
-                 "mailbox_depth": len(r.mailbox)}
+                 "mailbox_depth": len(r.mailbox),
+                 # seconds since the subscription's last fleet rollup
+                 # landed (None until one has) — /healthz's stale-warn
+                 "rollup_age_s": tfleet.rollup_age_s(f"replica:{r.rid}")}
                 for r in sorted(self._replicas.values(),
                                 key=lambda r: r.rid)]}
 
@@ -925,6 +950,7 @@ class Coordinator:
             if rec is not None and rec.status != "evicted":
                 rec.status = "evicted"
                 rec.mailbox = []
+                tfleet.forget(f"replica:{rec.rid}")
                 self._cv.notify_all()
                 Log.Info("elastic: replica %d subscription evicted",
                          rec.rid)
@@ -1038,7 +1064,15 @@ class MemberClient:
         def _beat():
             while not self._hb_stop.wait(period):
                 try:
-                    self.call("hb", timeout=5.0)
+                    # round 22: this rank's fleet rollup rides the beat.
+                    # Telemetry must never cost the lease — a rollup
+                    # failure degrades to an empty blob.
+                    try:
+                        rollup = tfleet.encode_rollup(tfleet.build_rollup(
+                            f"rank{self.member}", "trainer"))
+                    except Exception:
+                        rollup = b""
+                    self.call("hb", rollup=rollup, timeout=5.0)
                 except Exception:
                     # a missed beat is what the lease machinery exists
                     # to notice — nothing useful to do locally

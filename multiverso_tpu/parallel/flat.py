@@ -59,6 +59,16 @@ _F64 = struct.Struct("<d")
 #: fails loudly at the first byte
 KIND_FLAT = 0x46        # 'F'
 
+#: OPTIONAL trace-context entry in a flat request dict (round 22):
+#: ``[trace_id, span_id]`` of the caller's open span, present ONLY when
+#: ``-trace`` is armed on the sending side. Same negotiation posture as
+#: the seal and codec tags — an old receiver sees an unknown dict key
+#: it never reads (dict entries are self-delimiting), a new receiver of
+#: an old sender sees it absent, and when absent the encoded frame is
+#: BYTE-IDENTICAL to a pre-round-22 one (the dict is one entry shorter;
+#: nothing else moves), so tracing-off leaves the wire untouched.
+TRACE_KEY = "_tctx"
+
 
 class Extension:
     """Hook for domain tags layered over the core grammar (wire.py's

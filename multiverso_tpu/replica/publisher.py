@@ -40,6 +40,7 @@ from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
                                             WireCorruption)
 from multiverso_tpu.parallel import compress
 from multiverso_tpu.replica import delta as rdelta
+from multiverso_tpu.telemetry import fleet as tfleet
 from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.configure import (GetFlag, cached_bool_flag,
@@ -88,6 +89,10 @@ class ReplicaPublisher:
         self.fanout_bytes = 0
         self._subs: Dict[int, dict] = {}    #: rid -> local ship state
         self._roster: List[dict] = []       #: last roster (healthz)
+        #: fleet identity for the rollup riding the roster poll —
+        #: stamped by start_plane on the app thread (the fan-out thread
+        #: must never touch multihost: device-work-domain law)
+        self.member_label = "rank0"
         #: content-addressed encode cache (round 21): N same-lag
         #: subscribers share ONE encode+compress. Keyed by (kind,
         #: prev_version, version, codec config); entries for superseded
@@ -185,9 +190,19 @@ class ReplicaPublisher:
 
     def _tick(self) -> None:
         from multiverso_tpu.serving import peek_plane
+        try:
+            # round 22: this trainer rank's fleet rollup rides the
+            # roster poll that already flows every tick — the one
+            # guaranteed control message even outside elastic runs.
+            # Telemetry must never cost the fan-out: failure -> empty.
+            rollup = tfleet.encode_rollup(tfleet.build_rollup(
+                self.member_label, "trainer"))
+        except Exception:
+            rollup = b""
         resp = self.client.call(
             "replica_roster", timeout=_RPC_TIMEOUT_S,
-            latest=self.latest if self.latest >= 0 else None)
+            latest=self.latest if self.latest >= 0 else None,
+            rollup=rollup)
         roster = resp["replicas"]
         plane = peek_plane()
         store = plane.store if plane is not None else None
@@ -350,6 +365,7 @@ def start_plane(zoo) -> bool:
     me = multihost.process_index()
     active = me == 0
     pub = ReplicaPublisher(zoo, active)
+    pub.member_label = f"rank{me}"
     if active:
         addr = str(GetFlag("mv_replica_addr"))
         ep = elastic.coordinator_endpoint()
@@ -429,9 +445,16 @@ def status_report() -> Optional[dict]:
         lag = (pub.latest - rec["acked"]
                if pub.latest >= 0 and rec["acked"] >= 0
                and rec["status"] == "live" else None)
+        # round 22 fix: a frozen telemetry feed used to render here as
+        # healthy-looking stale numbers — now each line carries the
+        # rollup age and an explicit stale verdict (vs -mv_fleet_stale_s)
+        age = rec.get("rollup_age_s")
         subs.append({"rid": rec["rid"], "mode": rec["mode"],
                      "state": rec["status"], "acked": rec["acked"],
-                     "lag_versions": lag})
+                     "lag_versions": lag, "rollup_age_s": age,
+                     "rollup_stale": bool(
+                         rec["status"] == "live" and age is not None
+                         and age > tfleet.stale_s())})
     return {"active": pub.active, "endpoint": pub.endpoint,
             "latest": pub.latest if pub.latest >= 0 else None,
             "fanout_bytes": pub.fanout_bytes, "max_lag": pub.max_lag,
@@ -445,8 +468,16 @@ def peek_sample() -> Optional[dict]:
     if pub is None or not pub.active:
         return None
     live = sum(1 for r in pub._roster if r["status"] == "live")
-    return {"replica_subscribers": live,
-            "replica_lag_versions": pub.max_lag}
+    sample = {"replica_subscribers": live,
+              "replica_lag_versions": pub.max_lag}
+    # round 22: the replica_lag rule degrades to a stale-warn instead
+    # of trusting frozen numbers — feed it the oldest live rollup age
+    ages = [r["rollup_age_s"] for r in pub._roster
+            if r["status"] == "live"
+            and r.get("rollup_age_s") is not None]
+    if ages:
+        sample["replica_rollup_age_max_s"] = max(ages)
+    return sample
 
 
 def ledger_bytes() -> Optional[dict]:

@@ -57,7 +57,9 @@ from multiverso_tpu.parallel import compress, flat
 from multiverso_tpu.replica import delta as rdelta
 from multiverso_tpu.serving.frontend import ServingFrontend
 from multiverso_tpu.serving.store import SnapshotStore
+from multiverso_tpu.telemetry import fleet as tfleet
 from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.utils.configure import SetCMDFlag
 from multiverso_tpu.utils.log import CHECK, Log
 
@@ -157,6 +159,7 @@ class Replica:
         self._t_applies = tmetrics.counter("replica.applies")
         self._t_recv = tmetrics.counter("replica.recv_bytes")
         self._t_mirror = tmetrics.gauge("mem.replica.mirror_bytes")
+        self._d_serve = tmetrics.digest("digest.replica.serve_s")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -176,6 +179,7 @@ class Replica:
                                       lease_s=self.lease_s)
         self.rid = int(resp["rid"])
         self.latest_known = int(resp.get("latest", -1))
+        ttrace.set_process_label(f"multiverso replica r{self.rid}")
         self._start_serve_server()
         threading.Thread(target=self._hb_loop, name="mv-replica-hb",
                          daemon=True).start()
@@ -200,8 +204,17 @@ class Replica:
         period = max(0.05, self.lease_s / 3.0)
         while not self._stop.wait(period):
             try:
+                # round 22: the fleet rollup rides the lease beat that
+                # already flows — zero new connections. Telemetry must
+                # never cost the lease, so a rollup failure degrades to
+                # an empty blob (the coordinator just sees no update).
+                rollup = tfleet.encode_rollup(tfleet.build_rollup(
+                    f"replica:{self.rid}", "replica"))
+            except Exception:
+                rollup = b""
+            try:
                 resp = self.client.call("replica_hb", rid=self.rid,
-                                        timeout=5.0)
+                                        rollup=rollup, timeout=5.0)
             except Exception:
                 fails += 1
                 if fails >= _HB_FAILS_FATAL:
@@ -311,7 +324,22 @@ class Replica:
                          name="mv-replica-serve", daemon=True).start()
 
     def _serve_op(self, req: dict) -> dict:
+        # the optional trace context is popped BEFORE dispatch so op
+        # handlers only ever see the verb's own keys; when present the
+        # dispatch span parents under the caller's client span and the
+        # merged timeline shows one tree across the process boundary
+        tctx = req.pop(flat.TRACE_KEY, None)
+        parent = (ttrace.SpanContext(int(tctx[0]), int(tctx[1]))
+                  if tctx else None)
         op = req.get("op")
+        t0 = time.perf_counter()
+        with ttrace.span(f"replica.{op}", parent=parent, cat="server"):
+            try:
+                return self._dispatch_op(op, req)
+            finally:
+                self._d_serve.observe(time.perf_counter() - t0)
+
+    def _dispatch_op(self, op, req: dict) -> dict:
         if op == "lookup":
             ids = req.get("ids")
             tid = int(req["table_id"])
@@ -331,6 +359,12 @@ class Replica:
         if op == "unpin":
             self.store.unpin(int(req["version"]))
             return {"ok": True}
+        if op == "trace_dump":
+            # this process's span buffer as Chrome trace JSON text —
+            # the fleet merge CLI stitches several of these into one
+            # wall-clock timeline. JSON (not flat values): the dump is
+            # an offline artifact, not a hot-path payload.
+            return {"trace_json": json.dumps(ttrace.to_chrome_trace())}
         CHECK(False, f"replica serve: unknown op {op!r}")
 
     def status(self) -> dict:
@@ -375,23 +409,29 @@ class ReplicaClient:
                 pass
 
     def _call(self, timeout: float = 30.0, **req) -> dict:
-        with self._lock:
-            resp = None
-            for attempt in (0, 1):
-                if self._sock is None:
-                    self._sock = socket.create_connection(
-                        (self.host, self.port), timeout=timeout)
-                try:
-                    self._sock.settimeout(timeout)
-                    _send_flat(self._sock, req)
-                    resp = _recv_flat(self._sock)
-                    break
-                except (ConnectionError, OSError):
-                    # server restarted / idle stream dropped: one
-                    # fresh-connection retry, then the error is real
-                    self.close()
-                    if attempt:
-                        raise
+        with ttrace.span(f"replica.{req.get('op')}", cat="client") as ctx:
+            if ctx is not None:
+                # trace context rides the frame as an OPTIONAL dict
+                # entry — when tracing is off the key is absent and the
+                # encoded frame stays byte-identical to pre-round-22
+                req[flat.TRACE_KEY] = [ctx.trace_id, ctx.span_id]
+            with self._lock:
+                resp = None
+                for attempt in (0, 1):
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            (self.host, self.port), timeout=timeout)
+                    try:
+                        self._sock.settimeout(timeout)
+                        _send_flat(self._sock, req)
+                        resp = _recv_flat(self._sock)
+                        break
+                    except (ConnectionError, OSError):
+                        # server restarted / idle stream dropped: one
+                        # fresh-connection retry, then the error is real
+                        self.close()
+                        if attempt:
+                            raise
         err = resp.get("err") if isinstance(resp, dict) else None
         if err is not None:
             raise RuntimeError(
@@ -421,6 +461,12 @@ class ReplicaClient:
     def unpin(self, version: int) -> None:
         self._call(op="unpin", version=int(version))
 
+    def trace_dump(self) -> dict:
+        """The server process's Chrome trace object (run the replica
+        with ``--trace``; merge several with ``python -m
+        multiverso_tpu.telemetry.fleet --trace``)."""
+        return json.loads(self._call(op="trace_dump")["trace_json"])
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
@@ -449,10 +495,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(-mv_compress) in this reader; lookup rows "
                         "compress only for tables named in "
                         "--compress-lossy")
+    p.add_argument("--trace", action="store_true",
+                   help="arm -trace span recording in this reader; "
+                        "fetch the buffer with the trace_dump serve op "
+                        "and stitch dumps with python -m "
+                        "multiverso_tpu.telemetry.fleet --trace")
     p.add_argument("--compress-lossy", default="",
                    help="comma-separated table ids (or 'all') whose "
                         "serve rows may ride the lossy bf16 codec "
                         "(-mv_compress_lossy)")
+    p.add_argument("--chaos-spec", default="",
+                   help="arm -chaos_spec fault injection in this "
+                        "reader only (fleet drills: serving.delay:1@"
+                        "0.05 makes THIS replica the deterministic "
+                        "p99 outlier the /fleet attribution must name)")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="-chaos_seed for the reader's injector streams")
     args = p.parse_args(argv)
     # the whole point of this tier: a reader must never pay the jax
     # import (or its device bootstrap) — if this trips, some module on
@@ -464,10 +522,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     CHECK(host and port_s.isdigit(),
           f"--addr must be host:port, got {args.addr!r}")
     SetCMDFlag("mv_serving_keep", args.keep)
+    if args.trace:
+        SetCMDFlag("trace", True)
     if args.compress:
         SetCMDFlag("mv_compress", True)
     if args.compress_lossy:
         SetCMDFlag("mv_compress_lossy", args.compress_lossy)
+    if args.chaos_spec:
+        SetCMDFlag("chaos_spec", args.chaos_spec)
+        SetCMDFlag("chaos_seed", args.chaos_seed)
     rep = Replica(host, int(port_s), mode=args.mode,
                   serve_port=args.serve_port,
                   ring_bytes=args.ring_bytes, lease_s=args.lease)

@@ -124,6 +124,10 @@ class ServingFrontend:
         self._t_dispatch = tmetrics.counter("serving.dispatches")
         self._t_batch = tmetrics.histogram("serving.batch_size")
         self._t_latency = tmetrics.histogram("serving.latency_s")
+        # round 22 — the same latencies into the MERGEABLE digest the
+        # fleet rollup ships (the histogram stays: /perf reads it);
+        # eager so /fleet's serving family scrapes from plane start
+        self._d_latency = tmetrics.digest("digest.serving.latency_s")
         self._t_age = tmetrics.gauge("serving.snapshot_age_s")
 
     # -- caller side --------------------------------------------------------
@@ -324,6 +328,7 @@ class ServingFrontend:
         now = time.perf_counter()
         for _, _, _, ticket in batch:
             self._t_latency.observe(now - ticket.enq_t)
+            self._d_latency.observe(now - ticket.enq_t)
         latest = self._store.get(None) if self._store.live_versions() \
             else None
         if latest is not None:

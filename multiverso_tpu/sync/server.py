@@ -730,6 +730,11 @@ class Server(Actor):
         # the <=2% phase-stamp budget on the blocking round)
         self._t_phase = {p: tmetrics.histogram(f"engine.phase.{p}_s")
                          for p in ENGINE_PHASES}
+        #: round 22 fleet digest: whole-window seconds (phase totals),
+        #: merged across ranks via the heartbeat rollups so /fleet can
+        #: quote a fleet-wide window p99. Handle cached like _t_phase —
+        #: a per-window registry get would bill the 2% budget.
+        self._d_window = tmetrics.digest("digest.engine.window_s")
         self._t_apply_fam = {
             fam: tmetrics.histogram(f"engine.apply.table_s.{fam}")
             for fam in _TABLE_FAMILIES}
@@ -1017,6 +1022,7 @@ class Server(Actor):
             # gauge only moves when the binding phase CHANGES)
             if apply_s > 0.0:
                 self._t_phase["apply"].observe(apply_s)
+                self._d_window.observe(apply_s)
                 if self.last_binding_phase != "apply":
                     self.last_binding_phase = "apply"
                     self._t_binding.set(
@@ -1038,6 +1044,9 @@ class Server(Actor):
         for name, secs in durs.items():
             if secs > 0.0:
                 self._t_phase[name].observe(secs)
+        # window total for the fleet digest: exchange already contains
+        # its wait portion, so the wait is not added again
+        self._d_window.observe(sum(durs.values()) - durs["exchange_wait"])
         # local binding proxy: the phase that dominated this window's
         # wall locally (exchange_wait stands in for "a peer bound us")
         cand = {k: v for k, v in durs.items() if k != "exchange"}

@@ -352,12 +352,16 @@ class MultiCall:
     per-member view). Chaos rehearsal worlds that need transparent
     retries should keep issuing single verbs."""
 
-    __slots__ = ("_waiter", "_results", "_n")
+    __slots__ = ("_waiter", "_results", "_n", "_t0")
 
     def __init__(self, n_tracked: int, n_members: int):
         self._waiter = Waiter(n_tracked) if n_tracked else None
         self._results: list = [None] * n_members
         self._n = n_members
+        #: round 22: submission stamp for the worker round-trip digest
+        #: (digest.worker.rtt_s) — observed once, at the first Wait
+        #: that sees every tracked reply in
+        self._t0 = time.perf_counter() if n_tracked else None
 
     def _member_cb(self, idx: int):
         def _on_reply(msg) -> None:
@@ -376,6 +380,10 @@ class MultiCall:
             if not self._waiter.Wait(timeout):
                 fdeadline.raise_deadline(
                     f"multi-verb batch replies ({self._n} members)")
+            if self._t0 is not None:
+                tmetrics.digest("digest.worker.rtt_s").observe(
+                    time.perf_counter() - self._t0)
+                self._t0 = None
         if not return_exceptions:
             for r in self._results:
                 if isinstance(r, Exception):

@@ -50,12 +50,24 @@ The watchdog plane (round 13) adds two more:
   in ``alert.<rule>`` counters + flight events, and as the /healthz
   ``warn`` status.
 
+The fleet plane (round 22) adds one more:
+
+* ``fleet`` — mergeable-digest rollups piggybacked on the lease
+  heartbeats that already flow (``replica_hb`` for readers, the
+  elastic member heartbeat for trainer ranks), folded coordinator-side
+  into the ``/fleet`` ops document (per-member QPS/p50/p99, staleness,
+  "slowest member by p99"), three fleet watchdog rules, and the
+  ``python -m multiverso_tpu.telemetry.fleet --trace`` multi-dump
+  trace merge CLI. Zero new connections, zero collectives.
+
 Importing this package registers every telemetry flag (``-telemetry``,
 ``-trace``, ``-stats_interval_s``, ``-mv_flight_events``,
-``-mv_diag_dir``, ``-mv_ops_port``, ``-mv_watchdog_s``) so ``MV_Init``
-argv parsing claims them.
+``-mv_diag_dir``, ``-mv_ops_port``, ``-mv_watchdog_s``,
+``-mv_fleet_stale_s``, ``-mv_fleet_p99_s``) so ``MV_Init`` argv
+parsing claims them.
 """
 
 from multiverso_tpu.telemetry import (export, flight,  # noqa: F401
                                       metrics, ops, trace)
 from multiverso_tpu.telemetry import accounting, watchdog  # noqa: F401,E402
+from multiverso_tpu.telemetry import fleet  # noqa: F401,E402

@@ -33,7 +33,7 @@ def _compact(snap: dict) -> dict:
     is for humans tailing a log, not for re-aggregation."""
     out = {}
     for name, rec in snap.items():
-        if rec.get("type") == "histogram":
+        if rec.get("type") in ("histogram", "digest"):
             rec = {k: (round(v, 6) if isinstance(v, float) else v)
                    for k, v in rec.items() if k != "buckets"}
         out[name] = rec

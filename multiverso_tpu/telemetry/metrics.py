@@ -1,4 +1,5 @@
-"""Typed metrics registry: Counter / Gauge / log-bucketed Histogram.
+"""Typed metrics registry: Counter / Gauge / log-bucketed Histogram /
+mergeable Digest.
 
 Prometheus-style instruments for the runtime's hot paths, designed
 around the two constraints the Dashboard already solved partially:
@@ -51,7 +52,8 @@ N_BUCKETS = 64
 _MIN_EXP = -20
 #: fixed vector widths per instrument kind — the cross-host merge
 #: contract (every rank derives the same layout from (name, kind))
-_WIDTHS = {"c": 1, "g": 1, "m": 1, "h": N_BUCKETS + 2}
+_WIDTHS = {"c": 1, "g": 1, "m": 1, "h": N_BUCKETS + 2,
+           "d": N_BUCKETS + 4}
 
 
 
@@ -249,9 +251,144 @@ class Histogram:
         return out
 
 
+class Digest:
+    """Mergeable latency/size digest (round 22): a Histogram's bucket
+    ladder plus exact min/max, built so two digests from DIFFERENT
+    processes combine into the digest of the combined stream without
+    any loss beyond the ladder itself.
+
+    Vector layout (width ``N_BUCKETS + 4``): ``[count, sum, min, max,
+    b0..b63]``. The merge is elementwise — count/sum/buckets add,
+    min takes the min, max the max — which makes it exact (the merged
+    vector equals the vector a single digest would have built from the
+    concatenated stream), hence associative and commutative; the fleet
+    accumulator relies on that to fold rollups in arrival order.
+
+    Quantiles interpolate inside the winning ladder bucket (<= one
+    octave of relative error, same bound as Histogram) and are then
+    CLAMPED to the exact ``[min, max]`` — so single-sample and
+    narrow-range digests report true values, not bucket upper bounds.
+    Empty digests use ``+inf/-inf`` sentinels for min/max (the merge
+    identity); they render as 0 in snapshots."""
+
+    kind = "d"
+    __slots__ = ("name", "_lock", "_count", "_sum", "_min", "_max",
+                 "_buckets")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._buckets = [0] * N_BUCKETS
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bucket_index(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._buckets[i] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _vector(self) -> List[float]:
+        with self._lock:
+            return [float(self._count), self._sum, self._min,
+                    self._max] + [float(b) for b in self._buckets]
+
+    @staticmethod
+    def empty_vector() -> List[float]:
+        """The merge identity — what an untouched digest encodes to
+        (and what absent ranks contribute in the cross-host merge)."""
+        return [0.0, 0.0, math.inf, -math.inf] + [0.0] * N_BUCKETS
+
+    @staticmethod
+    def merge_vec(a, b) -> List[float]:
+        """Exact elementwise merge of two digest vectors -> new list."""
+        CHECK(len(a) == len(b) == N_BUCKETS + 4,
+              f"digest vector width mismatch: {len(a)} vs {len(b)}")
+        out = [float(a[0]) + float(b[0]), float(a[1]) + float(b[1]),
+               min(float(a[2]), float(b[2])),
+               max(float(a[3]), float(b[3]))]
+        out.extend(float(a[i]) + float(b[i])
+                   for i in range(4, N_BUCKETS + 4))
+        return out
+
+    def merge(self, other: "Digest") -> "Digest":
+        """Pure combine: a NEW digest holding both streams."""
+        merged = Digest(self.name)
+        vec = Digest.merge_vec(self._vector(), other._vector())
+        merged._count = int(vec[0])
+        merged._sum = vec[1]
+        merged._min = vec[2]
+        merged._max = vec[3]
+        merged._buckets = [int(b) for b in vec[4:]]
+        return merged
+
+    @staticmethod
+    def quantile(vec, q: float) -> float:
+        """Bounded-error q-quantile from a digest VECTOR: ladder
+        interpolation clamped to the exact [min, max]."""
+        count = float(vec[0])
+        if count <= 0:
+            return 0.0
+        lo, hi = float(vec[2]), float(vec[3])
+        est = Histogram.percentile(vec[4:4 + N_BUCKETS], count, q)
+        return min(max(est, lo), hi)
+
+    @staticmethod
+    def _snapshot(vec) -> dict:
+        count = float(vec[0])
+        total = float(vec[1])
+        return {
+            "type": "digest",
+            "count": int(count),
+            "sum": total,
+            "mean": total / count if count else 0.0,
+            "min": float(vec[2]) if count else 0.0,
+            "max": float(vec[3]) if count else 0.0,
+            "p50": Digest.quantile(vec, 0.50),
+            "p95": Digest.quantile(vec, 0.95),
+            "p99": Digest.quantile(vec, 0.99),
+            "buckets": {str(i): int(b)
+                        for i, b in enumerate(vec[4:4 + N_BUCKETS])
+                        if b > 0},
+        }
+
+
 _SNAPSHOTTERS = {"c": Counter._snapshot, "g": Gauge._snapshot,
-                 "m": MaxGauge._snapshot, "h": Histogram._snapshot}
-_CLASSES = {"c": Counter, "g": Gauge, "m": MaxGauge, "h": Histogram}
+                 "m": MaxGauge._snapshot, "h": Histogram._snapshot,
+                 "d": Digest._snapshot}
+_CLASSES = {"c": Counter, "g": Gauge, "m": MaxGauge, "h": Histogram,
+            "d": Digest}
+
+
+def _merge_cols(kind: str, cols):
+    """Reduce a (ranks, width) column block to one merged vector per
+    the kind's law: max-gauges take the rank max; digests merge
+    columnwise (count/sum/buckets add, min-col min, max-col max);
+    everything else sums elementwise."""
+    if kind == "m":
+        return cols.max(axis=0)
+    if kind == "d":
+        merged = cols.sum(axis=0)
+        merged[2] = cols[:, 2].min()
+        merged[3] = cols[:, 3].max()
+        return merged
+    return cols.sum(axis=0)
 
 
 class MetricsRegistry:
@@ -286,6 +423,28 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def digest(self, name: str) -> Digest:
+        return self._get(name, Digest)
+
+    def digest_vectors(self) -> Dict[str, List[float]]:
+        """{name: vector} for every registered Digest — the fleet
+        rollup's raw material. Never collective."""
+        with self._lock:
+            items = [(n, i) for n, i in self._instruments.items()
+                     if i.kind == "d"]
+        return {name: inst._vector() for name, inst in sorted(items)}
+
+    def gauge_values(self, prefixes=()) -> Dict[str, float]:
+        """{name: value} of gauges/max-gauges, optionally filtered by
+        name prefix — the fleet rollup's key-gauge read. Never
+        collective."""
+        pfx = tuple(prefixes)
+        with self._lock:
+            return {n: float(i.value)
+                    for n, i in self._instruments.items()
+                    if i.kind in ("g", "m")
+                    and (not pfx or n.startswith(pfx))}
 
     def snapshot(self) -> Dict[str, dict]:
         """LOCAL snapshot: {name: typed dict}. Never collective — safe
@@ -341,6 +500,9 @@ class MetricsRegistry:
             have = local.get(name)
             if have is not None and have[0] == kind:
                 vec.extend(have[1])
+            elif kind == "d":
+                # digest identity is NOT all-zeros: min/max sentinels
+                vec.extend(Digest.empty_vector())
             else:
                 vec.extend([0.0] * _WIDTHS[kind])
         arr = np.asarray(vec, np.float64)
@@ -358,9 +520,7 @@ class MetricsRegistry:
             kind = kinds[name]
             width = _WIDTHS[kind]
             cols = ranks[:, pos:pos + width]
-            merged = (cols.max(axis=0) if kind == "m"
-                      else cols.sum(axis=0))
-            out[name] = _SNAPSHOTTERS[kind](merged)
+            out[name] = _SNAPSHOTTERS[kind](_merge_cols(kind, cols))
             pos += width
         return out
 
@@ -386,6 +546,10 @@ def max_gauge(name: str) -> MaxGauge:
 
 def histogram(name: str) -> Histogram:
     return REGISTRY.histogram(name)
+
+
+def digest(name: str) -> Digest:
+    return REGISTRY.digest(name)
 
 
 def snapshot() -> Dict[str, dict]:

@@ -1,5 +1,5 @@
 """Ops endpoint: a flag-gated stdlib-HTTP daemon serving /metrics,
-/healthz, /flight, /perf, /alerts and /memory.
+/healthz, /flight, /perf, /alerts, /fleet and /memory.
 
 ``-mv_ops_port=N`` (default -1 = off; 0 = ephemeral, for tests and
 multi-world processes) starts one daemon thread at MV_Init running a
@@ -28,6 +28,12 @@ multi-world processes) starts one daemon thread at MV_Init running a
   multiverso_tpu/policy/): guard settings, install/revert/drain
   counts, actions under revert watch, and the bounded action history;
   says "off" while ``-mv_policy`` is unarmed.
+* ``GET /fleet`` — the coordinator-side fleet rollup (round 22,
+  telemetry/fleet.py): per-member rows (QPS, p50/p99, rollup age,
+  staleness), the fleet-merged digest quantiles, and the "slowest
+  member by p99" attribution. ALWAYS a well-formed document — before
+  any rollup arrives (or on a rank that hosts no coordinator) it is
+  the empty fleet, never a 500.
 * ``GET /memory`` — the process byte ledger (round 13,
   telemetry/accounting.py): per-table device/mirror/host placement,
   per-version snapshot retention, flight/dedup/buffer estimates, shm
@@ -109,6 +115,18 @@ def render_prometheus(snap: dict) -> str:
                 lines.append(f'{pname}_bucket{{le="{repr(le)}"}} {cum}')
             lines.append(f'{pname}_bucket{{le="+Inf"}} '
                          f'{int(rec["count"])}')
+            lines.append(f"{pname}_sum {_fmt(rec['sum'])}")
+            lines.append(f"{pname}_count {int(rec['count'])}")
+        elif kind == "digest":
+            # round 22 — mergeable digests scrape as Prometheus
+            # summaries: clamped quantiles are point estimates, not
+            # cumulative buckets (the full bucket vector rides the
+            # fleet rollup, not the text exposition)
+            lines.append(f"# TYPE {pname} summary")
+            for q in ("0.5", "0.95", "0.99"):
+                key = "p" + q[2:].ljust(2, "0")
+                lines.append(f'{pname}{{quantile="{q}"}} '
+                             f"{_fmt(rec.get(key, 0.0))}")
             lines.append(f"{pname}_sum {_fmt(rec['sum'])}")
             lines.append(f"{pname}_count {int(rec['count'])}")
     return "\n".join(lines) + "\n"
@@ -353,6 +371,11 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(twatchdog.alerts_report(),
                                            indent=1, sort_keys=True),
                            "application/json")
+            elif path == "/fleet":
+                from multiverso_tpu.telemetry import fleet as tfleet
+                self._send(200, json.dumps(tfleet.fleet_report(),
+                                           indent=1, sort_keys=True),
+                           "application/json")
             elif path == "/memory":
                 from multiverso_tpu.telemetry import accounting
                 self._send(200, json.dumps(accounting.memory_report(),
@@ -366,7 +389,7 @@ class _OpsHandler(BaseHTTPRequestHandler):
             else:
                 self._send(404, "unknown path (know /metrics /healthz "
                                 "/flight /perf /alerts /actions "
-                                "/memory)\n",
+                                "/fleet /memory)\n",
                            "text/plain")
         except Exception as exc:    # never kill the handler thread
             try:
@@ -392,7 +415,7 @@ class OpsServer:
         self._thread.start()
         Log.Info("ops endpoint serving on 127.0.0.1:%d "
                  "(/metrics /healthz /flight /perf /alerts /actions "
-                 "/memory)", self.port)
+                 "/fleet /memory)", self.port)
 
     def stop(self, join_s: float = 5.0) -> None:
         """Shut down + join BOUNDED (Zoo.Stop must never hang on a
@@ -434,6 +457,15 @@ def start_ops() -> Optional[int]:
             return _server.port
         if want < 0:
             return None
+        # round 22 — the scrape surface is a plane start too: the
+        # fleet.* families (and the trainer digest families) must show
+        # at zero on the FIRST /metrics read even when the watchdog
+        # (the other eager-registration site) stays unarmed
+        try:
+            from multiverso_tpu.telemetry import fleet as tfleet
+            tfleet.eager_register()
+        except Exception:
+            pass
         try:
             _server = OpsServer(want)
         except OSError as exc:

@@ -205,16 +205,33 @@ def to_chrome_trace() -> dict:
     """The buffered events as a Chrome trace-event object (JSON-ready)."""
     with _events_lock:
         events = list(_events)
-    return chrome_trace(events,
-                        process_names={os.getpid(): _process_label()})
+    out = chrome_trace(events,
+                       process_names={os.getpid(): _process_label()})
+    # round 22: a (wall, mono) anchor pair sampled at export time. Span
+    # timestamps are perf_counter-based (each process its own zero);
+    # the fleet trace-merge CLI (telemetry/fleet.py --trace) uses this
+    # pair to map every dump onto one wall timeline before refining the
+    # residual offset from matched client/server span pairs.
+    out["clock"] = {"wall_s": time.time(), "mono_us": _now_us(),
+                    "pid": os.getpid()}
+    return out
+
+
+#: process label for dumps/merges — stamped by set_process_label()
+#: from contexts that KNOW their identity (MV_Init on trainer ranks,
+#: Replica.start on readers). A lazy multihost.process_index() here
+#: would put device work on every dump caller's thread (the replica
+#: serve loop exports dumps — device-work-domain law).
+_PROC_LABEL = "multiverso"
+
+
+def set_process_label(label: str) -> None:
+    global _PROC_LABEL
+    _PROC_LABEL = str(label)
 
 
 def _process_label() -> str:
-    try:
-        from multiverso_tpu.parallel import multihost
-        return f"multiverso rank {multihost.process_index()}"
-    except Exception:
-        return "multiverso"
+    return _PROC_LABEL
 
 
 def dump(path: str) -> str:

@@ -50,8 +50,26 @@ straggler           sustained local proxy: per-window apply seconds
 replica_lag         a live replica subscriber sits >= N published
                     versions behind the newest snapshot (fan-out
                     stalled, ring backpressured, or the replica's
-                    apply can't keep up)
+                    apply can't keep up) — or its fleet rollup went
+                    stale, in which case the lag numbers are frozen
+                    and the rule degrades to warn instead of trusting
+                    them
+fleet_p99_breach    the COORDINATOR-side fleet-merged request p99
+                    (telemetry/fleet.py rollups) exceeds
+                    ``-mv_fleet_p99_s`` (0 disables)
+member_qps_outlier  one previously-serving fleet member's QPS fell far
+                    below its peers' mean (a chaos-delayed or wedged
+                    member drags the fleet tail)
+rollup_stale        a member's lease heartbeats still arrive but its
+                    fleet rollup stopped refreshing
+                    (``-mv_fleet_stale_s``) — frozen telemetry, named
 ==================  ====================================================
+
+The three ``fleet_*`` rules read the coordinator-side accumulator's
+sample (fleet.peek_sample(), merged into every tick's evidence) and
+HOLD everywhere else — they are the never-collective law applied to
+fleet state: aggregation happened when members PUSHED rollups on their
+lease heartbeats; the rules only read the fold.
 
 Every ``alert.*`` counter is registered EAGERLY at
 :func:`start_watchdog` (the PR 6 rule) so the whole rule family scrapes
@@ -66,6 +84,7 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from multiverso_tpu.telemetry import accounting
+from multiverso_tpu.telemetry import fleet as tfleet
 from multiverso_tpu.telemetry import flight as tflight
 from multiverso_tpu.telemetry import metrics as tmetrics
 from multiverso_tpu.utils.configure import GetFlag, MV_DEFINE_double
@@ -75,7 +94,8 @@ MV_DEFINE_double("mv_watchdog_s", 0.0,
                  "watchdog tick interval: evaluate the typed online "
                  "alert rules (shard imbalance, shm backpressure, "
                  "apply-pool saturation, mailbox/memory growth, "
-                 "snapshot staleness, straggler proxy, replica lag) "
+                 "snapshot staleness, straggler proxy, replica lag, "
+                 "fleet p99 breach / QPS outlier / rollup staleness) "
                  "every N seconds "
                  "over LOCAL instruments only, with fire/clear "
                  "hysteresis; alerts surface at /alerts, in "
@@ -338,6 +358,16 @@ class ReplicaLagRule(Rule):
         subs = cur.get("replica_subscribers")
         if not subs:
             return HOLD      # plane off / nobody subscribed
+        # round 22 — the rollup staleness stamp outranks the lag
+        # numbers: a subscriber whose lease heartbeats still arrive but
+        # whose fleet rollup stopped refreshing is reporting FROZEN
+        # telemetry, so the rule degrades to warn naming that instead
+        # of trusting (or HOLDing on) numbers that cannot move
+        age = cur.get("replica_rollup_age_max_s")
+        if age is not None and age > tfleet.stale_s():
+            return (f"a replica's telemetry rollup is {age:.1f}s stale "
+                    f"(> {tfleet.stale_s():.1f}s) — its lag numbers "
+                    f"are frozen, not trustworthy")
         lag = cur.get("replica_lag_versions", 0)
         if lag >= self.max_lag:
             return (f"a live replica is {int(lag)} published versions "
@@ -398,11 +428,108 @@ class StragglerRule(Rule):
         return None
 
 
+class FleetP99BreachRule(Rule):
+    """COORDINATOR-side: the fleet-merged request p99 (folded from the
+    rollups members pushed on their lease heartbeats) exceeds the
+    ``-mv_fleet_p99_s`` budget. HOLDs on every rank that accumulated
+    no rollups and while the flag is 0 (no budget, no verdict)."""
+
+    name = "fleet_p99_breach"
+
+    def __init__(self, threshold_s: Optional[float] = None):
+        self.threshold_s = threshold_s      # None: read the flag live
+
+    def check(self, history):
+        cur = history[-1]
+        p99 = cur.get("fleet_p99_s")
+        if p99 is None:
+            return HOLD      # no accumulator here / no rollups yet
+        thr = self.threshold_s
+        if thr is None:
+            try:
+                thr = float(GetFlag("mv_fleet_p99_s"))
+            except Exception:
+                thr = 0.0
+        if thr <= 0:
+            return HOLD      # unbudgeted: the rule is disarmed
+        if p99 >= thr:
+            return (f"fleet-merged request p99 {1e3 * p99:.2f}ms >= "
+                    f"{1e3 * thr:.2f}ms budget across "
+                    f"{int(cur.get('fleet_members', 0))} member(s)")
+        return None
+
+
+class MemberQpsOutlierRule(Rule):
+    """COORDINATOR-side: one PREVIOUSLY-SERVING member's QPS fell far
+    below its peers' mean — the live tripwire for a chaos-delayed or
+    wedged member dragging the fleet tail. Members that never served a
+    request (ops == 0 — e.g. a trainer rank in a replica-serving
+    fleet) are not candidates: a role that serves nothing is not an
+    outlier among roles that do. HOLDs while fewer than two members
+    serve or the fleet is near-idle (an idle fleet's QPS spread is
+    noise, not evidence)."""
+
+    name = "member_qps_outlier"
+
+    def __init__(self, frac: float = 0.25, min_peer_qps: float = 5.0):
+        self.frac = frac
+        self.min_peer_qps = min_peer_qps
+
+    def check(self, history):
+        cur = history[-1]
+        qps = cur.get("fleet_member_qps")
+        ops = cur.get("fleet_member_ops", {})
+        if not qps:
+            return HOLD
+        serving = {m: q for m, q in qps.items() if ops.get(m, 0) > 0}
+        if len(serving) < 2:
+            return HOLD
+        total = sum(serving.values())
+        worst = min(serving, key=serving.get)
+        peers_mean = (total - serving[worst]) / (len(serving) - 1)
+        if peers_mean < self.min_peer_qps:
+            return HOLD      # near-idle fleet: spread is noise
+        if serving[worst] < self.frac * peers_mean:
+            return (f"member {worst} serves {serving[worst]:.1f} qps "
+                    f"vs a {peers_mean:.1f} qps peer mean over "
+                    f"{len(serving) - 1} peer(s) "
+                    f"(< {100 * self.frac:.0f}%)")
+        return None
+
+
+class RollupStaleRule(Rule):
+    """COORDINATOR-side: a member's lease heartbeats still arrive (it
+    is in the fold) but its fleet rollup stopped refreshing past
+    ``-mv_fleet_stale_s`` — every number it contributes to /fleet is
+    frozen. Named per member so the operator knows WHOSE telemetry to
+    distrust."""
+
+    name = "rollup_stale"
+
+    def __init__(self, stale_s: Optional[float] = None):
+        self.stale_s = stale_s              # None: read the flag live
+
+    def check(self, history):
+        cur = history[-1]
+        ages = cur.get("fleet_rollup_ages_s")
+        if not ages:
+            return HOLD
+        limit = (self.stale_s if self.stale_s is not None
+                 else tfleet.stale_s())
+        worst = max(ages, key=ages.get)
+        if ages[worst] > limit:
+            return (f"member {worst} rollup is {ages[worst]:.1f}s "
+                    f"stale (> {limit:.1f}s) — its fleet numbers are "
+                    f"frozen")
+        return None
+
+
 def default_rules() -> List[Rule]:
     return [ShardImbalanceRule(), ShmBackpressureRule(),
             ApplyPoolSaturationRule(), MailboxBacklogRule(),
             SnapshotStaleRule(), MemoryGrowthRule(), StragglerRule(),
-            ReplicaLagRule()]
+            ReplicaLagRule(), FleetP99BreachRule(),
+            MemberQpsOutlierRule(), RollupStaleRule()]
 
 
 def refresh_saturation_gauges() -> None:
@@ -500,6 +627,14 @@ def collect_sample() -> dict:
             sample.update(rsample)
     except Exception:
         pass
+    # round 22 — the fleet accumulator's fold: non-empty only on the
+    # coordinator-hosting process (everywhere else the fleet rules
+    # HOLD). Reading the fold is local by construction — the pushes
+    # happened on member heartbeats, not here.
+    try:
+        sample.update(tfleet.peek_sample())
+    except Exception:
+        pass
     try:
         rep = accounting.refresh()
         # the growth rule watches components that CAN grow without
@@ -542,9 +677,11 @@ class Watchdog:
         self._tick_listeners: List = []
         self._t_ticks = tmetrics.counter("watchdog.ticks")
         # EAGER registration (the PR 6 rule): the whole alert family
-        # scrapes at zero from the first /metrics read
+        # scrapes at zero from the first /metrics read — the fleet
+        # plane's always-on families ride the same moment
         for r in self.rules:
             tmetrics.counter(f"alert.{r.name}")
+        tfleet.eager_register()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

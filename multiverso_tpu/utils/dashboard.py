@@ -180,6 +180,11 @@ class Dashboard:
             lines += replica.status_lines()
         except Exception:       # pragma: no cover - replica torn down
             pass
+        try:
+            from multiverso_tpu.telemetry import fleet
+            lines += fleet.status_lines()
+        except Exception:       # pragma: no cover - telemetry torn down
+            pass
         lines += cls._ops_lines()
         out = "\n".join(lines)
         for line in lines:

@@ -115,6 +115,11 @@ class Zoo:
                 requested_engine_channels
             multihost.maybe_install_wire(requested_engine_channels())
         rank = multihost.process_index() if self._multihost else 0
+        # stamp the trace-dump process label HERE, where the identity
+        # is known on the app thread — dump callers (including the
+        # replica serve loop) must never reach device work for it
+        from multiverso_tpu.telemetry import trace as ttrace
+        ttrace.set_process_label(f"multiverso rank {rank}")
         self.node = Node(rank=rank, role=role,
                          worker_id=0 if role & Role.WORKER else -1,
                          server_id=0 if role & Role.SERVER else -1)
@@ -218,6 +223,12 @@ class Zoo:
         # later MV_Init world starts from a fresh plane
         from multiverso_tpu.serving import shutdown_plane
         shutdown_plane()
+        # fleet fold last among the telemetry planes: everything that
+        # pushed rollups into it (replica hb, elastic member hb, the
+        # roster poll) is down, and the next world must start from an
+        # EMPTY fleet — a surviving member would age into rollup_stale
+        from multiverso_tpu.telemetry import fleet as _fleet
+        _fleet.shutdown_plane()
         # one-flag postmortem: with -mv_diag_dir set, every world leaves
         # its flight ring + telemetry sidecar + span trace on disk at
         # teardown (failure paths already dumped the ring mid-flight)

@@ -1,0 +1,21 @@
+"""Fixture twin of the fleet plane: rollup build + coordinator fold
+are never-collective roots (they run on heartbeat daemons and RPC
+handler threads)."""
+
+
+def decode_rollup(blob):
+    return {"member": str(blob), "digests": {}}
+
+
+def build_rollup(member, role):
+    return {"member": member, "role": role, "digests": {}}
+
+
+class FleetAccumulator:
+    def __init__(self):
+        self.members = {}
+
+    def ingest(self, blob):
+        rollup = decode_rollup(blob)
+        self.members[rollup["member"]] = rollup
+        return True

@@ -344,6 +344,24 @@ class TestFixtureCatches:
                     if f.rule == "never-collective"
                     and f.path.startswith("replica/")]
 
+    def test_never_collective_catches_fleet_roots(self, results):
+        """The round-22 roots: a fleet rollup build reaching a
+        collective (seeded host_barrier in bad/telemetry/fleet.py)
+        is a finding — the rollup runs on lease heartbeat daemons,
+        where a collective deadlocks the beat against the engine
+        stream. The clean twin passes."""
+        bad_res, clean_res = results
+        hits = [f for f in bad_res.findings
+                if f.rule == "never-collective"
+                and f.path == "telemetry/fleet.py"]
+        assert hits, sorted({f.path for f in bad_res.findings})
+        assert any("build_rollup" in f.message
+                   and "parallel/multihost.py:host_barrier" in f.message
+                   for f in hits), [f.render() for f in hits]
+        assert not [f for f in clean_res.findings
+                    if f.rule == "never-collective"
+                    and f.path == "telemetry/fleet.py"]
+
     def test_policy_fixture_is_gated_from_day_one(self, results):
         """Round 20: the policy plane's thread is inventoried and its
         domain is blocking-restricted — the seeded UNBOUNDED wait in
@@ -507,6 +525,10 @@ class TestWholePackageBaseline:
             "replica serve loop": "replica/replica.py:_LookupHandler.handle",
             "replica fan-out thread":
                 "replica/publisher.py:ReplicaPublisher._run",
+            # round 22 — the fleet plane's two legs
+            "fleet rollup build": "telemetry/fleet.py:build_rollup",
+            "fleet coordinator fold":
+                "telemetry/fleet.py:FleetAccumulator.ingest",
         }
         for label, node in conventions.items():
             assert node in DEFAULT_ROOTS, label
@@ -1192,6 +1214,12 @@ class TestScannedCoveragePins:
         # wire-plane set (its enable predicates are hot-zone defs)
         for checker in res.checkers:
             assert "parallel/compress.py" in checker.scanned
+        # round 22 — the fleet plane module is scanned (its rollup
+        # build/fold run on daemon and RPC threads) and its fixture
+        # mirror exists in the package
+        for checker in res.checkers:
+            assert "telemetry/fleet.py" in checker.scanned
+        assert "telemetry/fleet.py" in all_rels
 
 
 class TestMvlintEntryPoint:

@@ -87,6 +87,11 @@ GUARDED_CEIL = {
     # slack only absorbs codec framing tweaks, not noise
     "compress_fanout_bytes_pct": 1.3,
     "compress_bytes_per_window": 1.1,
+    # round 22 — the fleet rollup blob that rides every lease heartbeat
+    # is near-deterministic (4 digest vectors + a handful of gauges
+    # through the sealed flat codec); the slack absorbs a gauge or two
+    # joining the _GAUGE_PREFIXES set, not unbounded telemetry growth
+    "fleet_rollup_bytes_per_hb": 1.5,
 }
 
 #: metrics that must read EXACTLY ZERO in the latest artifact (round

@@ -1,0 +1,739 @@
+"""Fleet observability plane (round 22): digest merge laws, rollup
+codec + coordinator fold, fleet watchdog rules, cross-wire trace
+propagation and the multi-dump trace merge CLI — plus the 2-proc +
+2-replica acceptance drill where a chaos-delayed reader must be NAMED
+by the /fleet p99 attribution and the fleet_p99_breach rule.
+
+Layering mirrors the plane:
+
+* Digest units — the merge must be EXACT (digest-of-merged-streams ==
+  merge-of-digests, associative, commutative, empty identity) and the
+  quantile must stay inside the ladder's factor-2 envelope on
+  adversarial shapes;
+* rollup units — build/encode/decode round trip through the sealed
+  flat codec, foreign blobs count errors instead of raising, QPS is an
+  arrival-stamped counter delta, staleness is explicit;
+* rule units — the three fleet rules over synthetic watchdog history;
+* wire units — the optional trace-ctx tag leaves untagged frames
+  BYTE-IDENTICAL (the acceptance bit), spans parent across the tag;
+* the merge CLI over deterministic synthetic dumps (known clock
+  anchors -> known shift, known skew -> known correction);
+* live single-process + the 2-proc drill.
+"""
+
+import json
+import math
+import os
+import signal
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.parallel import flat
+from multiverso_tpu.telemetry import fleet
+from multiverso_tpu.telemetry import metrics
+from multiverso_tpu.telemetry import trace as ttrace
+from multiverso_tpu.telemetry.watchdog import (
+    HOLD, FleetP99BreachRule, MemberQpsOutlierRule, RollupStaleRule)
+from multiverso_tpu.utils.configure import SetCMDFlag
+
+from tests.test_multihost import run_two_process
+
+D = metrics.Digest
+
+
+def _digest_of(values, name="t"):
+    d = D(name)
+    for v in values:
+        d.observe(v)
+    return d._vector()
+
+
+# -- digest merge laws ---------------------------------------------------
+
+
+class TestDigestMerge:
+    def test_observe_tracks_exact_count_sum_min_max(self):
+        vec = _digest_of([0.25, 4.0, 0.5])
+        assert vec[0] == 3.0
+        assert vec[1] == 4.75
+        assert vec[2] == 0.25 and vec[3] == 4.0
+
+    def test_merge_equals_digest_of_concatenated_stream(self):
+        # binary-exact values (k/1024) keep float sums order-invariant,
+        # so the law holds to the BIT, not within a tolerance
+        rng = np.random.default_rng(0)
+        xs = (rng.integers(1, 4096, 200) / 1024.0).tolist()
+        ys = (rng.integers(1, 4096, 300) / 1024.0).tolist()
+        merged = D.merge_vec(_digest_of(xs), _digest_of(ys))
+        assert merged == _digest_of(xs + ys)
+
+    def test_merge_is_associative_and_commutative(self):
+        rng = np.random.default_rng(1)
+        a, b, c = (_digest_of((rng.integers(1, 4096, n) / 1024.0)
+                              .tolist())
+                   for n in (50, 80, 10))
+        assert D.merge_vec(a, b) == D.merge_vec(b, a)
+        assert (D.merge_vec(D.merge_vec(a, b), c)
+                == D.merge_vec(a, D.merge_vec(b, c)))
+
+    def test_empty_vector_is_merge_identity(self):
+        vec = _digest_of([0.125, 3.0, 7.5])
+        assert D.merge_vec(vec, D.empty_vector()) == vec
+        assert D.merge_vec(D.empty_vector(), vec) == vec
+        assert D.merge_vec(D.empty_vector(), D.empty_vector()) \
+            == D.empty_vector()
+
+    def test_quantile_factor2_bound_on_adversarial_shapes(self):
+        rng = np.random.default_rng(2)
+        shapes = {
+            "constant": np.full(500, 0.37),
+            # straddles ladder bucket edges exactly
+            "ladder": 2.0 ** rng.integers(-12, 4, 800).astype(float),
+            "bimodal": np.concatenate([np.full(600, 0.001),
+                                       np.full(400, 1.0)]),
+            "lognormal": rng.lognormal(-6.0, 2.0, 1000),
+        }
+        for name, vals in shapes.items():
+            vec = _digest_of(vals.tolist())
+            for q in (0.5, 0.9, 0.99):
+                exact = float(np.quantile(vals, q))
+                est = D.quantile(vec, q)
+                assert exact / 2 * (1 - 1e-9) <= est \
+                    <= exact * 2 * (1 + 1e-9), (
+                        f"{name} q={q}: est {est} vs exact {exact}")
+        # the constant stream clamps to the exact value, no ladder error
+        assert D.quantile(_digest_of([0.37] * 9), 0.99) == 0.37
+
+    def test_edges_empty_single_overflow(self):
+        assert D.quantile(D.empty_vector(), 0.5) == 0.0
+        # one sample: the [min, max] clamp collapses to the exact value
+        assert D.quantile(_digest_of([0.0123]), 0.5) == 0.0123
+        # beyond the ladder top: clamps to the exact max, not the
+        # last bucket's bound
+        big = 1e20
+        vec = _digest_of([big, big])
+        assert D.quantile(vec, 0.99) == big
+        # and merging overflow with normal keeps the exact extremes
+        m = D.merge_vec(vec, _digest_of([0.5]))
+        assert m[2] == 0.5 and m[3] == big
+
+
+# -- rollup codec + accumulator ------------------------------------------
+
+
+def _mk_rollup(member, ops, role="replica"):
+    return {"v": fleet.ROLLUP_V, "member": member, "role": role,
+            "ops": float(ops), "digests": {}, "gauges": {}}
+
+
+class TestRollup:
+    def setup_method(self):
+        metrics._reset_for_tests()
+
+    def teardown_method(self):
+        metrics._reset_for_tests()
+
+    def test_round_trip_through_sealed_flat_codec(self):
+        for v in (0.001, 0.002, 0.004):
+            metrics.digest("digest.worker.rtt_s").observe(v)
+        for _ in range(5):
+            metrics.digest("digest.engine.window_s").observe(0.01)
+        r = fleet.build_rollup("rank3", "trainer")
+        # ops counts ONLY the request-shaped families: the window
+        # digest rides the rollup but a window is not a request
+        assert r["ops"] == 3.0
+        got = fleet.decode_rollup(fleet.encode_rollup(r))
+        assert got["v"] == fleet.ROLLUP_V
+        assert got["member"] == "rank3" and got["role"] == "trainer"
+        assert got["ops"] == 3.0
+        assert set(got["digests"]) == set(r["digests"])
+        for name, vec in r["digests"].items():
+            assert got["digests"][name] == [float(x) for x in vec], name
+        # a few hundred bytes, not a second telemetry wire
+        assert len(fleet.encode_rollup(r)) < 4096
+
+    def test_foreign_blobs_count_errors_and_never_raise(self):
+        acc = fleet.FleetAccumulator()
+        errs0 = metrics.counter("fleet.rollup_errors").value
+        assert acc.ingest(b"garbage") is False
+        assert acc.ingest(flat.encode_frame({"v": 99})) is False
+        # well-versed but memberless: the accumulator rejects it too
+        assert acc.ingest_rollup({"v": fleet.ROLLUP_V}) is False
+        assert metrics.counter("fleet.rollup_errors").value - errs0 == 3
+        rep = acc.report()
+        assert rep["n_members"] == 0 and rep["members"] == []
+
+    def test_qps_is_arrival_stamped_counter_delta(self):
+        acc = fleet.FleetAccumulator()
+        assert acc.ingest_rollup(_mk_rollup("m", 0), now=100.0)
+        row = acc.report(now=100.0)["members"][0]
+        assert row["qps"] == 0.0        # first rollup: no interval yet
+        acc.ingest_rollup(_mk_rollup("m", 50), now=110.0)
+        row = acc.report(now=110.0)["members"][0]
+        assert row["qps"] == 5.0
+        # a counter RESET (restarted member) reads as zero, not negative
+        acc.ingest_rollup(_mk_rollup("m", 10), now=120.0)
+        assert acc.report(now=120.0)["members"][0]["qps"] == 0.0
+
+    def test_staleness_marking_and_forget(self):
+        acc = fleet.FleetAccumulator()
+        acc.ingest_rollup(_mk_rollup("m", 1), now=0.0)
+        fresh = acc.report(now=3.0)
+        assert not fresh["members"][0]["stale"]
+        assert fresh["stale_members"] == []
+        stale = acc.report(now=fresh["stale_s"] + 1.0)
+        assert stale["members"][0]["stale"]
+        assert stale["stale_members"] == ["m"]
+        assert acc.rollup_age_s("m", now=7.0) == 7.0
+        assert acc.rollup_age_s("ghost") is None
+        acc.forget("m")
+        assert acc.report(now=8.0)["n_members"] == 0
+        acc.forget("m")                 # idempotent
+
+    def test_report_merges_member_digests_exactly(self):
+        rng = np.random.default_rng(3)
+        xs = (rng.integers(1, 2048, 40) / 1024.0).tolist()
+        ys = (rng.integers(1, 2048, 60) / 1024.0).tolist()
+        acc = fleet.FleetAccumulator()
+        for member, vals in (("replica:0", xs), ("replica:1", ys)):
+            r = _mk_rollup(member, len(vals))
+            r["digests"] = {"digest.replica.serve_s": _digest_of(vals)}
+            acc.ingest_rollup(r, now=1.0)
+        rep = acc.report(now=1.0)
+        assert rep["fleet"]["count"] == 100
+        assert rep["fleet"]["count"] == sum(
+            row["count"] for row in rep["members"])
+        fam = rep["digests"]["digest.replica.serve_s"]
+        assert fam["count"] == 100.0
+        both = _digest_of(xs + ys)
+        assert fam["max"] == both[3] and fam["min"] == both[2]
+        # attribution: the member with the fatter tail binds the p99
+        worst = max(rep["members"], key=lambda r: r["p99_s"])
+        assert rep["binding_p99"]["member"] == worst["member"]
+
+
+# -- fleet watchdog rules ------------------------------------------------
+
+
+class TestFleetRules:
+    def test_p99_breach_fires_over_budget_and_holds_unbudgeted(self):
+        r = FleetP99BreachRule(threshold_s=0.05)
+        assert r.check([{}]) is HOLD                # no accumulator here
+        sample = {"fleet_p99_s": 0.049, "fleet_members": 3}
+        assert r.check([sample]) is None
+        msg = r.check([{"fleet_p99_s": 0.051, "fleet_members": 3}])
+        assert msg and "p99" in msg and "3 member" in msg
+        # flag default is 0: unbudgeted fleets never alert
+        assert FleetP99BreachRule().check(
+            [{"fleet_p99_s": 9.9}]) is HOLD
+
+    def test_qps_outlier_excludes_never_serving_members(self):
+        r = MemberQpsOutlierRule(frac=0.25, min_peer_qps=5.0)
+        assert r.check([{}]) is HOLD
+        # the idle trainer rank (ops == 0) is NOT an outlier among
+        # serving replicas
+        sample = {"fleet_member_qps": {"rank0": 0.0, "replica:0": 100.0,
+                                       "replica:1": 90.0},
+                  "fleet_member_ops": {"rank0": 0.0, "replica:0": 5000.0,
+                                       "replica:1": 4000.0}}
+        assert r.check([sample]) is None
+        # ...but a PREVIOUSLY-serving member that collapsed is named
+        sample["fleet_member_ops"]["rank0"] = 500.0
+        msg = r.check([sample])
+        assert msg and "rank0" in msg
+        # fewer than two serving members: no peer group
+        assert r.check([{"fleet_member_qps": {"a": 1.0},
+                         "fleet_member_ops": {"a": 10.0}}]) is HOLD
+        # near-idle fleet: spread is noise
+        assert r.check([{"fleet_member_qps": {"a": 0.1, "b": 1.0},
+                         "fleet_member_ops": {"a": 5.0, "b": 9.0}}]) \
+            is HOLD
+
+    def test_rollup_stale_names_the_worst_member(self):
+        r = RollupStaleRule(stale_s=5.0)
+        assert r.check([{}]) is HOLD
+        assert r.check([{"fleet_rollup_ages_s": {"a": 1.0, "b": 4.0}}]) \
+            is None
+        msg = r.check([{"fleet_rollup_ages_s": {"a": 2.0, "b": 7.0}}])
+        assert msg and "b" in msg and "frozen" in msg
+
+
+# -- empty surfaces ------------------------------------------------------
+
+
+class TestEmptyFleetSurfaces:
+    def test_empty_report_is_well_formed(self):
+        rep = fleet.FleetAccumulator().report()
+        assert rep["n_members"] == 0 and rep["members"] == []
+        assert rep["fleet"] == {"qps": 0.0, "count": 0, "p50_s": 0.0,
+                                "p95_s": 0.0, "p99_s": 0.0}
+        assert rep["binding_p99"] is None
+        assert rep["digests"] == {} and rep["stale_members"] == []
+        assert fleet.FleetAccumulator().peek_sample() == {}
+
+    def test_module_surfaces_stay_quiet_before_any_rollup(self):
+        fleet._reset_for_tests()
+        assert fleet.peek_sample() == {}
+        assert fleet.status_lines() == []
+
+    def test_fleet_route_serves_the_empty_fleet_not_a_500(self):
+        from multiverso_tpu.telemetry import ops as tops
+        mv.MV_Init(["-mv_ops_port=0"])
+        try:
+            fleet._reset_for_tests()
+            port = tops.port()
+            assert port is not None
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10).read())
+            assert body["n_members"] == 0 and body["binding_p99"] is None
+            # one pushed rollup and the same route reflects it
+            fleet.ingest(fleet.encode_rollup(
+                fleet.build_rollup("rank0", "trainer")))
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10).read())
+            assert body["n_members"] == 1
+            assert body["members"][0]["member"] == "rank0"
+        finally:
+            fleet._reset_for_tests()
+            mv.MV_ShutDown()
+
+
+# -- trace wire ----------------------------------------------------------
+
+
+@pytest.fixture
+def traced():
+    SetCMDFlag("trace", True)
+    ttrace._reset_for_tests()
+    yield
+    SetCMDFlag("trace", False)
+    ttrace._reset_for_tests()
+
+
+class TestTraceWire:
+    def test_untagged_frames_are_byte_identical_to_tracing_off(self):
+        """THE acceptance bit: the trace-ctx tag is optional, and when
+        absent the serve frame must be byte-identical to a tracing-off
+        build — flipping -trace alone may not move a single data-path
+        byte."""
+        req = {"op": "lookup", "rid": 3, "version": 7}
+        off = flat.encode_frame(req)
+        SetCMDFlag("trace", True)
+        try:
+            assert flat.encode_frame(req) == off
+        finally:
+            SetCMDFlag("trace", False)
+        # the tag, when present, is one more dict entry and strips
+        # clean on decode
+        tagged = dict(req)
+        tagged[flat.TRACE_KEY] = [7, 9]
+        frame = flat.encode_frame(tagged)
+        assert frame != off
+        got = flat.decode_frame(frame)
+        assert list(got.pop(flat.TRACE_KEY)) == [7, 9]
+        assert got == flat.decode_frame(off)
+
+    def test_server_span_parents_under_the_wire_context(self, traced):
+        with ttrace.span("replica.lookup", cat="client") as ctx:
+            assert ctx is not None
+            wire = [ctx.trace_id, ctx.span_id]
+        # ...the tag crosses the wire; the server rebuilds the parent
+        parent = ttrace.SpanContext(int(wire[0]), int(wire[1]))
+        with ttrace.span("replica.serve", parent=parent, cat="server"):
+            pass
+        evs = {e["cat"]: e for e in ttrace.to_chrome_trace()
+               ["traceEvents"] if e.get("ph") == "X"}
+        cli, srv = evs["client"], evs["server"]
+        assert srv["args"]["trace_id"] == cli["args"]["trace_id"]
+        assert srv["args"]["parent_id"] == cli["args"]["span_id"]
+
+    def test_dump_carries_the_clock_anchor(self, traced):
+        d = ttrace.to_chrome_trace()
+        assert {"wall_s", "mono_us", "pid"} <= set(d["clock"])
+        assert d["clock"]["pid"] == os.getpid()
+
+
+# -- trace merge CLI -----------------------------------------------------
+
+
+def _dump(events, wall_s, mono_us, pid, label=None):
+    evs = list(events)
+    if label:
+        evs.insert(0, {"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+    return {"traceEvents": evs, "displayTimeUnit": "ms",
+            "clock": {"wall_s": wall_s, "mono_us": mono_us, "pid": pid}}
+
+
+def _x(name, cat, ts, dur, pid, trace_id, span_id, parent_id=0):
+    return {"name": name, "cat": cat, "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": 1,
+            "args": {"trace_id": trace_id, "span_id": span_id,
+                     "parent_id": parent_id}}
+
+
+class TestTraceMergeCli:
+    # two processes whose perf_counter zeros differ by exactly 4000us
+    # (same wall clock): the client span [1000, 1100] on A and its
+    # server span [5020, 5080] on B cover the SAME wall interval
+
+    def _dumps(self, server_skew_us=0.0):
+        a = _dump([_x("replica.lookup", "client", 1000.0, 100.0, 1,
+                      7, 1)], 1.0, 1000.0, 1, label="trainer rank 0")
+        b = _dump([_x("replica.lookup", "server", 5020.0 + server_skew_us,
+                      60.0, 2, 7, 2, parent_id=1)],
+                  1.0, 5000.0, 2, label="replica r0")
+        return a, b
+
+    def test_clock_anchor_recovers_the_known_shift(self, tmp_path):
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        da, db = self._dumps()
+        pa.write_text(json.dumps(da))
+        pb.write_text(json.dumps(db))
+        out = tmp_path / "merged.json"
+        rc = fleet.main(["--trace", "-o", str(out), str(pa), str(pb)])
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        m = merged["merge"]
+        assert m["n_dumps"] == 2 and m["n_span_pairs"] == 1
+        assert m["shift_us"] == [0.0, -4000.0]
+        assert m["align_err_us"] == 0.0
+        xs = {e["cat"]: e for e in merged["traceEvents"]
+              if e.get("ph") == "X"}
+        # one timeline: the server span sits INSIDE the client span
+        assert xs["client"]["ts"] == 1000.0
+        assert xs["server"]["ts"] == 1020.0
+        # process labels survived the stitch as metadata events
+        labels = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e.get("ph") == "M"}
+        assert {"trainer rank 0", "replica r0"} <= labels
+
+    def test_span_pair_refinement_splits_residual_skew(self):
+        # the server's clock runs 200us late past the anchor: the
+        # matched pair's midpoint delta must be folded back half-half
+        da, db = self._dumps(server_skew_us=200.0)
+        merged = fleet.merge_traces([da, db])
+        m = merged["merge"]
+        assert m["correction_us"] == [100.0, -100.0]
+        assert m["align_err_us"] == 0.0
+        xs = {e["cat"]: e for e in merged["traceEvents"]
+              if e.get("ph") == "X"}
+        cli_mid = xs["client"]["ts"] + xs["client"]["dur"] / 2
+        srv_mid = xs["server"]["ts"] + xs["server"]["dur"] / 2
+        assert abs(cli_mid - srv_mid) < 1e-6
+
+    def test_cli_requires_trace_mode_and_dumps(self, tmp_path):
+        with pytest.raises(SystemExit):
+            fleet.main(["-o", str(tmp_path / "x.json")])
+        with pytest.raises(SystemExit):
+            fleet.main(["--trace"])
+
+
+# -- live single process -------------------------------------------------
+
+
+class TestFleetLiveSingleProcess:
+    def test_worker_rtt_feeds_a_rollup_and_the_fleet_line(self, mv_env):
+        from multiverso_tpu.tables import KVTableOption
+        fleet._reset_for_tests()
+        t = mv.MV_CreateTable(KVTableOption())
+        keys = np.array([1, 2], np.int64)
+        # the batched verb path is what feeds digest.worker.rtt_s (one
+        # observation per tracked MultiCall round trip)
+        mv.MV_MultiAdd([(t, {"keys": keys,
+                             "values": np.array([1.0, 2.0],
+                                                np.float32)})])
+        (got,) = mv.MV_MultiGet([(t, {"keys": keys})])
+        assert got.tolist() == [1.0, 2.0]
+        r = fleet.build_rollup("rank0", "trainer")
+        assert r["ops"] >= 1.0, "tracked MultiCall Wait fed no digest"
+        assert fleet.ingest(fleet.encode_rollup(r))
+        rep = fleet.fleet_report()
+        rows = {m["member"]: m for m in rep["members"]}
+        assert rows["rank0"]["role"] == "trainer"
+        assert rows["rank0"]["count"] >= 1
+        assert rep["fleet"]["count"] == rows["rank0"]["count"]
+        (line,) = fleet.status_lines()
+        assert line.startswith("[Fleet] members=1"), line
+        # the watchdog sample mirrors the same fold
+        sample = fleet.peek_sample()
+        assert sample["fleet_members"] == 1
+        assert sample["fleet_member_ops"]["rank0"] >= 1
+        fleet._reset_for_tests()
+
+
+# -- fleet-plane overhead guard (tier-1) ---------------------------------
+
+
+class TestFleetOverheadGuard:
+    def test_rollup_pump_overhead_within_budget(self):
+        """An AGGRESSIVE background rollup pump (build + sealed encode
+        every 10ms — ~30x the production lease-heartbeat cadence,
+        contending on the registry lock the hot path's digest observes
+        take) must cost <= max(2%, 2x measured baseline noise) on the
+        blocking host round — the flight/watchdog overhead budget
+        extended to the round-22 plane. Off/on worlds interleave with
+        best-per-side, and a failure must REPRODUCE on a second
+        independent measurement."""
+        import threading
+
+        from multiverso_tpu.tables import MatrixTableOption
+
+        k, rounds = 512, 15
+        rng = np.random.default_rng(22)
+
+        def measure(pump):
+            mv.MV_Init([])
+            stop = threading.Event()
+            thr = None
+            try:
+                if pump:
+                    def _pump():
+                        while not stop.is_set():
+                            fleet.encode_rollup(
+                                fleet.build_rollup("rank0", "trainer"))
+                            stop.wait(0.01)
+                    thr = threading.Thread(target=_pump, daemon=True)
+                    thr.start()
+                table = mv.MV_CreateTable(MatrixTableOption(
+                    num_rows=8192, num_cols=8))
+                ids = rng.choice(8192, size=k,
+                                 replace=False).astype(np.int32)
+                deltas = rng.standard_normal((k, 8)).astype(np.float32)
+                table.AddRows(ids, deltas)      # warm the jit caches
+                table.GetRows(ids)
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        table.AddRows(ids, deltas)
+                        table.GetRows(ids)
+                    best = min(best, time.perf_counter() - t0)
+            finally:
+                stop.set()
+                if thr is not None:
+                    thr.join(timeout=5)
+                mv.MV_ShutDown()
+            return best / rounds
+
+        last = None
+        for _attempt in range(2):
+            offs, ons = [], []
+            for _ in range(3):
+                offs.append(measure(False))
+                ons.append(measure(True))
+            base, on = min(offs), min(ons)
+            noise_pct = 100.0 * (max(offs) - base) / base
+            overhead_pct = 100.0 * (on - base) / base
+            allowed = max(2.0, 2.0 * noise_pct)
+            if overhead_pct <= allowed:
+                return
+            last = (f"fleet rollup pump overhead {overhead_pct:.2f}% "
+                    f"exceeds {allowed:.2f}% (baseline noise "
+                    f"{noise_pct:.2f}%; "
+                    f"off={[round(o * 1e6) for o in offs]}us, "
+                    f"on={[round(o * 1e6) for o in ons]}us per round)")
+        raise AssertionError(last)
+
+
+# -- the 2-proc + 2-replica acceptance drill -----------------------------
+
+
+_FLEET_DRILL_CHILD = r'''
+import json, os, signal, subprocess, sys, time, urllib.request
+rank, port, cport, statdir = (int(sys.argv[1]), sys.argv[2],
+                              sys.argv[3], sys.argv[4])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.tables import MatrixTableOption
+from multiverso_tpu.telemetry import fleet as tfleet
+from multiverso_tpu.telemetry import trace as ttrace
+
+mv.MV_Init([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
+            "-dist_size=2", "-mv_deadline_s=240",
+            "-mv_replica_fanout=true",
+            f"-mv_replica_addr=127.0.0.1:{cport}",
+            "-mv_ops_port=0", "-mv_watchdog_s=0.2",
+            "-mv_fleet_p99_s=0.02", "-trace=true"])
+R, C = 128, 8
+mat = mv.MV_CreateTable(MatrixTableOption(num_rows=R, num_cols=C))
+rng = np.random.default_rng(22 + rank)
+for _ in range(3):
+    sel = np.sort(rng.choice(R, 16, replace=False)).astype(np.int32)
+    mat.AddRows(sel, rng.standard_normal((16, C)).astype(np.float32))
+mv.MV_Barrier()
+v1 = mv.MV_PublishSnapshot()
+mv.MV_PinVersion(v1)
+
+DELAY = 0.03
+N_LOOKUPS = 60
+procs, clients, rids = {}, {}, {}
+if rank == 0:
+    from multiverso_tpu.replica import publisher
+    from multiverso_tpu.replica.replica import ReplicaClient
+    ep = publisher.publisher_endpoint()
+    # the "slow" reader gets a deterministic chaos stall on every serve
+    # batch: it MUST surface as the fleet's named p99 outlier
+    for name, extra in (("fast", []),
+                        ("slow", ["--chaos-spec",
+                                  f"serving.delay:1.0@{DELAY}",
+                                  "--chaos-seed", "7"])):
+        sf = os.path.join(statdir, name + ".json")
+        procs[name] = subprocess.Popen(
+            [sys.executable, "-m", "multiverso_tpu.replica.replica",
+             "--addr", ep, "--mode", "shm", "--lease", "1",
+             "--status-file", sf, "--trace"] + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for _ in range(400):
+            if os.path.exists(sf):
+                break
+            time.sleep(0.05)
+        assert os.path.exists(sf), f"replica {name} never came up"
+        st = json.load(open(sf))
+        rids[name] = st["rid"]
+        clients[name] = ReplicaClient("127.0.0.1", st["serve_port"])
+    for rc in clients.values():
+        deadline = time.time() + 30
+        while (rc.status()["latest"] or -1) < v1:
+            assert time.time() < deadline, rc.status()
+            time.sleep(0.05)
+mv.MV_Barrier()
+
+if rank == 0:
+    m_fast, m_slow = f"replica:{rids['fast']}", f"replica:{rids['slow']}"
+    ids = np.arange(32, dtype=np.int32)
+    want = mv.MV_ServingLookup(mat, ids, version=v1)
+    qps_seen = {}
+
+    def note_qps(rep):
+        for row in rep["members"]:
+            qps_seen[row["member"]] = max(
+                qps_seen.get(row["member"], 0.0), row["qps"])
+
+    for i in range(N_LOOKUPS):
+        assert np.array_equal(clients["fast"].lookup(0, ids, version=v1),
+                              want)
+        assert np.array_equal(clients["slow"].lookup(0, ids, version=v1),
+                              want)
+        if i % 10 == 9:
+            note_qps(tfleet.fleet_report())
+
+    # wait for post-load heartbeats to fold EVERY driven lookup into
+    # the merged serve digest (each reader observed its 60 serves)
+    deadline = time.time() + 25
+    rep = rows = None
+    while time.time() < deadline:
+        rep = tfleet.fleet_report()
+        rows = {r["member"]: r for r in rep["members"]}
+        note_qps(rep)
+        served = rep["digests"].get("digest.replica.serve_s",
+                                    {"count": 0})["count"]
+        if (served >= 2 * N_LOOKUPS
+                and {m_fast, m_slow, "rank0"} <= set(rows)
+                and rows[m_slow]["n_rollups"] >= 2
+                and qps_seen.get(m_slow, 0.0) > 0
+                and qps_seen.get(m_fast, 0.0) > 0):
+            break
+        time.sleep(0.1)
+
+    # membership: both readers + the fan-out owner's own rollup
+    assert {m_fast, m_slow, "rank0"} <= set(rows), sorted(rows)
+    # reconciliation: the fleet fold IS the sum of its member rows
+    # (the exact digest merge law, live)
+    assert rep["fleet"]["count"] == sum(
+        r["count"] for r in rep["members"]), rep
+    fam = rep["digests"]["digest.replica.serve_s"]
+    assert fam["count"] >= 2 * N_LOOKUPS, fam
+    # QPS flowed while the load ran (arrival-stamped deltas)
+    assert qps_seen.get(m_fast, 0.0) > 0, qps_seen
+    assert qps_seen.get(m_slow, 0.0) > 0, qps_seen
+    # the chaos-delayed reader is the named p99 outlier, inside the
+    # ladder's factor-2 envelope of the injected stall
+    assert rows[m_slow]["p99_s"] >= DELAY / 2, rows[m_slow]
+    assert rows[m_fast]["p99_s"] < rows[m_slow]["p99_s"], rows
+    assert rep["binding_p99"]["member"] == m_slow, rep["binding_p99"]
+    assert rep["fleet"]["p99_s"] >= DELAY / 2, rep["fleet"]
+    assert rep["fleet"]["p50_s"] <= rep["fleet"]["p99_s"]
+    line = tfleet.status_lines()[0]
+    assert line.startswith("[Fleet]") and f"bind={m_slow}" in line, line
+
+    # the /fleet route serves the same attribution
+    from multiverso_tpu.telemetry import ops as tops
+    body = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{tops.port()}/fleet", timeout=10).read())
+    assert body["n_members"] >= 3, body["n_members"]
+    assert body["binding_p99"]["member"] == m_slow, body["binding_p99"]
+
+    # coordinator-side verdict: the budgeted fleet p99 rule fires
+    deadline = time.time() + 15
+    names = []
+    while time.time() < deadline:
+        alerts = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{tops.port()}/alerts", timeout=10).read())
+        names = [a["rule"] for a in alerts["alerts"]]
+        if "fleet_p99_breach" in names:
+            break
+        time.sleep(0.2)
+    assert "fleet_p99_breach" in names, alerts
+
+    # live cross-process trace stitch: my client spans + the slow
+    # reader's server spans share trace_ids across the wire tag
+    mine = ttrace.to_chrome_trace()
+    theirs = clients["slow"].trace_dump()
+    merged = tfleet.merge_traces([mine, theirs])
+    mg = merged["merge"]
+    assert mg["n_dumps"] == 2 and mg["n_span_pairs"] >= 1, mg
+    assert abs(mg["align_err_us"]) < 2e5, mg
+    pids = {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert len(pids) >= 2, pids
+
+    # a frozen member leaves the fold on eviction instead of aging
+    # into every surface forever
+    procs["slow"].send_signal(signal.SIGSTOP)
+    deadline = time.time() + 30
+    members = set()
+    while time.time() < deadline:
+        members = {r["member"] for r in tfleet.fleet_report()["members"]}
+        if m_slow not in members:
+            break
+        time.sleep(0.2)
+    assert m_slow not in members, members
+    assert m_fast in members, members
+    procs["slow"].send_signal(signal.SIGCONT)
+else:
+    # the non-coordinator rank accumulated NOTHING: fleet aggregation
+    # is coordinator-side fold of pushed blobs, never a collective
+    assert tfleet.peek_sample() == {}
+    assert tfleet.status_lines() == []
+mv.MV_Barrier()
+for p in procs.values():
+    p.terminate()
+    p.wait(timeout=10)
+mv.MV_ShutDown()
+print(f"child {rank} FLEET DRILL OK", flush=True)
+'''
+
+
+class TestFleetDrill:
+    def test_chaos_delayed_reader_is_named_fleet_wide(self, tmp_path):
+        """2-proc trainer + 2 shm readers, one with a deterministic
+        30ms chaos serve stall: /fleet must reconcile counts/QPS/p99
+        against the driven load and NAME the delayed reader — in the
+        binding_p99 attribution, the [Fleet] line, and the
+        fleet_p99_breach verdict."""
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        cport = s.getsockname()[1]
+        s.close()
+        run_two_process(_FLEET_DRILL_CHILD, tmp_path, str(cport),
+                        str(tmp_path), expect="FLEET DRILL OK")

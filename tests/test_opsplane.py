@@ -208,7 +208,8 @@ _VALUE = r"[-+]?(?:\d+(?:\.\d+)?(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?)"
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
 _LABELS = (r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\""
            r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\")*\}")
-_TYPE_RE = re.compile(rf"^# TYPE {_NAME} (?:counter|gauge|histogram)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE {_NAME} (?:counter|gauge|histogram|summary)$")
 _HELP_RE = re.compile(rf"^# HELP {_NAME} .*$")
 _SAMPLE_RE = re.compile(rf"^({_NAME})(?:{_LABELS})? {_VALUE}$")
 

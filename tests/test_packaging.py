@@ -60,6 +60,10 @@ class TestWheel:
         # SAME wheel)
         for mod in ("__init__", "delta", "publisher", "replica"):
             assert f"multiverso_tpu/replica/{mod}.py" in names, names
+        # ...and the round-22 fleet plane: the rollup/trace-merge module
+        # ships with the same wheel (replica readers build rollups)
+        for mod in ("fleet", "trace", "metrics"):
+            assert f"multiverso_tpu/telemetry/{mod}.py" in names, names
 
     def test_seal_verify_path_is_jax_free(self):
         """Round 19: the versioned seal (parallel/seal.py) + flat frame
@@ -108,6 +112,12 @@ class TestWheel:
             "assert 'jax' not in sys.modules, 'jax entered the import "
             "graph'\n"
             "assert hasattr(rr, 'Replica') and hasattr(rr, 'main')\n"
+            "from multiverso_tpu.telemetry import fleet\n"
+            "blob = fleet.encode_rollup(fleet.build_rollup('replica:0',"
+            " 'replica'))\n"
+            "assert fleet.decode_rollup(blob)['member'] == 'replica:0'\n"
+            "assert 'jax' not in sys.modules, 'jax entered the fleet "
+            "rollup path'\n"
             "import numpy\n"
             "print('REPLICA-JAXFREE-OK')\n")
         env = dict(os.environ, PYTHONPATH=ROOT)

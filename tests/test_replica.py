@@ -29,13 +29,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def spawn_replica(endpoint: str, tmp_path, *, mode: str = "shm",
                   lease: float = 3.0, name: str = "replica",
-                  keep: int = 2):
+                  keep: int = 2, extra: tuple = ()):
     """Launch one reader process; returns (proc, status dict)."""
     sf = str(tmp_path / f"{name}.json")
     proc = subprocess.Popen(
         [sys.executable, "-m", "multiverso_tpu.replica.replica",
          "--addr", endpoint, "--mode", mode, "--lease", str(lease),
-         "--keep", str(keep), "--status-file", sf],
+         "--keep", str(keep), "--status-file", sf, *extra],
         env=dict(os.environ, PYTHONPATH=ROOT),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     deadline = time.time() + 30
