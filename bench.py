@@ -1499,6 +1499,9 @@ print(f"child {rank} SERVING BENCH OK", flush=True)
 #: replica-plane bench config: table sized so a full base is MBs (the
 #: delta-vs-full comparison means something) while the sweep stays
 #: seconds; 1% churn per publish is the ROADMAP's acceptance workload
+#: round 23 — coordinator HA failover drill trials (median reported)
+FAILOVER_TRIALS = 3
+
 REP_ROWS = 20_000
 REP_COLS = 64
 REP_CHURN = REP_ROWS // 100
@@ -1681,6 +1684,102 @@ def bench_replica(np, rng):
                 proc.kill()
         mv.MV_ShutDown()
         tmp_ctx.cleanup()
+
+
+def bench_failover(np, rng):
+    """-> dict: coordinator HA drill (round 23) — wall time from
+    SIGKILL of the primary coordinator PROCESS to the FIRST successful
+    post-takeover op on the same client. The number includes the whole
+    recovery chain the operator actually waits on: the standby's
+    takeover lease (1.0s here, the dominant term BY DESIGN — the floor
+    of the metric is the lease, not zero), the log replay + successor
+    bind, and the client's dialer walking the endpoint list. jax-free:
+    both coordinator roles run in standby.py subprocesses."""
+    import json as _json
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+
+    from multiverso_tpu.elastic.coordinator import MemberClient
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def _wait_status(path, role, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as fh:
+                    st = _json.load(fh)
+                if st.get("role") == role:
+                    return st
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        raise RuntimeError(f"no {role} status in {path}")
+
+    lease_s = 1.0
+    times, replays = [], []
+    for trial in range(FAILOVER_TRIALS):
+        with tempfile.TemporaryDirectory(
+                prefix="mvt_bench_failover") as tmp:
+            succ_port = _free_port()
+            sb_st = os.path.join(tmp, "sb.json")
+            pr_st = os.path.join(tmp, "pr.json")
+            standby = subprocess.Popen(
+                [sys.executable, "-m",
+                 "multiverso_tpu.elastic.standby",
+                 "--listen", "127.0.0.1:0",
+                 "--serve", f"127.0.0.1:{succ_port}",
+                 "--lease", str(lease_s), "--coord-lease", "30",
+                 "--status-file", sb_st],
+                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+            primary = None
+            try:
+                log_port = _wait_status(sb_st, "standby")["log_port"]
+                primary = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "multiverso_tpu.elastic.standby",
+                     "--primary", "127.0.0.1:0",
+                     "--standby", f"127.0.0.1:{log_port}",
+                     "--coord-lease", "30", "--status-file", pr_st],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.STDOUT)
+                prim_port = _wait_status(pr_st, "primary")["port"]
+                client = MemberClient(
+                    "127.0.0.1", prim_port, 0, 30.0,
+                    endpoints=[("127.0.0.1", prim_port),
+                               ("127.0.0.1", succ_port)])
+                client.call("register")
+                for shard in range(20):     # give the replay real work
+                    client.call("shard_put", epoch=1, table_id=0,
+                                shard=shard, blob=b"x" * 4096)
+                t0 = time.monotonic()
+                primary.send_signal(signal.SIGKILL)
+                client.call_retry("state", attempts=20, timeout=5.0)
+                times.append(1e3 * (time.monotonic() - t0))
+                replays.append(float(
+                    _wait_status(sb_st, "successor")["takeover_ms"]))
+            finally:
+                for proc in (standby, primary):
+                    if proc is not None:
+                        proc.kill()
+                        proc.wait(timeout=10)
+    times.sort()
+    return {
+        "failover_ms": round(times[len(times) // 2], 1),
+        "failover_replay_ms": round(sorted(replays)[len(replays) // 2],
+                                    2),
+        "failover_config": (
+            f"median of {FAILOVER_TRIALS} trials: SIGKILL of the "
+            f"primary coordinator process mid-world (1 member, 20 "
+            f"4KB shard frames in the op log) to the first successful "
+            f"op on the successor; takeover lease {lease_s:g}s (the "
+            f"metric's floor), 2-endpoint -mv_coordinator list"),
+    }
 
 
 def serving_two_proc_numbers() -> dict:
@@ -1880,6 +1979,7 @@ def main() -> int:
     section(bench_flight_overhead, fill_host)
     section(bench_watchdog_overhead, fill_host)
     section(bench_fleet, fill_host)
+    section(bench_failover, fill_host)
     section(bench_policy, fill_host)
     section(bench_sparse_matrix, fill_sparse)
     section(bench_kv_table, fill_kv)
@@ -2875,7 +2975,11 @@ _GUARD_CEIL_KEYS = ("serving_lookup_p99_ms", "serving_lookup_2proc_p99_ms",
                     # heartbeat: bytes only ever ratchet DOWN (the
                     # plane's "few hundred bytes on existing traffic"
                     # premise)
-                    "fleet_rollup_bytes_per_hb")
+                    "fleet_rollup_bytes_per_hb",
+                    # round 23 — primary SIGKILL -> first successful
+                    # post-takeover op: recovery time only ever
+                    # ratchets DOWN (floor = the takeover lease)
+                    "failover_ms")
 
 
 def update_guard(json_path: str = FULL_JSON_PATH) -> int:
@@ -2911,7 +3015,8 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
             "seal_crc32c_GB_s", "verb_batch_throughput",
             "policy_actions_fired",
             "compress_fanout_bytes_pct", "compress_bytes_per_window",
-            "compress_int8_GB_s", "fleet_rollup_bytes_per_hb")
+            "compress_int8_GB_s", "fleet_rollup_bytes_per_hb",
+            "failover_ms")
     guard = {k: data[k] for k in keep if k in data}
     if data.get("metric") in keep and "value" in data:
         # the headline rides the artifact as metric/value, not a named key
@@ -3019,6 +3124,35 @@ if __name__ == "__main__":
                     json.dump(data, f, indent=1, sort_keys=True)
                     f.write("\n")
                 print(f"merged policy metrics into {FULL_JSON_PATH}")
+            else:
+                print(f"NOT merged: artifact platform/host "
+                      f"{data.get('platform')}/{data.get('host_cores')}"
+                      f" != {platform}/{os.cpu_count()}")
+        print(json.dumps(res, indent=1, sort_keys=True))
+        sys.exit(0)
+    if sys.argv[1:2] == ["--failover"]:
+        # standalone coordinator-HA failover drill (round 23): jax-free
+        # subprocesses, merged into the artifact when the platform/host
+        # match (the --serving pattern)
+        jax, platform = _init_jax_guarded()
+        import numpy as np
+        res = bench_failover(np, np.random.default_rng(0))
+        try:
+            with open(FULL_JSON_PATH) as f:
+                data = json.load(f)
+        except Exception as exc:
+            data = None
+            print(f"NOT merged: no readable full-run artifact at "
+                  f"{FULL_JSON_PATH} ({exc!r}) — run `python bench.py` "
+                  f"first")
+        if data is not None:
+            if (data.get("platform") == platform
+                    and data.get("host_cores") == os.cpu_count()):
+                data.update(res)
+                with open(FULL_JSON_PATH, "w") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                print(f"merged failover metrics into {FULL_JSON_PATH}")
             else:
                 print(f"NOT merged: artifact platform/host "
                       f"{data.get('platform')}/{data.get('host_cores')}"

@@ -77,6 +77,13 @@ DEFAULT_ROOTS: Dict[str, str] = {
         "fleet rollup build (lease heartbeat daemon threads)",
     "telemetry/fleet.py:FleetAccumulator.ingest":
         "coordinator-side fleet rollup fold (RPC handler threads)",
+    # round 23 — coordinator HA: the standby's takeover replays the op
+    # log and serves INSIDE a jax-free standby process that has no
+    # SPMD stream — a collective reachable from it would hang the
+    # successor forever (no rank will ever match it)
+    "elastic/standby.py:StandbyServer.force_takeover":
+        "standby lease takeover (log replay + successor bind, "
+        "jax-free standby process)",
 }
 
 #: collective primitives: node id -> what it is

@@ -128,12 +128,33 @@ INVENTORY: List[DomainRoot] = [
     DomainRoot("elastic", "elastic/coordinator.py",
                r"^Coordinator\._dispatch$",
                "coordinator RPC dispatch (per-connection threads)",
-               spawn=("elastic/coordinator.py", "Coordinator.__init__")),
+               spawn=("elastic/coordinator.py", "Coordinator.serve")),
     DomainRoot("elastic", "elastic/coordinator.py",
                r"^MemberClient\.start_heartbeats$",
                "member heartbeat thread (the _beat closure)",
                spawn=("elastic/coordinator.py",
                       "MemberClient.start_heartbeats")),
+    # -- coordinator HA (round 23): the op-log replication threads.
+    # Their own "standby" domain, NOT "elastic": the shipper's ack
+    # wait and the standby's replay hold plain locks by design
+    # (control-plane, never on a verb path), so they must not inherit
+    # the elastic domain's blocking-restriction posture.
+    DomainRoot("standby", "elastic/standby.py",
+               r"^LogShipper\._ack_loop$",
+               "primary-side op-log ack reader (standby watermark)",
+               spawn=("elastic/standby.py", "LogShipper.__init__")),
+    DomainRoot("standby", "elastic/standby.py",
+               r"^LogShipper\._ping_loop$",
+               "primary-side takeover-lease keepalive",
+               spawn=("elastic/standby.py", "LogShipper.__init__")),
+    DomainRoot("standby", "elastic/standby.py",
+               r"^StandbyServer\._feed$",
+               "standby op-log intake (per-stream server threads)",
+               spawn=("elastic/standby.py", "StandbyServer.__init__")),
+    DomainRoot("standby", "elastic/standby.py",
+               r"^StandbyServer\._watch$",
+               "standby takeover-lease monitor",
+               spawn=("elastic/standby.py", "StandbyServer.__init__")),
     # -- worker/main: the STEADY-STATE concurrent surfaces only. The
     # cut-riding API calls (checkpoint save/load, snapshot publish,
     # elastic transitions) and the setup/teardown calls (MV_Init,

@@ -83,6 +83,20 @@ MV_DEFINE_double("mv_elastic_lease_s", 0.0,
                  "declared dead (0 = derive from -mv_deadline_s, "
                  "floor 1s — the lease must expire before the "
                  "collective deadline consults it)")
+MV_DEFINE_string("mv_coordinator", "",
+                 "ordered coordinator endpoint list "
+                 "host:port[,host:port] — primary first, standby "
+                 "successor endpoints after. Every client (members, "
+                 "replica readers, the policy daemon) walks the list "
+                 "with backoff on connect failure, which is how they "
+                 "find the successor after a takeover. Overrides "
+                 "-mv_elastic_addr when set")
+MV_DEFINE_string("mv_standby", "",
+                 "host:port of a standby coordinator's log-stream "
+                 "listener (python -m multiverso_tpu.elastic.standby "
+                 "--listen ...). Rank 0 ships every coordinator "
+                 "mutation there; on primary death the standby "
+                 "replays the log and serves as successor")
 
 #: rendezvous bound for control-plane waits (sync/cut/commit/joiner
 #: pickup) — generous: these block on PEERS reaching their lockstep
@@ -135,6 +149,34 @@ def coordinator_endpoint():
     return (st.client.host, st.client.port)
 
 
+def coordinator_endpoints():
+    """The ORDERED coordinator endpoint list (primary first, standby
+    successors after), or None when the plane is down. Other planes
+    (replica relay, policy daemon) build their own clients from this so
+    every client fails over along the same list."""
+    st = _state
+    if not st.enabled or st.client is None:
+        return None
+    return list(st.client.endpoints)
+
+
+def ha_status() -> Optional[dict]:
+    """Coordinator-HA view for /healthz, /fleet and the dashboard:
+    standby replication state (rank 0 only — "solo" / "replicated" /
+    "degraded"), this client's active endpoint and failover count.
+    Never collective, never blocks."""
+    st = _state
+    if not st.enabled or st.client is None:
+        return None
+    out = {"endpoints": [f"{h}:{p}" for h, p in st.client.endpoints],
+           "active_endpoint": f"{st.client.host}:{st.client.port}",
+           "failover_gen": st.client.failover_gen}
+    if st.coordinator is not None:
+        out["standby"] = st.coordinator.standby_state
+        out["op_dedup_hits"] = st.coordinator._dedup_hits
+    return out
+
+
 def _lease_s() -> float:
     lease = float(GetFlag("mv_elastic_lease_s"))
     if lease > 0:
@@ -157,14 +199,17 @@ def start_plane(zoo) -> bool:
           "-mv_elastic needs the server engine (not -ma mode): every "
           "membership transition is an engine-stream cut")
     from multiverso_tpu.elastic.coordinator import Coordinator, MemberClient
+    from multiverso_tpu.elastic import dialer as _dialer
     me = multihost.process_index()
     world = multihost.process_count()
-    addr = str(GetFlag("mv_elastic_addr"))
+    eps_spec = str(GetFlag("mv_coordinator"))
+    addr = eps_spec.split(",")[0].strip() if eps_spec \
+        else str(GetFlag("mv_elastic_addr"))
     lease = _lease_s()
     if addr:
         host, _, port_s = addr.rpartition(":")
         CHECK(host and port_s.isdigit(),
-              f"-mv_elastic_addr must be host:port, got {addr!r}")
+              f"coordinator endpoint must be host:port, got {addr!r}")
         host, port = host, int(port_s)
     else:
         CHECK(world <= 1,
@@ -178,8 +223,13 @@ def start_plane(zoo) -> bool:
             st.coordinator = Coordinator(host if addr else "127.0.0.1",
                                          port, lease)
             port = st.coordinator.port
+            standby = str(GetFlag("mv_standby"))
+            if standby:
+                st.coordinator.attach_standby(standby)
+        endpoints = (_dialer.parse_endpoints(eps_spec) if eps_spec
+                     else None)
         st.client = MemberClient(host if addr else "127.0.0.1", port,
-                                 me, lease)
+                                 me, lease, endpoints=endpoints)
         st.client.call_retry("register", attempts=50)
         st.client.start_heartbeats()
         st.enabled = True

@@ -75,20 +75,45 @@ the publisher's next fan-out tick — the SPMD world never blocks on a
 replica, which is what keeps the read tier failure-isolated from the
 training stream.
 
-Coordinator failover is out of scope (as is the jax.distributed
-coordinator's): rank 0 cannot drain, and its death ends the world.
+**Coordinator HA (round 23).** The authority is no longer a special
+immortal process — it is a deterministic state machine replicated over
+its own op protocol. Every MUTATING op appends a sealed,
+sequence-numbered record to an **op log** streamed to a standby
+process (``elastic/standby.py``); the mutating op acks its caller only
+after the standby acked the append (bounded wait — on standby death
+the authority degrades to solo LOUDLY: availability over replication,
+flagged in /healthz). Read-only ops (``state``, pure rendezvous reads)
+never touch the log; clock-driven internal events (lease reaps, staged
+transitions, installs, policy drains) are logged at their mutation
+point so the standby's replay reproduces them without re-running any
+rendezvous. On the primary's lease expiry the standby **replays the
+log into this same class** (``replay``/``apply_logged`` — determinism
+pinned by the ``state_digest`` test), re-bases every lease/ack clock
+(``rebase_clocks`` — a failover must never manufacture evictions out
+of time that passed while no authority served), binds the successor
+endpoint and serves. Clients walk an ordered endpoint list
+(``-mv_coordinator``, see ``elastic/dialer.py``); non-idempotent ops
+carry a ``(member, op_seq)`` dedup tag so a retried ``commit`` applies
+once. Rank 0 still cannot drain (it hosts the jax.distributed
+coordinator), but its DEATH is now a measured failover, not the end
+of the world.
 """
 
 from __future__ import annotations
 
+import collections
+import hashlib
 import pickle
 import socket
 import socketserver
 import struct
 import threading
 import time
+import zlib
 from typing import Dict, Optional
 
+from multiverso_tpu.elastic import dialer as _dialer
+from multiverso_tpu.failsafe import chaos as fchaos
 from multiverso_tpu.failsafe.errors import (MembershipChanged,
                                             TransientError)
 # control frames ride the seal module's VERSIONED trailer (round 19) —
@@ -183,6 +208,15 @@ class _ReplicaRec:
 #: the trainer)
 _REPLICA_MAILBOX_CAP = 4
 
+#: bound on the standby append-ack wait per mutating op: past this the
+#: authority degrades to solo (availability over replication) instead
+#: of stalling the control plane behind a sick standby link
+_STANDBY_ACK_S = 2.0
+
+#: (member, op, op_seq) -> response cache depth for non-idempotent op
+#: dedup — far above any in-flight retry window; evicted FIFO
+_OP_DEDUP_CAP = 512
+
 
 class Coordinator:
     """The rank-0 membership authority. Thread-per-connection TCP
@@ -190,9 +224,15 @@ class Coordinator:
     on it). Never issues collectives itself — it is pure control
     plane."""
 
-    def __init__(self, host: str, port: int, lease_s: float):
+    def __init__(self, host: str, port: int, lease_s: float,
+                 serve: bool = True):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        #: HA flag lock (standby link + state string). DELIBERATELY not
+        #: _lock: the degrade callback fires from the shipper while a
+        #: dispatch thread may hold _lock inside an op handler — taking
+        #: the (non-reentrant) state lock there would self-deadlock.
+        self._ha_lock = threading.Lock()
         self._lease_s = float(lease_s)
         self.epoch = 0
         self.members: Dict[int, _MemberRec] = {}
@@ -245,7 +285,30 @@ class Coordinator:
         #: every participant has read it
         self._xchg: Dict[tuple, Dict[int, bytes]] = {}
         self._xchg_results: Dict[tuple, tuple] = {}
+        #: round 23 — coordinator HA. The op-log shipper to the standby
+        #: (None: solo), its health (solo | replicated | degraded), the
+        #: per-handler-thread pending log seq (the dispatch-level
+        #: replication wait reads it after the handler returns), and
+        #: the (member, op, op_seq) response cache that makes retried
+        #: non-idempotent ops apply-once.
+        self._standby = None
+        self.standby_state = "solo"
+        self._tls = threading.local()
+        self._op_dedup: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._dedup_hits = 0
 
+        self._host = host
+        self._server = None
+        self._thread = None
+        self.port = int(port)
+        if serve:
+            self.serve()
+
+    def serve(self) -> None:
+        """Bind + serve the op endpoint. Separate from ``__init__`` so
+        a standby's takeover can replay the op log into a quiescent
+        instance BEFORE any client reaches it."""
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -273,7 +336,7 @@ class Coordinator:
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = _Server((host, port), _Handler)
+        self._server = _Server((self._host, self.port), _Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever,
@@ -281,14 +344,172 @@ class Coordinator:
             name="mv-elastic-coordinator", daemon=True)
         self._thread.start()
         Log.Info("elastic: coordinator up at %s:%d (lease %.1fs)",
-                 host, self.port, lease_s)
+                 self._host, self.port, self._lease_s)
 
     def stop(self) -> None:
+        with self._ha_lock:
+            ship, self._standby = self._standby, None
+        if ship is not None:
+            ship.close()
         try:
-            self._server.shutdown()
-            self._server.server_close()
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
         except Exception:       # pragma: no cover - teardown race
             pass
+
+    # -- coordinator HA: op log, replication, replay (round 23) --------------
+
+    def attach_standby(self, addr) -> None:
+        """Start replicating: every mutating op's record streams to the
+        standby at ``addr`` (host:port of its ``--listen`` endpoint)
+        and mutating responses wait for its append ack."""
+        from multiverso_tpu.elastic import standby as _standby
+        (host, port), = _dialer.parse_endpoints(addr)
+        ship = _standby.LogShipper(
+            host, port, lease_s=self._lease_s,
+            on_degrade=self._standby_degraded)
+        with self._ha_lock:
+            self._standby = ship
+            self.standby_state = "replicated"
+        tmetrics.counter("elastic.standby_degraded")    # eager: shows 0
+        Log.Info("elastic: op log replicating to standby %s:%d",
+                 host, port)
+
+    def _standby_degraded(self, why: str) -> None:
+        with self._ha_lock:
+            if self.standby_state != "replicated":
+                return
+            self.standby_state = "degraded"
+        tmetrics.counter("elastic.standby_degraded").inc()
+        Log.Error("elastic: standby link lost (%s) — DEGRADED TO SOLO: "
+                  "the authority keeps serving unreplicated "
+                  "(availability over replication); a primary death "
+                  "from here is unrecoverable until a standby "
+                  "re-attaches", why)
+
+    def _log(self, kind: str, **data) -> None:
+        """Append one op-log record. Caller holds the lock — the seq
+        is assigned and the frame sent in mutation order, so the
+        standby's replay order IS the primary's mutation order."""
+        ship = self._standby
+        if ship is None or not ship.alive:
+            return
+        seq = ship.append(kind, data)
+        if seq is not None:
+            self._tls.pending_seq = seq
+
+    def _sync_standby(self) -> None:
+        """Dispatch-level replication barrier: if this handler thread
+        appended log records, ack the caller only after the standby
+        acked the LAST of them (acks are cumulative on the ordered
+        stream). Bounded: a standby that stops acking degrades the
+        authority to solo instead of stalling the control plane."""
+        seq = getattr(self._tls, "pending_seq", None)
+        self._tls.pending_seq = None
+        ship = self._standby
+        if seq is None or ship is None:
+            return
+        if not ship.wait_acked(seq, timeout=_STANDBY_ACK_S):
+            ship.close()
+            self._standby_degraded(
+                f"append ack for seq {seq} not within "
+                f"{_STANDBY_ACK_S:g}s")
+
+    def simulate_kill(self) -> None:
+        """Chaos hook (``coord.kill``): die the way ``kill -9`` does —
+        stop serving and stop shipping with NO goodbye. The standby
+        must find out from its takeover lease, clients from their
+        refused connects."""
+        with self._ha_lock:
+            ship, self._standby = self._standby, None
+        if ship is not None:
+            ship.abandon()
+        try:
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
+        except Exception:       # pragma: no cover - teardown race
+            pass
+
+    def apply_logged(self, rec: dict) -> None:
+        """Replay one op-log record's STATE EFFECT (never a rendezvous
+        wait — rendezvous completions were logged as their own internal
+        events). The standby applies these in seq order at takeover;
+        determinism vs the live primary is pinned by ``state_digest``."""
+        fn = getattr(self, f"_ap_{rec['kind']}", None)
+        CHECK(fn is not None,
+              f"elastic: op-log record kind {rec['kind']!r} has no "
+              f"replay handler")
+        with self._lock:
+            fn(rec["data"])
+
+    def replay(self, records) -> int:
+        """Replay a full op log (takeover path). Holds the lock across
+        the whole log so no client op can interleave mid-replay."""
+        n = 0
+        with self._lock:
+            for rec in records:
+                fn = getattr(self, f"_ap_{rec['kind']}", None)
+                CHECK(fn is not None,
+                      f"elastic: op-log record kind {rec['kind']!r} "
+                      f"has no replay handler")
+                fn(rec["data"])
+                n += 1
+        return n
+
+    def rebase_clocks(self) -> None:
+        """Takeover clock re-basing: every lease/ack clock restarts at
+        the successor's NOW — a failover must never manufacture member
+        or replica evictions out of time that passed while no authority
+        was serving. Relay replicas are flagged for a fresh base: any
+        unfetched mailbox bytes died with the primary (mailbox contents
+        are deliberately NOT replicated — fan-out transport state, not
+        durable subscription state)."""
+        now = time.monotonic()
+        with self._lock:
+            for rec in self.members.values():
+                if rec.status == "active":
+                    rec.last_hb = now
+            for rrec in self._replicas.values():
+                if rrec.status == "live":
+                    rrec.last_hb = now
+                    rrec.needs_base = True
+            self._cv.notify_all()
+
+    def state_digest(self) -> str:
+        """SHA-256 over the DURABLE replicated state — the replay
+        determinism pin (live primary digest == replayed standby
+        digest, byte-exact). Deliberately EXCLUDES: lease/ack clocks
+        (re-based at takeover), rendezvous generation bookkeeping
+        (``_sync_*``/``_ppull_*``/``_xchg*`` — the successor resets
+        them together so every member re-rendezvouses from a common
+        zero), relay mailboxes + the needs-base hint (takeover forces
+        a re-base), and dedup counters (observability, not state)."""
+        with self._lock:
+            obj = (
+                self.epoch,
+                sorted((r, m.status) for r, m in self.members.items()),
+                sorted(self._pending_join),
+                sorted(self._pending_leave),
+                repr(self._transition),
+                sorted((e, sorted(d.items()))
+                       for e, d in self._cut_seqs.items()),
+                sorted((e, repr(m))
+                       for e, m in self._manifests.items()),
+                sorted((k, zlib.crc32(v))
+                       for k, v in self._shards.items()),
+                sorted((e, sorted(s)) for e, s in self._commits.items()),
+                sorted((k, repr(a)) for k, a in self._policy_staged),
+                sorted(map(repr, self._policy_seen)),
+                [(r.rid, r.mode, r.token, r.ring_bytes, r.status,
+                  r.acked_version)
+                 for r in sorted(self._replicas.values(),
+                                 key=lambda r: r.rid)],
+                self._next_rid,
+                self._replica_latest,
+            )
+        return hashlib.sha256(repr(obj).encode()).hexdigest()
 
     # -- state machine -------------------------------------------------------
 
@@ -305,8 +526,18 @@ class Coordinator:
                           "declared dead", rec.rank, rec.lease_s)
         if dead:
             tmetrics.counter("elastic.lease_expirations").inc(len(dead))
+            # clock-driven mutation: logged as an internal event so the
+            # standby's replay reproduces the verdict without a clock
+            self._log("reap", ranks=dead)
             self._cv.notify_all()
         return dead
+
+    def _ap_reap(self, d: dict) -> None:
+        for rank in d["ranks"]:
+            rec = self.members.get(int(rank))
+            if rec is not None and rec.status == "active":
+                rec.status = "dead"
+        self._cv.notify_all()
 
     def _active(self) -> list:
         return sorted(r for r, m in self.members.items()
@@ -336,7 +567,7 @@ class Coordinator:
         if new == self._transitioned_view() and not dead:
             return None
         CHECK(new, "elastic: transition would empty the world")
-        self._transition = {
+        t = {
             "epoch": self.epoch + 1,
             "members": new,
             "old_members": self._transitioned_view(),
@@ -346,13 +577,19 @@ class Coordinator:
             "cause": cause,
             "sync_gen": sync_gen,
         }
-        if cause != "death":
+        self._ap_stage({"t": t})
+        self._log("stage", t=dict(t))
+        Log.Info("elastic: staged epoch %d (%s): members %s",
+                 t["epoch"], cause, new)
+        return self._transition
+
+    def _ap_stage(self, d: dict) -> None:
+        t = d["t"]
+        self._transition = t
+        if t["cause"] != "death":
             self._pending_leave.clear()
             self._pending_join.clear()
         self._cv.notify_all()
-        Log.Info("elastic: staged epoch %d (%s): members %s",
-                 self._transition["epoch"], cause, new)
-        return self._transition
 
     def _transitioned_view(self) -> list:
         """The CURRENT epoch's member list (active + the just-dead —
@@ -373,18 +610,53 @@ class Coordinator:
         op = req.get("op")
         fn = getattr(self, f"_op_{op}", None)
         CHECK(fn is not None, f"elastic coordinator: unknown op {op!r}")
-        return fn(req)
+        inj = fchaos.get()
+        if inj is not None:
+            delay = inj.coord_delay()
+            if delay > 0:
+                time.sleep(delay)
+            if inj.coord_kill():
+                self.simulate_kill()
+                raise ConnectionError(
+                    "chaos coord.kill: primary hard-stopped mid-op")
+        # non-idempotent ops carry (member, op_seq): a blind client
+        # retry (post-send socket death, chaos retransmit) answers from
+        # the response cache instead of mutating twice
+        key = None
+        if "op_seq" in req:
+            key = (int(req.get("member", -1)), op, int(req["op_seq"]))
+            with self._lock:
+                hit = self._op_dedup.get(key)
+                if hit is not None:
+                    self._dedup_hits += 1
+            if hit is not None:
+                tmetrics.counter("elastic.op_dedup_hits").inc()
+                return hit
+        self._tls.pending_seq = None
+        resp = fn(req)
+        self._sync_standby()
+        if key is not None:
+            with self._lock:
+                self._op_dedup[key] = resp
+                while len(self._op_dedup) > _OP_DEDUP_CAP:
+                    self._op_dedup.popitem(last=False)
+        return resp
 
     def _op_register(self, req: dict) -> dict:
         with self._lock:
             rank = int(req["member"])
-            rec = self.members.get(rank)
-            if rec is None or rec.status in ("left", "dead"):
-                self.members[rank] = _MemberRec(rank, self._lease_s)
-            else:
-                rec.last_hb = time.monotonic()
-            self._cv.notify_all()
+            self._ap_register({"rank": rank})
+            self._log("register", rank=rank)
             return {"epoch": self.epoch, "members": self._active()}
+
+    def _ap_register(self, d: dict) -> None:
+        rank = int(d["rank"])
+        rec = self.members.get(rank)
+        if rec is None or rec.status in ("left", "dead"):
+            self.members[rank] = _MemberRec(rank, self._lease_s)
+        else:
+            rec.last_hb = time.monotonic()
+        self._cv.notify_all()
 
     def _op_hb(self, req: dict) -> dict:
         # round 22: fleet rollups piggyback on the beats that already
@@ -397,7 +669,13 @@ class Coordinator:
             rec = self.members.get(int(req["member"]))
             if rec is not None and rec.status not in ("dead",):
                 rec.last_hb = time.monotonic()
+                self._log("hb", rank=rec.rank)
             return {"epoch": self.epoch, "pending": self._has_pending()}
+
+    def _ap_hb(self, d: dict) -> None:
+        rec = self.members.get(int(d["rank"]))
+        if rec is not None and rec.status not in ("dead",):
+            rec.last_hb = time.monotonic()
 
     def _op_leave(self, req: dict) -> dict:
         with self._lock:
@@ -407,9 +685,15 @@ class Coordinator:
             rec = self.members.get(rank)
             CHECK(rec is not None and rec.status == "active",
                   f"elastic: leave from non-active member {rank}")
-            self._pending_leave.add(rank)
+            if rank not in self._pending_leave:
+                self._pending_leave.add(rank)
+                self._log("leave", rank=rank)
             self._cv.notify_all()
             return {"epoch": self.epoch}
+
+    def _ap_leave(self, d: dict) -> None:
+        self._pending_leave.add(int(d["rank"]))
+        self._cv.notify_all()
 
     def _op_join(self, req: dict) -> dict:
         with self._lock:
@@ -423,9 +707,15 @@ class Coordinator:
             # a re-join racing its own drain's install is legal: the
             # drain is staged/committing, the join lands in the NEXT
             # transition's pending set either way
-            self._pending_join.add(rank)
+            if rank not in self._pending_join:
+                self._pending_join.add(rank)
+                self._log("join", rank=rank)
             self._cv.notify_all()
             return {"epoch": self.epoch}
+
+    def _ap_join(self, d: dict) -> None:
+        self._pending_join.add(int(d["rank"]))
+        self._cv.notify_all()
 
     def _op_sync(self, req: dict) -> dict:
         """Lockstep sync rendezvous: a member's n-th call joins
@@ -505,6 +795,8 @@ class Coordinator:
                 CHECK(seqs[member] == seq,
                       f"elastic: member {member} re-cut at a different "
                       f"seq ({seqs[member]} vs {seq})")
+            else:
+                self._log("cut", epoch=epoch, member=member, seq=seq)
             seqs[member] = seq
             self._cv.notify_all()
             while True:
@@ -528,13 +820,24 @@ class Coordinator:
                         f"{sorted(seqs)}, expected {sorted(expected)})")
                 self._cv.wait(0.1)
 
+    def _ap_cut(self, d: dict) -> None:
+        self._cut_seqs.setdefault(
+            int(d["epoch"]), {})[int(d["member"])] = int(d["seq"])
+        self._cv.notify_all()
+
     def _op_manifest(self, req: dict) -> dict:
         with self._lock:
             epoch = int(req["epoch"])
             if epoch not in self._manifests:      # idempotent (retries)
                 self._manifests[epoch] = req["manifest"]
+                self._log("manifest", epoch=epoch,
+                          manifest=req["manifest"])
                 self._cv.notify_all()
             return {"ok": True}
+
+    def _ap_manifest(self, d: dict) -> None:
+        self._manifests.setdefault(int(d["epoch"]), d["manifest"])
+        self._cv.notify_all()
 
     def _op_manifest_get(self, req: dict) -> dict:
         epoch = int(req["epoch"])
@@ -560,8 +863,13 @@ class Coordinator:
                 tmetrics.counter("elastic.shard_dedup_hits").inc()
             else:
                 self._shards[key] = req["blob"]
+                self._log("shard_put", key=list(key), blob=req["blob"])
                 self._cv.notify_all()
             return {"ok": True, "dup": dup}
+
+    def _ap_shard_put(self, d: dict) -> None:
+        self._shards[tuple(d["key"])] = d["blob"]
+        self._cv.notify_all()
 
     def _op_shard_get(self, req: dict) -> dict:
         key = (int(req["epoch"]), int(req["table_id"]), int(req["shard"]))
@@ -587,7 +895,10 @@ class Coordinator:
             CHECK(t is not None and t["epoch"] == epoch,
                   f"elastic: commit for unstaged epoch {epoch} "
                   f"(current {self.epoch})")
-            self._commits.setdefault(epoch, set()).add(member)
+            arrived = self._commits.setdefault(epoch, set())
+            if member not in arrived:
+                arrived.add(member)
+                self._log("commit_arrive", epoch=epoch, member=member)
             self._cv.notify_all()
             while True:
                 if self.epoch >= epoch:
@@ -604,8 +915,18 @@ class Coordinator:
                         f"expected {t['members']})")
                 self._cv.wait(0.1)
 
+    def _ap_commit_arrive(self, d: dict) -> None:
+        self._commits.setdefault(
+            int(d["epoch"]), set()).add(int(d["member"]))
+        self._cv.notify_all()
+
     def _install(self, t: dict) -> None:
         """Make the staged transition current. Caller holds the lock."""
+        self._log("install", t=dict(t))
+        self._ap_install({"t": t})
+
+    def _ap_install(self, d: dict) -> None:
+        t = d["t"]
         for r in t["departed"]:
             rec = self.members.get(r)
             if rec is None:
@@ -752,6 +1073,8 @@ class Coordinator:
                 "policy_dedup_hits": self._policy_dups,
                 "replicas": {r.rid: r.status
                              for r in self._replicas.values()},
+                "standby": self.standby_state,
+                "op_dedup_hits": self._dedup_hits,
             }
 
     # -- policy-plane control ops (round 20) ----------------------------------
@@ -776,9 +1099,17 @@ class Coordinator:
                 # staged alongside its dedup key: a kill-vetoed batch
                 # un-sees exactly the keys it staged under
                 self._policy_staged.append((key, action))
+                self._log("policy_put", key=list(key), action=action)
                 self._cv.notify_all()
             return {"ok": True, "dup": dup,
                     "staged": len(self._policy_staged)}
+
+    def _ap_policy_put(self, d: dict) -> None:
+        key = tuple(d["key"])
+        if key not in self._policy_seen:
+            self._policy_seen.add(key)
+            self._policy_staged.append((key, d["action"]))
+        self._cv.notify_all()
 
     def _op_policy_pull(self, req: dict) -> dict:
         """Rendezvous drain of the staged policy actions: a member's
@@ -829,16 +1160,16 @@ class Coordinator:
                     staged = sorted(self._policy_staged,
                                     key=lambda ka:
                                     str(ka[1].get("id", "")))
-                    self._policy_staged = []
                     acting = all(arr.values())
-                    if not acting:
-                        # a vetoed batch was never installed: forget
-                        # its dedup keys so the same correction can
-                        # re-stage after the world re-arms (the keys
-                        # exist to stop duplicate DELIVERIES of one
-                        # proposal, not to wedge a discarded one)
-                        for k, _a in staged:
-                            self._policy_seen.discard(k)
+                    # the drain is the rendezvous' one durable effect:
+                    # logged by the exact keys it consumed so replay
+                    # reproduces it without re-running the rendezvous
+                    self._ap_policy_drain({"keys": [list(k) for
+                                                    k, _a in staged],
+                                           "acting": acting})
+                    self._log("policy_drain",
+                              keys=[list(k) for k, _a in staged],
+                              acting=acting)
                     self._ppull_answer[gen] = (
                         [a for _k, a in staged], acting)
                     self._cv.notify_all()
@@ -853,6 +1184,19 @@ class Coordinator:
                         f"policy pull rendezvous {gen} timed out "
                         f"(arrived {sorted(arr)}, world {world})")
                 self._cv.wait(0.1)
+
+    def _ap_policy_drain(self, d: dict) -> None:
+        keys = {tuple(k) for k in d["keys"]}
+        self._policy_staged = [ka for ka in self._policy_staged
+                               if ka[0] not in keys]
+        if not d["acting"]:
+            # a vetoed batch was never installed: forget its dedup
+            # keys so the same correction can re-stage after the
+            # world re-arms (the keys exist to stop duplicate
+            # DELIVERIES of one proposal, not to wedge a discarded one)
+            for k in keys:
+                self._policy_seen.discard(k)
+        self._cv.notify_all()
 
     # -- replica subscriptions (role=replica — round 17) ---------------------
 
@@ -874,22 +1218,39 @@ class Coordinator:
             tmetrics.counter("replica.lease_expirations").inc(len(dead))
             for rid in dead:
                 tfleet.forget(f"replica:{rid}")
+            self._log("replica_reap", rids=dead)
             self._cv.notify_all()
         return dead
+
+    def _ap_replica_reap(self, d: dict) -> None:
+        for rid in d["rids"]:
+            rec = self._replicas.get(int(rid))
+            if rec is not None and rec.status == "live":
+                rec.status = "dead"
+                rec.mailbox = []
+                tfleet.forget(f"replica:{rec.rid}")
+        self._cv.notify_all()
 
     def _op_replica_join(self, req: dict) -> dict:
         with self._lock:
             rid = self._next_rid
-            self._next_rid += 1
-            rec = _ReplicaRec(rid, str(req.get("mode", "relay")),
-                              str(req.get("token", "")),
-                              int(req.get("ring_bytes", 0)),
-                              float(req.get("lease_s", 5.0)))
-            self._replicas[rid] = rec
-            self._cv.notify_all()
+            d = {"rid": rid, "mode": str(req.get("mode", "relay")),
+                 "token": str(req.get("token", "")),
+                 "ring_bytes": int(req.get("ring_bytes", 0)),
+                 "lease_s": float(req.get("lease_s", 5.0))}
+            self._ap_replica_join(d)
+            self._log("replica_join", **d)
             Log.Info("elastic: replica %d joined (mode=%s, lease %.1fs)",
-                     rid, rec.mode, rec.lease_s)
+                     rid, d["mode"], d["lease_s"])
             return {"rid": rid, "latest": self._replica_latest}
+
+    def _ap_replica_join(self, d: dict) -> None:
+        rid = int(d["rid"])
+        rec = _ReplicaRec(rid, d["mode"], d["token"], d["ring_bytes"],
+                          d["lease_s"])
+        self._replicas[rid] = rec
+        self._next_rid = max(self._next_rid, rid + 1)
+        self._cv.notify_all()
 
     def _op_replica_hb(self, req: dict) -> dict:
         with self._lock:
@@ -897,6 +1258,7 @@ class Coordinator:
             if rec is None or rec.status != "live":
                 return {"evicted": True, "latest": self._replica_latest}
             rec.last_hb = time.monotonic()
+            self._log("replica_hb", rid=rec.rid)
             resp = {"evicted": False, "latest": self._replica_latest,
                     "acked": rec.acked_version}
         # the reader's fleet rollup rides its lease beat (round 22);
@@ -907,16 +1269,29 @@ class Coordinator:
             tfleet.ingest(blob)
         return resp
 
+    def _ap_replica_hb(self, d: dict) -> None:
+        rec = self._replicas.get(int(d["rid"]))
+        if rec is not None and rec.status == "live":
+            rec.last_hb = time.monotonic()
+
     def _op_replica_ack(self, req: dict) -> dict:
         with self._lock:
             rec = self._replicas.get(int(req["rid"]))
             if rec is None or rec.status != "live":
                 return {"evicted": True}
-            rec.last_hb = time.monotonic()
-            rec.acked_version = max(rec.acked_version,
-                                    int(req["version"]))
-            rec.needs_base = False
+            self._ap_replica_ack({"rid": rec.rid,
+                                  "version": int(req["version"])})
+            self._log("replica_ack", rid=rec.rid,
+                      version=int(req["version"]))
             return {"evicted": False}
+
+    def _ap_replica_ack(self, d: dict) -> None:
+        rec = self._replicas.get(int(d["rid"]))
+        if rec is None or rec.status != "live":
+            return
+        rec.last_hb = time.monotonic()
+        rec.acked_version = max(rec.acked_version, int(d["version"]))
+        rec.needs_base = False
 
     def _op_replica_roster(self, req: dict) -> dict:
         """Publisher-side poll: announce the newest published version,
@@ -930,8 +1305,12 @@ class Coordinator:
             tfleet.ingest(blob)
         with self._lock:
             if "latest" in req and req["latest"] is not None:
-                self._replica_latest = max(self._replica_latest,
-                                           int(req["latest"]))
+                v = int(req["latest"])
+                if v > self._replica_latest:
+                    self._replica_latest = v
+                    # the roster's one durable side effect (the version
+                    # replica heartbeats answer lag from)
+                    self._log("latest", version=v)
             self._reap_replicas()
             return {"replicas": [
                 {"rid": r.rid, "mode": r.mode, "token": r.token,
@@ -944,17 +1323,27 @@ class Coordinator:
                 for r in sorted(self._replicas.values(),
                                 key=lambda r: r.rid)]}
 
+    def _ap_latest(self, d: dict) -> None:
+        self._replica_latest = max(self._replica_latest,
+                                   int(d["version"]))
+
     def _op_replica_evict(self, req: dict) -> dict:
         with self._lock:
             rec = self._replicas.get(int(req["rid"]))
             if rec is not None and rec.status != "evicted":
-                rec.status = "evicted"
-                rec.mailbox = []
-                tfleet.forget(f"replica:{rec.rid}")
-                self._cv.notify_all()
+                self._ap_replica_evict({"rid": rec.rid})
+                self._log("replica_evict", rid=rec.rid)
                 Log.Info("elastic: replica %d subscription evicted",
                          rec.rid)
             return {"ok": True}
+
+    def _ap_replica_evict(self, d: dict) -> None:
+        rec = self._replicas.get(int(d["rid"]))
+        if rec is not None and rec.status != "evicted":
+            rec.status = "evicted"
+            rec.mailbox = []
+            tfleet.forget(f"replica:{rec.rid}")
+        self._cv.notify_all()
 
     def _op_replica_put(self, req: dict) -> dict:
         """Relay-mode fan-out: park one (version, blob) in the
@@ -965,6 +1354,11 @@ class Coordinator:
             rec = self._replicas.get(int(req["rid"]))
             if rec is None or rec.status != "live":
                 return {"evicted": True}
+            # logged WITHOUT the blob: mailbox bytes are fan-out
+            # transport state, not durable subscription state — a
+            # successor re-bases the replica instead (rebase_clocks)
+            self._log("replica_put", rid=rec.rid,
+                      version=int(req["version"]))
             if len(rec.mailbox) >= _REPLICA_MAILBOX_CAP:
                 rec.mailbox = []
                 rec.needs_base = True
@@ -973,6 +1367,13 @@ class Coordinator:
             rec.mailbox.append((int(req["version"]), req["blob"]))
             self._cv.notify_all()
             return {"evicted": False, "overflow": False}
+
+    def _ap_replica_put(self, d: dict) -> None:
+        rec = self._replicas.get(int(d["rid"]))
+        if rec is not None and rec.status == "live":
+            # the blob was not replicated: the replayed subscription
+            # needs a fresh base from the successor's publisher
+            rec.needs_base = True
 
     def _op_replica_fetch(self, req: dict) -> dict:
         """Relay-mode replica side: block until the mailbox holds a
@@ -997,38 +1398,118 @@ class Coordinator:
                 self._cv.wait(0.1)
 
 
+#: ops safe to blind-retry after a POST-SEND failure (the request may
+#: or may not have been served): pure reads, lease refreshes, and the
+#: rendezvous reads whose server-side generations self-heal — against
+#: a LIVE server a post-send socket death is vanishingly rare, and
+#: against a dead primary the retry lands on the successor, whose
+#: rendezvous counters all reset together (every member re-rendezvouses
+#: from a common zero). ``replica_fetch`` is deliberately absent: a
+#: popped-but-undelivered mailbox blob must not turn into a silent
+#: version gap — its caller's own loop re-fetches.
+_RETRYABLE_OPS = frozenset({
+    "register", "hb", "state", "dead_check", "sync", "policy_pull",
+    "manifest", "manifest_get", "shard_get", "joiner_wait",
+    "replica_hb", "replica_ack", "replica_roster", "replica_evict"})
+
+#: non-idempotent mutators: the client stamps a monotonically
+#: increasing ``op_seq`` so the coordinator's (member, op, op_seq)
+#: response cache makes a blind retry apply-once
+_DEDUP_OPS = frozenset({
+    "commit", "leave", "join", "cut", "shard_put", "policy_put",
+    "replica_put"})
+
+#: post-send retry budget per call (connect-phase failures are the
+#: dialer's business and don't count against this)
+_POST_SEND_RETRIES = 2
+
+
 class MemberClient:
     """One member's RPC client to the authority. Fresh socket per call
     (control-plane rates are low; this keeps concurrent callers —
     heartbeat thread, engine thread, app thread — trivially isolated).
     Ops the chaos ``membership.*`` sites target retry on
-    TransientError."""
+    TransientError.
+
+    Round 23: connects go through the shared
+    :class:`~multiverso_tpu.elastic.dialer.Dialer` over an ORDERED
+    endpoint list (primary first, successors after) — a dead primary
+    is a failover, not an error. ``failover_gen`` bumps on every
+    endpoint change so consumers (the publisher's fan-out tick) can
+    reset per-endpoint state."""
 
     def __init__(self, host: str, port: int, member: int,
-                 lease_s: float):
-        self.host, self.port = host, int(port)
+                 lease_s: float, endpoints=None):
+        eps = (_dialer.parse_endpoints(endpoints) if endpoints
+               else [(host, int(port))])
+        self._dialer = _dialer.Dialer(eps, what=f"member{member}")
         self.member = int(member)
         self.lease_s = float(lease_s)
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._xchg_idx: Dict = {}
         self._xchg_lock = threading.Lock()
+        self._seq_lock = threading.Lock()
+        self._op_seq = 0
+
+    @property
+    def host(self) -> str:
+        return self._dialer.active[0]
+
+    @property
+    def port(self) -> int:
+        return self._dialer.active[1]
+
+    @property
+    def endpoints(self):
+        return list(self._dialer.endpoints)
+
+    @property
+    def failover_gen(self) -> int:
+        return self._dialer.failover_gen
+
+    def _next_op_seq(self) -> int:
+        with self._seq_lock:
+            self._op_seq += 1
+            return self._op_seq
 
     def call(self, op: str, timeout: Optional[float] = None,
              **kw) -> dict:
         """One RPC. ``timeout`` is forwarded as the SERVER-side
         rendezvous bound; the socket waits 10s past it so the server's
         typed answer (TransientError/MembershipChanged with diagnostic
-        membership detail) always wins over a raw socket timeout."""
+        membership detail) always wins over a raw socket timeout.
+
+        Connect-phase failures retry/fail over inside the dialer for
+        EVERY op (the request was never delivered — always safe).
+        Post-send socket deaths blind-retry only for ``_RETRYABLE_OPS``
+        (idempotent) and ``_DEDUP_OPS`` (apply-once via op_seq), within
+        ``_POST_SEND_RETRIES``."""
         req = dict(kw, op=op, member=self.member)
         bound = float(timeout if timeout is not None
                       else kw.get("timeout") or 300.0)
         req.setdefault("timeout", bound)
-        with socket.create_connection((self.host, self.port),
-                                      timeout=10.0) as sock:
-            sock.settimeout(bound + 10.0)
-            _send_frame(sock, req)
-            resp = _recv_frame(sock)
+        if op in _DEDUP_OPS and "op_seq" not in req:
+            req["op_seq"] = self._next_op_seq()
+        budget = (_POST_SEND_RETRIES
+                  if op in _RETRYABLE_OPS or op in _DEDUP_OPS else 0)
+        attempt = 0
+        while True:
+            sock = self._dialer.dial(
+                deadline_s=min(bound, self._dialer.deadline_s))
+            try:
+                with sock:
+                    sock.settimeout(bound + 10.0)
+                    _send_frame(sock, req)
+                    resp = _recv_frame(sock)
+                break
+            except (ConnectionError, OSError):
+                self._dialer.mark_failed()
+                if attempt >= budget:
+                    raise
+                attempt += 1
+                tmetrics.counter("failsafe.retries").inc()
+                time.sleep(0.05 * attempt)
         err = resp.get("err") if isinstance(resp, dict) else None
         if err == "MembershipChanged":
             raise MembershipChanged(resp.get("msg", "coordinator"),

@@ -63,6 +63,20 @@ Spec grammar (comma-separated)::
                                slow apply stage (armed on ONE rank, it
                                is the deliberate straggler the critpath
                                drill must attribute)
+    coord.kill:P               coordinator HA (round 23): the primary
+                               coordinator hard-stops MID-OP — the op
+                               log shipper is abandoned without a
+                               goodbye, the server dies without
+                               answering, the client sees a dead
+                               connection. ONE-SHOT: fires at most once
+                               per injector regardless of P draws (a
+                               world has one primary to kill); armed in
+                               the process hosting the primary
+    coord.delay:P[@delay_s]    coordinator op dispatch stalled by
+                               delay_s BEFORE the handler runs —
+                               rehearses client retry budgets and the
+                               standby replication barrier under a slow
+                               authority
 
     (serving.* draws come from concurrent reader threads: the outcome
     sequence per site stays seeded-deterministic, but which caller
@@ -97,7 +111,8 @@ _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "verb.transient", "verb.failack",
           "serving.overload", "serving.delay",
           "membership.leave", "membership.join",
-          "apply.delay", "policy.flap")
+          "apply.delay", "policy.flap",
+          "coord.kill", "coord.delay")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -139,6 +154,12 @@ class ChaosInjector:
         #: gauge hovering AT a threshold, which is deterministic by
         #: nature, not probabilistic)
         self._flap_calls = 0
+        #: coord.kill latch: a world has ONE primary to kill — once the
+        #: site fires, every later consult is False no matter the draws.
+        #: Own lock: consults come from concurrent dispatch threads and
+        #: exactly one may win the latch.
+        self._kill_lock = threading.Lock()
+        self._coord_killed = False
         # eager registration: an armed injector's sites show at zero in
         # MV_MetricsSnapshot() even before their first fault
         for site in self.spec:
@@ -231,6 +252,31 @@ class ChaosInjector:
         if breach:
             metrics.counter("chaos.policy.flap").inc()
         return breach
+
+    def coord_kill(self) -> bool:
+        """Consulted once per coordinator op dispatch: True = the
+        primary hard-stops NOW, mid-op (shipper abandoned, server dead,
+        no answer to the caller). ONE-SHOT LATCHED: the draw still
+        happens every consult (schedule independence, like every
+        site), but at most one consult ever returns True — re-killing a
+        successor would turn one drill into an unbounded outage."""
+        hit = self._fire("coord.kill")
+        if not hit:
+            return False
+        with self._kill_lock:
+            if self._coord_killed:
+                return False
+            self._coord_killed = True
+            return True
+
+    def coord_delay(self) -> float:
+        """Consulted once per coordinator op dispatch: seconds to stall
+        the handler (0.0 = no fault). Single dispatch site per op, so
+        the schedule keeps strict (seed, site, call-index)
+        reproducibility per coordinator process."""
+        if self._fire("coord.delay"):
+            return self.param("coord.delay")
+        return 0.0
 
     def membership_fault(self, kind: str) -> bool:
         """Consulted once per elastic ``leave``/``join`` control op:

@@ -53,6 +53,25 @@ class TransientError(FailsafeError):
     exponential backoff + jitter up to ``-mv_max_retries``."""
 
 
+class CoordinatorUnreachable(TransientError):
+    """The shared coordinator dialer exhausted its deadline without a
+    successful TCP connect to ANY endpoint of the ordered failover
+    list. Subclasses :class:`TransientError`: every existing retry
+    site that absorbs transients keeps working, but callers that care
+    (the replica reader's hold-vs-evict boundary, the failover bench)
+    can name the condition. ``endpoints`` is the list that was tried,
+    ``deadline_s`` the bound that expired."""
+
+    def __init__(self, what: str, endpoints=(), deadline_s: float = 0.0):
+        self.what = what
+        self.endpoints = tuple(endpoints)
+        self.deadline_s = float(deadline_s)
+        eps = ",".join(f"{h}:{p}" for h, p in self.endpoints)
+        super().__init__(
+            f"no coordinator reachable for {what} within "
+            f"{deadline_s:g}s (tried [{eps}])")
+
+
 class ServingOverloaded(FailsafeError):
     """The serving plane shed this lookup: the front-end's admission
     queue already holds ``-mv_serving_max_inflight`` requests (or the

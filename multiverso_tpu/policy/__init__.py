@@ -88,11 +88,15 @@ def start_plane(zoo) -> bool:
             from multiverso_tpu.elastic.coordinator import (Coordinator,
                                                             MemberClient)
             lease = 10.0
+            endpoints = None
             ep = elastic.coordinator_endpoint()
             if ep is not None:
                 # the membership coordinator already runs on rank 0 —
-                # the policy control ops ride the same authority
+                # the policy control ops ride the same authority (and
+                # its ordered failover list: agreement must follow the
+                # authority to its successor after a takeover)
                 host, port = ep
+                endpoints = elastic.coordinator_endpoints()
             else:
                 addr = str(GetFlag("mv_policy_addr"))
                 host, _, port_s = addr.rpartition(":")
@@ -105,7 +109,8 @@ def start_plane(zoo) -> bool:
                 if me == 0:
                     st.coordinator = Coordinator(host, port, lease)
                     port = st.coordinator.port
-            st.client = MemberClient(host, port, me, lease)
+            st.client = MemberClient(host, port, me, lease,
+                                     endpoints=endpoints)
             stager = _engine.CoordStager(st.client)
         else:
             stager = _engine.LocalStager()

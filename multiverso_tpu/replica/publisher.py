@@ -101,6 +101,11 @@ class ReplicaPublisher:
         self._enc_cache: Dict[tuple, bytes] = {}
         self._enc_version = -1
         self.max_lag = 0
+        #: last seen client failover generation: a takeover voids the
+        #: per-subscriber ship dedup (the successor's mailboxes are
+        #: empty and needs_base is re-armed server-side — but our
+        #: last_sent would skip the re-ship entirely)
+        self._failover_gen = 0
         self._kick = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -204,6 +209,19 @@ class ReplicaPublisher:
             latest=self.latest if self.latest >= 0 else None,
             rollup=rollup)
         roster = resp["replicas"]
+        gen = getattr(self.client, "failover_gen", 0)
+        if gen != self._failover_gen:
+            # coordinator failover: the successor replayed the op log
+            # (roster + acked versions survive) but relay mailboxes
+            # died with the primary — drop the local ship dedup so
+            # every live subscriber gets re-shipped against its
+            # replayed ack state on this very tick
+            self._failover_gen = gen
+            for st in self._subs.values():
+                st["last_sent"] = -1
+            Log.Error("replica fan-out: coordinator failover detected "
+                      "(gen %d) — re-shipping every subscription "
+                      "against the successor's replayed state", gen)
         plane = peek_plane()
         store = plane.store if plane is not None else None
         live = 0
@@ -369,6 +387,7 @@ def start_plane(zoo) -> bool:
     if active:
         addr = str(GetFlag("mv_replica_addr"))
         ep = elastic.coordinator_endpoint()
+        endpoints = None
         if addr:
             host, _, port_s = addr.rpartition(":")
             CHECK(host and port_s.isdigit(),
@@ -377,7 +396,10 @@ def start_plane(zoo) -> bool:
                                                pub.lease_s)
             host, port = host, pub._own_coordinator.port
         elif ep is not None:
-            host, port = ep     # ride the elastic coordinator
+            host, port = ep     # ride the elastic coordinator —
+            # and its ORDERED failover list: the relay must follow
+            # the membership authority to its successor
+            endpoints = elastic.coordinator_endpoints()
         else:
             CHECK(multihost.process_count() <= 1,
                   "-mv_replica_fanout in a multi-process world needs "
@@ -386,7 +408,8 @@ def start_plane(zoo) -> bool:
             pub._own_coordinator = Coordinator("127.0.0.1", 0,
                                                pub.lease_s)
             host, port = "127.0.0.1", pub._own_coordinator.port
-        pub.client = MemberClient(host, port, me, pub.lease_s)
+        pub.client = MemberClient(host, port, me, pub.lease_s,
+                                  endpoints=endpoints)
         pub.endpoint = f"{host}:{port}"
         pub.start()
         Log.Info("replica plane: fan-out up at %s (lease %.1fs)",

@@ -51,6 +51,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from multiverso_tpu.elastic import dialer as _dialer
 from multiverso_tpu.elastic.coordinator import MemberClient, _recv_exact
 from multiverso_tpu.failsafe.errors import TransientError
 from multiverso_tpu.parallel import compress, flat
@@ -63,9 +64,23 @@ from multiverso_tpu.telemetry import trace as ttrace
 from multiverso_tpu.utils.configure import SetCMDFlag
 from multiverso_tpu.utils.log import CHECK, Log
 
-#: consecutive heartbeat failures before the replica concludes the
-#: trainer is gone and exits (the lease-symmetric shutdown path)
-_HB_FAILS_FATAL = 10
+#: hold window for an UNREACHABLE coordinator: floor seconds and a
+#: multiple of the lease, whichever is longer. A coordinator failover
+#: (standby lease expiry + log replay + clients walking the endpoint
+#: list) fits comfortably inside; a trainer that is actually gone still
+#: ends the reader, just not on the first refused connect. Eviction is
+#: a different verdict entirely: an "evicted" ANSWER exits immediately.
+_HOLD_FLOOR_S = 20.0
+_HOLD_LEASES = 6.0
+
+
+def unreachable_verdict(silent_s: float, hold_s: float) -> str:
+    """The hold-vs-evict boundary, as a pure function so the unit test
+    pins it: an unreachable coordinator means **hold** (keep retrying —
+    a failover window looks exactly like this) until the silence
+    reaches ``hold_s``, and only then **die**. Exactly at the boundary
+    is "die" (the window is a closed bound, like the lease)."""
+    return "die" if silent_s >= hold_s else "hold"
 
 #: how long the shm attach retries while the publisher discovers this
 #: subscription and creates its ring segment
@@ -130,12 +145,14 @@ class _LookupHandler(socketserver.BaseRequestHandler):
 class Replica:
     def __init__(self, host: str, port: int, *, mode: str = "shm",
                  serve_port: int = 0, ring_bytes: int = 8 << 20,
-                 lease_s: float = 5.0):
+                 lease_s: float = 5.0, endpoints=None):
         CHECK(mode in ("shm", "relay"), f"unknown replica mode {mode!r}")
         self.mode = mode
         self.ring_bytes = int(ring_bytes)
         self.lease_s = float(lease_s)
-        self.client = MemberClient(host, port, 0, self.lease_s)
+        self.hold_s = max(_HOLD_FLOOR_S, _HOLD_LEASES * self.lease_s)
+        self.client = MemberClient(host, port, 0, self.lease_s,
+                                   endpoints=endpoints)
         self.store = SnapshotStore()
         self.frontend = ServingFrontend(self.store)
         self.mirrors = rdelta.MirrorStore()
@@ -200,7 +217,7 @@ class Replica:
         os._exit(code)
 
     def _hb_loop(self) -> None:
-        fails = 0
+        first_fail: Optional[float] = None
         period = max(0.05, self.lease_s / 3.0)
         while not self._stop.wait(period):
             try:
@@ -216,12 +233,21 @@ class Replica:
                 resp = self.client.call("replica_hb", rid=self.rid,
                                         rollup=rollup, timeout=5.0)
             except Exception:
-                fails += 1
-                if fails >= _HB_FAILS_FATAL:
-                    self._die(3, "coordinator unreachable — trainer "
+                # UNREACHABLE is not EVICTED: a coordinator failover
+                # looks exactly like this from here — hold (and keep
+                # dialing the endpoint list, which is how we find the
+                # successor) until the hold window says the trainer is
+                # actually gone
+                now = time.monotonic()
+                if first_fail is None:
+                    first_fail = now
+                if unreachable_verdict(now - first_fail,
+                                       self.hold_s) == "die":
+                    self._die(3, "coordinator unreachable for "
+                                 f"{now - first_fail:.1f}s — trainer "
                                  "gone")
                 continue
-            fails = 0
+            first_fail = None
             if resp.get("evicted"):
                 self._die(4, "subscription evicted by the trainer")
             self._advance_latest(int(resp.get("latest", -1)))
@@ -397,6 +423,12 @@ class ReplicaClient:
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, int(port)
+        # the shared dialer (single endpoint here): bounded connect
+        # retries with jittered backoff instead of one-shot-fatal, and
+        # the typed CoordinatorUnreachable on exhaustion — a reader
+        # restarting its serve socket is not a client-fatal event
+        self._dialer = _dialer.Dialer([(host, int(port))],
+                                      what=f"replica-lookup:{port}")
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -419,8 +451,9 @@ class ReplicaClient:
                 resp = None
                 for attempt in (0, 1):
                     if self._sock is None:
-                        self._sock = socket.create_connection(
-                            (self.host, self.port), timeout=timeout)
+                        self._sock = self._dialer.dial(
+                            deadline_s=min(timeout,
+                                           self._dialer.deadline_s))
                     try:
                         self._sock.settimeout(timeout)
                         _send_flat(self._sock, req)
@@ -475,7 +508,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "replica plane, mirror published versions, serve "
                     "lookups")
     p.add_argument("--addr", required=True,
-                   help="trainer replica coordinator host:port")
+                   help="trainer replica coordinator endpoint list "
+                        "host:port[,host:port] — primary first, "
+                        "standby successor endpoints after")
     p.add_argument("--mode", choices=("shm", "relay"), default="shm",
                    help="fan-out transport: shm (same host) or the "
                         "coordinator socket relay (remote)")
@@ -518,9 +553,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     CHECK("jax" not in sys.modules,
           "replica process import graph must stay numpy-only — "
           "something pulled jax at import time")
-    host, _, port_s = args.addr.rpartition(":")
-    CHECK(host and port_s.isdigit(),
-          f"--addr must be host:port, got {args.addr!r}")
+    endpoints = _dialer.parse_endpoints(args.addr)
+    host, port_n = endpoints[0]
     SetCMDFlag("mv_serving_keep", args.keep)
     if args.trace:
         SetCMDFlag("trace", True)
@@ -531,9 +565,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.chaos_spec:
         SetCMDFlag("chaos_spec", args.chaos_spec)
         SetCMDFlag("chaos_seed", args.chaos_seed)
-    rep = Replica(host, int(port_s), mode=args.mode,
+    rep = Replica(host, port_n, mode=args.mode,
                   serve_port=args.serve_port,
-                  ring_bytes=args.ring_bytes, lease_s=args.lease)
+                  ring_bytes=args.ring_bytes, lease_s=args.lease,
+                  endpoints=endpoints)
     rep.start()
     if args.status_file:
         tmp = args.status_file + ".tmp"

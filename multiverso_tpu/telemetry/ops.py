@@ -247,6 +247,23 @@ def health_report() -> dict:
             out["policy"] = pline
     except Exception:           # policy plane torn down mid-scrape
         pass
+    # round 23 — coordinator HA: standby replication state (rank 0:
+    # solo / replicated / degraded) + this process's client failover
+    # posture (endpoint list, active endpoint, failover count). A
+    # DEGRADED standby — the primary shed a dead standby and serves
+    # solo, availability over replication — stays healthy but is a
+    # NAMED warning: the operator must know redundancy is gone.
+    try:
+        from multiverso_tpu import elastic
+        ha = elastic.ha_status()
+        if ha is not None:
+            out["coordinator_ha"] = ha
+            if ha.get("standby") == "degraded":
+                out.setdefault("warnings", []).append(
+                    "coordinator standby lost — primary serving solo "
+                    "(op log unreplicated)")
+    except Exception:           # elastic plane torn down mid-scrape
+        pass
     rec, drop = flight.stats()
     out["flight"] = {"recorded": rec, "dropped": drop,
                      "enabled": flight.enabled()}
@@ -258,9 +275,11 @@ def health_report() -> dict:
         alerts = twatchdog.active_alerts()
         out["alerts"] = [a["rule"] for a in alerts]
         out["status"] = ("dead" if not out["healthy"]
-                         else ("warn" if alerts else "ok"))
+                         else ("warn" if alerts or out.get("warnings")
+                               else "ok"))
     except Exception:           # watchdog torn down mid-scrape
-        out["status"] = "dead" if not out["healthy"] else "ok"
+        out["status"] = ("dead" if not out["healthy"]
+                         else ("warn" if out.get("warnings") else "ok"))
     return out
 
 
@@ -373,8 +392,19 @@ class _OpsHandler(BaseHTTPRequestHandler):
                            "application/json")
             elif path == "/fleet":
                 from multiverso_tpu.telemetry import fleet as tfleet
-                self._send(200, json.dumps(tfleet.fleet_report(),
-                                           indent=1, sort_keys=True),
+                rep = tfleet.fleet_report()
+                # round 23 — coordinator HA posture rides the fleet
+                # view: which endpoint of the failover list this
+                # process talks to, failover count, standby state
+                try:
+                    from multiverso_tpu import elastic
+                    ha = elastic.ha_status()
+                    if ha is not None:
+                        rep["coordinator_ha"] = ha
+                except Exception:
+                    pass
+                self._send(200, json.dumps(rep, indent=1,
+                                           sort_keys=True),
                            "application/json")
             elif path == "/memory":
                 from multiverso_tpu.telemetry import accounting

@@ -524,12 +524,37 @@ class RollupStaleRule(Rule):
         return None
 
 
+class CoordinatorFailoverRule(Rule):
+    """This process's coordinator clients failed over to a different
+    endpoint of the ordered ``-mv_coordinator`` list since the last
+    tick — the primary died (or vanished long enough for the dialer to
+    land on a successor). Fires on the FIRST tick that sees the
+    counter move (fire_after=1: one failover is already the event, not
+    noise needing corroboration), clears once the counter stops moving
+    — so one takeover alerts exactly once."""
+
+    name = "coordinator_failover"
+    fire_after = 1
+    clear_after = 1
+
+    def check(self, history):
+        if len(history) < 2:
+            return HOLD
+        d = self._delta(history, "coordinator_failovers")
+        if d > 0:
+            return (f"{int(d)} coordinator client failover(s) this "
+                    f"tick — active endpoint index "
+                    f"{int(history[-1].get('coordinator_endpoint', 0))}")
+        return None
+
+
 def default_rules() -> List[Rule]:
     return [ShardImbalanceRule(), ShmBackpressureRule(),
             ApplyPoolSaturationRule(), MailboxBacklogRule(),
             SnapshotStaleRule(), MemoryGrowthRule(), StragglerRule(),
             ReplicaLagRule(), FleetP99BreachRule(),
-            MemberQpsOutlierRule(), RollupStaleRule()]
+            MemberQpsOutlierRule(), RollupStaleRule(),
+            CoordinatorFailoverRule()]
 
 
 def refresh_saturation_gauges() -> None:
@@ -585,6 +610,13 @@ def collect_sample() -> dict:
     sample["publishes"] = _counter("serving.publishes")
     sample["shm_writer_stall_s"] = _counter("shm_wire.writer_stall_s")
     sample["shm_rounds"] = _counter("shm_wire.exchanges")
+    # coordinator HA: the shared dialer's failover counter + active
+    # endpoint index (plain metric reads — the CoordinatorFailoverRule
+    # watches the counter's delta)
+    sample["coordinator_failovers"] = _counter("elastic.client_failovers")
+    ep = snap.get("elastic.active_endpoint")
+    if ep:
+        sample["coordinator_endpoint"] = ep.get("value", 0.0)
     try:
         from multiverso_tpu.zoo import Zoo
         eng = Zoo.Get().server_engine

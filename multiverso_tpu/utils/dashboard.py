@@ -285,6 +285,14 @@ class Dashboard:
                        else "")
                     + (f", cut_seq = {el['cut_seq']}"
                        if el.get("cut_seq") is not None else ""))
+            ha = elastic.ha_status()
+            if ha is not None:
+                line = (f"[CoordHA] endpoint = {ha['active_endpoint']}"
+                        f" (of {len(ha['endpoints'])}), failovers = "
+                        f"{ha['failover_gen']}")
+                if "standby" in ha:
+                    line += f", standby = {ha['standby']}"
+                lines.append(line)
             return lines
         except Exception:       # pragma: no cover - teardown races
             return []

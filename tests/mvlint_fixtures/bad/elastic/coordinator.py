@@ -1,5 +1,6 @@
 """Fixture twin of the elastic coordinator: per-connection RPC
-threads (spawned in __init__) and the member heartbeat thread."""
+threads (spawned in serve(), deferred from __init__ so a standby's
+takeover can replay before serving) and the member heartbeat thread."""
 
 import threading
 
@@ -7,7 +8,11 @@ import threading
 class Coordinator:
     def __init__(self, host, port):
         self._lock = threading.Lock()
+        self._thread = None
+
+    def serve(self):
         self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
 
     def _serve(self):
         return self._dispatch({})

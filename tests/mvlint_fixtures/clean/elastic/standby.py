@@ -1,0 +1,52 @@
+"""Fixture twin of the coordinator HA plane: the primary-side log
+shipper spawns its ack reader + lease keepalive in __init__, the
+standby spawns its intake/monitor pair in __init__, and takeover is a
+never-collective root (it runs in a jax-free standby process)."""
+
+import threading
+
+
+class LogShipper:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._acked = 0
+        self._ack_thread = threading.Thread(target=self._ack_loop,
+                                            daemon=True)
+        self._ack_thread.start()
+        self._ping_thread = threading.Thread(target=self._ping_loop,
+                                             daemon=True)
+        self._ping_thread.start()
+
+    def _ack_loop(self):
+        with self._cv:
+            self._acked += 1
+            self._cv.notify_all()
+
+    def _ping_loop(self):
+        while not self._stop.wait(0.2):
+            pass
+
+
+class StandbyServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._feed, daemon=True)
+        self._thread.start()
+        self._monitor = threading.Thread(target=self._watch, daemon=True)
+        self._monitor.start()
+
+    def _feed(self):
+        with self._lock:
+            self._records.append({"seq": len(self._records) + 1})
+
+    def _watch(self):
+        while not self._stop.wait(0.05):
+            self.force_takeover("lease expired")
+
+    def force_takeover(self, why):
+        with self._lock:
+            return {"why": why, "records": len(self._records)}

@@ -362,6 +362,24 @@ class TestFixtureCatches:
                     if f.rule == "never-collective"
                     and f.path == "telemetry/fleet.py"]
 
+    def test_never_collective_catches_standby_takeover(self, results):
+        """The round-23 root: a standby takeover reaching a collective
+        (seeded host_barrier in bad/elastic/standby.py) is a finding —
+        force_takeover runs in a jax-free standby process with no SPMD
+        stream, so a collective there hangs the successor forever. The
+        clean twin passes."""
+        bad_res, clean_res = results
+        hits = [f for f in bad_res.findings
+                if f.rule == "never-collective"
+                and f.path == "elastic/standby.py"]
+        assert hits, sorted({f.path for f in bad_res.findings})
+        assert any("force_takeover" in f.message
+                   and "parallel/multihost.py:host_barrier" in f.message
+                   for f in hits), [f.render() for f in hits]
+        assert not [f for f in clean_res.findings
+                    if f.rule == "never-collective"
+                    and f.path == "elastic/standby.py"]
+
     def test_policy_fixture_is_gated_from_day_one(self, results):
         """Round 20: the policy plane's thread is inventoried and its
         domain is blocking-restricted — the seeded UNBOUNDED wait in
@@ -1220,6 +1238,14 @@ class TestScannedCoveragePins:
         for checker in res.checkers:
             assert "telemetry/fleet.py" in checker.scanned
         assert "telemetry/fleet.py" in all_rels
+        # round 23 — the coordinator HA modules are scanned (the log
+        # shipper/standby threads and the failover dialer are exactly
+        # the kind of control-plane concurrency the rules police) and
+        # the standby fixture mirror exists in the package
+        for checker in res.checkers:
+            assert "elastic/standby.py" in checker.scanned
+            assert "elastic/dialer.py" in checker.scanned
+        assert "elastic/standby.py" in all_rels
 
 
 class TestMvlintEntryPoint:

@@ -64,6 +64,11 @@ class TestWheel:
         # ships with the same wheel (replica readers build rollups)
         for mod in ("fleet", "trace", "metrics"):
             assert f"multiverso_tpu/telemetry/{mod}.py" in names, names
+        # ...and the round-23 coordinator HA plane: the standby entry
+        # point + failover dialer deploy from the same wheel onto
+        # hosts with no accelerator stack
+        for mod in ("coordinator", "dialer", "standby"):
+            assert f"multiverso_tpu/elastic/{mod}.py" in names, names
 
     def test_seal_verify_path_is_jax_free(self):
         """Round 19: the versioned seal (parallel/seal.py) + flat frame
@@ -126,6 +131,34 @@ class TestWheel:
                            env=env)
         assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
         assert "REPLICA-JAXFREE-OK" in r.stdout
+
+    def test_standby_entry_point_is_jax_free(self):
+        """Round 23: the standby coordinator is a deployment unit for
+        hosts with NO accelerator stack — importing its module (and
+        the coordinator + dialer it drives) may never pull jax. The
+        entry point itself CHECKs this at startup; here the import
+        graph is pinned so a refactor can't break the property between
+        releases."""
+        check = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import multiverso_tpu.elastic.standby as sb\n"
+            "assert 'jax' not in sys.modules, 'jax entered the standby "
+            "import graph'\n"
+            "assert hasattr(sb, 'StandbyServer') and hasattr(sb, "
+            "'main')\n"
+            "from multiverso_tpu.elastic import coordinator, dialer\n"
+            "assert dialer.parse_endpoints('a:1,b:2') == [('a', 1), "
+            "('b', 2)]\n"
+            "assert 'jax' not in sys.modules, 'jax entered the "
+            "coordinator/dialer import graph'\n"
+            "print('STANDBY-JAXFREE-OK')\n")
+        env = dict(os.environ, PYTHONPATH=ROOT)
+        r = subprocess.run([sys.executable, "-c", check],
+                           capture_output=True, text=True, timeout=120,
+                           env=env)
+        assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
+        assert "STANDBY-JAXFREE-OK" in r.stdout
 
     def test_install_and_import_in_clean_venv(self, wheel, tmp_path):
         env_dir = tmp_path / "venv"

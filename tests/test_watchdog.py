@@ -334,7 +334,8 @@ class TestEagerRegistrationAndSurfaces:
                 time.sleep(0.05)
             assert body["enabled"] is True and body["ticks"] >= 2
             assert sorted(body["rules"]) == [
-                "apply_pool_sat", "fleet_p99_breach", "mailbox_backlog",
+                "apply_pool_sat", "coordinator_failover",
+                "fleet_p99_breach", "mailbox_backlog",
                 "member_qps_outlier", "memory_growth", "replica_lag",
                 "rollup_stale", "shard_imbalance", "shm_backpressure",
                 "snapshot_stale", "straggler"]
