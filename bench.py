@@ -2046,6 +2046,7 @@ _COMPACT_PRIORITY = [
     "matrix_table_2proc_wire_pickle_ms_per_window",
     "kv_burst_2proc_collectives_per_op",
     "matrix_table_2proc_overlap_pct",
+    "matrix_table_2proc_tcp_wire_MB_s",
     "matrix_table_2proc_fence_causes",
     "matrix_table_2proc_critpath",
     "flight_recorder_overhead_pct",
@@ -2199,6 +2200,11 @@ args = ([f"-dist_coordinator=127.0.0.1:{port}", f"-dist_rank={rank}",
          f"-dist_size={nproc}"] if nproc > 1 else [])
 if mode == "bsp":
     args.append("-sync=true")
+elif mode == "tcp":
+    # round 24: the same async workload over the cross-host tcp wire —
+    # loopback cross-host (the hostname override fakes distinct hosts
+    # on one box; frames still ride real sockets through the kernel)
+    args += ["-mv_wire=tcp", "-mv_wire_hostname=node" + "AB"[rank]]
 mv.MV_Init(args)
 R, C, K, ROUNDS, W = 100_000, 50, 5000, 8, 4
 rng = np.random.default_rng(100 + rank)
@@ -2390,12 +2396,14 @@ if nproc > 1:
         "host_wire": multihost.wire_name(),
     }
     if multihost.active_wire() is not None:
-        # same-host shm wire active: the host_* numbers above ARE the
-        # shm numbers; re-measure the SAME rounds on RAW gloo for the
-        # A/B (wire_bypass is collective: both ranks bypass in
-        # lockstep)
-        prof["shm_wire_MB_s"] = round(host_MB_s, 1)
-        prof["shm_round_latency_ms"] = round(lat_ms, 2)
+        # wire active (shm same-host / tcp cross-host): the host_*
+        # numbers above ARE that wire's numbers — keyed by its name, so
+        # a -mv_wire=tcp run publishes tcp_wire_MB_s; re-measure the
+        # SAME rounds on RAW gloo for the A/B (wire_bypass is
+        # collective: both ranks bypass in lockstep)
+        wn = multihost.wire_name()
+        prof[wn + "_wire_MB_s"] = round(host_MB_s, 1)
+        prof[wn + "_round_latency_ms"] = round(lat_ms, 2)
         with multihost.wire_bypass():
             gcaps = {}
             multihost.capped_exchange(small, gcaps, "PROF_GS")
@@ -2832,6 +2840,9 @@ def two_proc_numbers() -> dict:
     out.update(serving_two_proc_numbers())
     # elastic plane (round 10): the rebalance-pause guard metric
     out.update(elastic_numbers())
+    # tcp wire A/B (round 24): the cross-host transport on the same
+    # matrix workload — loopback cross-host via -mv_wire_hostname
+    out.update(tcp_two_proc_numbers())
     res = _launch_nproc(_NPROC_KV_CHILD, 2)
     out["kv_burst_2proc_per_proc_Melem_s"] = res["burst_per_proc_Melem_s"]
     out["kv_burst_2proc_collectives_per_op"] = res[
@@ -3006,6 +3017,7 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
     keep = ("platform", "host_cores", "logreg_train_samples_per_sec",
             "matrix_table_2proc_host_per_proc_Melem_s",
             "matrix_table_2proc_shm_wire_MB_s",
+            "matrix_table_2proc_tcp_wire_MB_s",
             "we_app_words_per_sec", "we_app_2proc_aggregate_words_per_sec",
             "serving_lookup_qps", "serving_lookup_p99_ms",
             "serving_lookup_2proc_qps", "serving_lookup_2proc_p99_ms",
@@ -3036,6 +3048,31 @@ def update_guard(json_path: str = FULL_JSON_PATH) -> int:
         f.write("\n")
     print(f"updated {GUARD_JSON_PATH} from {json_path}: {guard}")
     return 0
+
+
+def tcp_two_proc_numbers() -> dict:
+    """Round 24 — shm vs gloo vs tcp A/B: the SAME 2-proc matrix-table
+    workload as two_proc_numbers, forced onto the cross-host tcp wire
+    (loopback cross-host: -mv_wire_hostname fakes distinct hosts on one
+    box; frames still cross real kernel sockets). The child's in-run
+    gloo A/B rides multihost.wire_bypass; the shm leg of the triple is
+    the regular matrix run's matrix_table_2proc_shm_wire_MB_s."""
+    res = _launch_nproc(_NPROC_MATRIX_CHILD, 2, "tcp")
+    out = {}
+    for src, dst in (
+            ("tcp_wire_MB_s", "matrix_table_2proc_tcp_wire_MB_s"),
+            ("tcp_round_latency_ms",
+             "matrix_table_2proc_tcp_round_latency_ms"),
+            ("gloo_exchange_MB_s", "matrix_table_2proc_tcp_gloo_MB_s"),
+            ("gloo_round_latency_ms",
+             "matrix_table_2proc_tcp_gloo_latency_ms"),
+            ("host_per_proc_Melem_s",
+             "matrix_table_2proc_tcp_host_per_proc_Melem_s"),
+            ("pipeline_burst_per_proc_Melem_s",
+             "matrix_table_2proc_tcp_pipeline_burst_per_proc_Melem_s")):
+        if src in res:
+            out[dst] = res[src]
+    return out
 
 
 def serving_section_main() -> int:
@@ -3097,6 +3134,24 @@ if __name__ == "__main__":
                 json.dump(data, f, indent=1, sort_keys=True)
                 f.write("\n")
             print(f"merged elastic metrics into {FULL_JSON_PATH}")
+        print(json.dumps(res, indent=1, sort_keys=True))
+        sys.exit(0)
+    if sys.argv[1:2] == ["--tcp"]:
+        # standalone tcp-wire A/B section (round 24), merged into the
+        # artifact when platform/host match (the --elastic pattern)
+        res = tcp_two_proc_numbers()
+        try:
+            with open(FULL_JSON_PATH) as f:
+                data = json.load(f)
+        except Exception:
+            data = None
+        if (data is not None and data.get("platform") == "cpu"
+                and data.get("host_cores") == os.cpu_count()):
+            data.update(res)
+            with open(FULL_JSON_PATH, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"merged tcp wire metrics into {FULL_JSON_PATH}")
         print(json.dumps(res, indent=1, sort_keys=True))
         sys.exit(0)
     if sys.argv[1:2] == ["--serving"]:

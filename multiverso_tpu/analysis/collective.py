@@ -103,6 +103,7 @@ DEFAULT_SINKS: Dict[str, str] = {
     "parallel/multihost.py:Group.exchange": "membership-group exchange",
     "parallel/multihost.py:Group.barrier": "membership-group barrier",
     "parallel/shm_wire.py:ShmWire.exchange": "shm-wire exchange",
+    "parallel/tcp_wire.py:TcpWire.exchange": "tcp-wire exchange",
     "zoo.py:Zoo._barrier_wait": "zoo rendezvous barrier leg",
 }
 

@@ -383,6 +383,12 @@ class CrossDomainStateChecker(Checker):
         "parallel/shm_wire.py":
             "single-owner wire instances; class-level aggregation is "
             "instance-blind",
+        # same posture for the tcp wire, plus its accept loop: that
+        # thread writes _conn/_accept_exc only during install, strictly
+        # BEFORE any exchange runs (connect() joins it), under _lock
+        "parallel/tcp_wire.py":
+            "single-owner wire instances; the accept loop writes only "
+            "during install, before any exchange, under the wire lock",
     }
 
     def check(self, pkg: PackageIndex) -> List[Finding]:

@@ -195,6 +195,16 @@ INVENTORY: List[DomainRoot] = [
     DomainRoot("policy", "policy/engine.py", r"^PolicyEngine\._run$",
                "policy evaluation daemon (alert->action loop)",
                spawn=("policy/engine.py", "PolicyEngine.start")),
+    # -- tcp wire (round 24): the only thread the transport owns is
+    # the install-time accept loop — it collects the mesh's inbound
+    # dials, closes the listeners and EXITS; steady-state exchanges
+    # run entirely on the caller's thread (the selectors loop), so no
+    # exchange-side root exists to register
+    DomainRoot("tcp-wire", "parallel/tcp_wire.py",
+               r"^TcpWire\._accept_loop$",
+               "tcp wire mesh accept loop (install-time, exits once "
+               "the mesh is up)",
+               spawn=("parallel/tcp_wire.py", "TcpWire.connect")),
     # -- infrastructure helpers
     DomainRoot("helper", "failsafe/deadline.py", r"^_Runner\._loop$",
                "bounded-call runner thread",

@@ -186,8 +186,11 @@ class _ReplicaRec:
     def __init__(self, rid: int, mode: str, token: str, ring_bytes: int,
                  lease_s: float):
         self.rid = rid
-        self.mode = mode              # "shm" | "relay"
-        self.token = token            # shm session token ("" for relay)
+        self.mode = mode              # "shm" | "tcp" | "relay"
+        self.token = token            # wire session token: shm session,
+                                      # or tcp "session@host:port"
+                                      # ("" for relay) — relayed to the
+                                      # publisher VERBATIM
         self.ring_bytes = int(ring_bytes)
         self.lease_s = float(lease_s)
         self.last_hb = time.monotonic()

@@ -77,6 +77,21 @@ Spec grammar (comma-separated)::
                                rehearses client retry budgets and the
                                standby replication barrier under a slow
                                authority
+    tcp.delay:P[@delay_s]      tcp wire (round 24): the exchange sleeps
+                               delay_s before sending its frame train —
+                               models a congested/slow link; the
+                               receiving peers' stall accounting and
+                               critpath attribution must absorb it
+    tcp.drop:P                 tcp wire: the FINAL outbound frame
+                               toward the lowest peer is swallowed —
+                               that peer stalls on bytes that never
+                               arrive, and its lease probe / deadline
+                               (NOT a hang) must convert the stall
+    tcp.partition:P            tcp wire: every stream of the exchanged
+                               channel is severed — both sides surface
+                               typed ActorDied (EOF/RST), rehearsing a
+                               mid-exchange network partition / peer
+                               kill -9
 
     (serving.* draws come from concurrent reader threads: the outcome
     sequence per site stays seeded-deterministic, but which caller
@@ -112,7 +127,8 @@ _SITES = ("mailbox.drop", "mailbox.dup", "mailbox.delay",
           "serving.overload", "serving.delay",
           "membership.leave", "membership.join",
           "apply.delay", "policy.flap",
-          "coord.kill", "coord.delay")
+          "coord.kill", "coord.delay",
+          "tcp.delay", "tcp.drop", "tcp.partition")
 _DEFAULT_DELAY_S = 0.002
 
 
@@ -268,6 +284,30 @@ class ChaosInjector:
                 return False
             self._coord_killed = True
             return True
+
+    def tcp_delay(self) -> float:
+        """Consulted once per tcp-wire exchange: seconds to sleep
+        before sending the frame train (0.0 = no fault) — a slow/
+        congested link. Drawn on the caller's exchange thread, so the
+        schedule keeps strict (seed, site, call-index)
+        reproducibility."""
+        if self._fire("tcp.delay"):
+            return self.param("tcp.delay")
+        return 0.0
+
+    def tcp_drop(self) -> bool:
+        """Consulted once per tcp-wire exchange: True = swallow the
+        final outbound frame toward the lowest peer. That peer stalls
+        on bytes that never arrive — its lease probe or deadline must
+        convert the stall into a typed error, never a hang."""
+        return self._fire("tcp.drop")
+
+    def tcp_partition(self) -> bool:
+        """Consulted once per tcp-wire exchange: True = sever every
+        stream of the exchanged channel NOW (mid-exchange partition /
+        peer kill -9 rehearsal — both sides must surface typed
+        ActorDied from the EOF/RST)."""
+        return self._fire("tcp.partition")
 
     def coord_delay(self) -> float:
         """Consulted once per coordinator op dispatch: seconds to stall

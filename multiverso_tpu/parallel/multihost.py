@@ -73,12 +73,27 @@ MV_DEFINE_int("dist_size", -1, "total process count (jax.distributed)")
 # split, PAPER.md L2: transports are deployment choices, not protocol
 # changes). "auto": same-host worlds ride the shared-memory wire
 # (parallel/shm_wire.py — gloo measured ~410 MB/s between two
-# processes of ONE machine; shm is a memcpy), cross-host worlds stay
-# on gloo. "gloo" forces the socket allgather; "shm" REQUIRES the
-# shared-memory wire and CHECK-fails when ranks span hosts.
+# processes of ONE machine; shm is a memcpy), cross-host worlds take
+# the framed tcp wire (round 24, parallel/tcp_wire.py) when the
+# engine/replica asked for more than one exchange channel, else gloo.
+# "gloo" forces the socket allgather; "shm"/"tcp" REQUIRE their wire
+# and CHECK-fail when it cannot come up.
 MV_DEFINE_string("mv_wire", "auto",
                  "windowed-engine host wire: auto (shm when every rank "
-                 "shares a host, else gloo) / shm (require) / gloo")
+                 "shares a host; tcp when hosts differ and >1 channel "
+                 "is needed; else gloo) / shm (require) / tcp "
+                 "(require) / gloo")
+# Round 24 — the loopback cross-host drills: CI has one box, but the
+# cross-host selection/labeling code path must still be exercised for
+# real. The override changes THIS rank's host IDENTITY (wire
+# selection votes, telemetry + critpath labels) while dialing always
+# rides the genuinely advertised endpoints — the honest split between
+# "which code path runs" and "which sockets carry bytes".
+MV_DEFINE_string("mv_wire_hostname", "",
+                 "override this rank's host identity in wire selection "
+                 "and telemetry/critpath labels (loopback cross-host "
+                 "drills fake distinct hosts on one box; dialing still "
+                 "rides real endpoints). Empty = the real hostname")
 MV_DEFINE_int("mv_shm_ring_bytes", 4 << 20,
               "shared-memory wire: per-(channel, rank) data area bytes "
               "(frames larger than this chunk through it)")
@@ -218,7 +233,7 @@ class Group:
 
 _group: Optional[Group] = None
 
-# -- pluggable same-host wire (round 12, parallel/shm_wire.py) -----------
+# -- pluggable host wire (round 12 shm, round 24 tcp) --------------------
 #: the installed transport behind capped_exchange (None = gloo). Boot
 #: world only: elastic groups (installed above) take precedence, and a
 #: membership transition never routes through a wire the dead member
@@ -227,8 +242,8 @@ _wire = None
 
 
 def active_wire():
-    """The installed same-host wire (parallel/shm_wire.ShmWire), or
-    None when exchanges ride gloo."""
+    """The installed host wire (ShmWire same-host / TcpWire
+    cross-host — round 24), or None when exchanges ride gloo."""
     return _wire
 
 
@@ -238,8 +253,27 @@ def wire_name() -> str:
     if _group is not None and _group.size > 1:
         return "relay"
     if _wire is not None:
-        return "shm"
+        return getattr(_wire, "name", "shm")
     return "gloo" if (_initialized and process_count() > 1) else "local"
+
+
+def host_label() -> str:
+    """This rank's host identity for wire selection and telemetry
+    labels: ``-mv_wire_hostname`` when set (the loopback cross-host
+    drills fake distinct hosts on one box — selection and labels
+    follow the override while dialing rides real endpoints), else the
+    real hostname. Registry-safe (flight dumps run at teardown)."""
+    import socket
+    try:
+        v = str(GetFlag("mv_wire_hostname"))
+    except Exception:       # registry torn down
+        v = ""
+    if v:
+        return v
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "localhost"
 
 
 def wire_channels() -> int:
@@ -253,33 +287,40 @@ def wire_channels() -> int:
 def maybe_install_wire(channels: int) -> str:
     """Select + install the host wire for this world (Zoo.Start, after
     jax.distributed is up, BEFORE the engine starts). One gloo
-    rendezvous exchanges (hostname, nonce) across the boot world; when
-    every rank shares a host and ``-mv_wire`` allows it, each rank
-    creates its shm segments, attaches its peers' after a barrier, and
-    a smoke exchange proves the wiring before anything trusts it. ANY
-    setup failure falls back to gloo loudly (CHECK-fails only under
-    ``-mv_wire=shm``, where the fallback was explicitly refused).
-    Returns the active transport name."""
+    rendezvous exchanges (host label, nonce) across the boot world:
+    same-host worlds ride the shm wire, hosts-differ worlds take the
+    tcp wire when more than one channel is needed (``-mv_wire=tcp``
+    forces it regardless), gloo is the loud fallback. Either wire is
+    proven by a smoke exchange before anything trusts it, and ANY
+    setup failure degrades the WHOLE world to gloo symmetrically
+    (CHECK-fails only under ``-mv_wire=shm``/``tcp``, where the
+    fallback was explicitly refused). Returns the active transport
+    name."""
     global _wire
     mode = str(GetFlag("mv_wire")).lower()
-    CHECK(mode in ("auto", "shm", "gloo"),
-          f"-mv_wire must be auto/shm/gloo, got {mode!r}")
+    CHECK(mode in ("auto", "shm", "tcp", "gloo"),
+          f"-mv_wire must be auto/shm/tcp/gloo, got {mode!r}")
     if not _initialized or process_count() <= 1 or mode == "gloo":
         return wire_name()
     if _wire is not None:
-        return "shm"
+        return getattr(_wire, "name", "shm")
     import secrets
-    import socket
     info = host_allgather_objects(
-        (socket.gethostname(), secrets.token_hex(4)))
+        (host_label(), secrets.token_hex(4)))
     hosts = [h for h, _ in info]
-    if any(h != hosts[0] for h in hosts):
+    token = info[0][1]          # rank 0's nonce names the session
+    spans_hosts = any(h != hosts[0] for h in hosts)
+    if mode == "tcp" or (spans_hosts and mode == "auto"
+                         and max(1, int(channels)) > 1):
+        return _install_tcp_wire(mode, token, max(1, int(channels)),
+                                 hosts)
+    if spans_hosts:
         CHECK(mode != "shm",
               f"-mv_wire=shm but ranks span hosts: {hosts}")
-        Log.Debug("multihost: ranks span hosts (%s) — staying on gloo",
-                  hosts)
+        Log.Debug("multihost: ranks span hosts (%s) and %d channel(s) "
+                  "suffice — staying on gloo (-mv_wire=tcp forces the "
+                  "tcp wire)", hosts, max(1, int(channels)))
         return "gloo"
-    token = info[0][1]          # rank 0's nonce names the session
     from multiverso_tpu.parallel import shm_wire
 
     # Every rank runs the IDENTICAL gloo collective sequence below —
@@ -344,6 +385,81 @@ def maybe_install_wire(channels: int) -> str:
     return "shm"
 
 
+def _install_tcp_wire(mode: str, token: str, channels: int,
+                      hosts) -> str:
+    """The tcp leg of maybe_install_wire: bind listeners, allgather
+    (ok, endpoints) in ONE collective round, dial the mesh, vote, and
+    smoke-exchange before install. The vote protocol is the shm path's,
+    verbatim in shape: every rank runs the IDENTICAL collective
+    sequence, so an asymmetric local failure becomes an ok=False vote
+    that degrades the WHOLE world to gloo instead of desyncing the
+    boot collective stream. payload_crc=False for the same reason as
+    shm: engine blobs arrive pre-sealed (parallel/seal.py) and the
+    frame layer's own seal still guards headers + chunks."""
+    global _wire
+    from multiverso_tpu.parallel import tcp_wire
+    state = {"wire": None, "exc": None}
+    try:
+        state["wire"] = tcp_wire.TcpWire(
+            token, process_index(), process_count(), channels,
+            int(GetFlag("mv_shm_ring_bytes")), payload_crc=False)
+    except Exception as e:
+        state["exc"] = e
+
+    def _vote(step: str) -> bool:
+        votes = host_allgather_objects(state["exc"] is None)
+        if all(votes):
+            return True
+        if state["wire"] is not None:
+            state["wire"].close()
+        CHECK(mode != "tcp",
+              f"-mv_wire=tcp but the wire failed to come up at "
+              f"{step}: {state['exc']!r} (votes {votes})")
+        Log.Error("multihost: tcp wire setup failed at %s on rank(s) "
+                  "%s (%r here) — falling back to gloo", step,
+                  [i for i, v in enumerate(votes) if not v],
+                  state["exc"])
+        return False
+
+    # bind vote + endpoint rendezvous in ONE collective round
+    eps = (state["wire"].listen_endpoints()
+           if state["wire"] is not None else None)
+    votes = host_allgather_objects((state["exc"] is None, eps))
+    if not all(ok for ok, _ in votes):
+        if state["wire"] is not None:
+            state["wire"].close()
+        CHECK(mode != "tcp",
+              f"-mv_wire=tcp but the wire failed to bind its "
+              f"listeners: {state['exc']!r}")
+        Log.Error("multihost: tcp wire listener bind failed on "
+                  "rank(s) %s (%r here) — falling back to gloo",
+                  [i for i, (ok, _) in enumerate(votes) if not ok],
+                  state["exc"])
+        return "gloo"
+    world_eps = {r: e for r, (_, e) in enumerate(votes)}
+    try:
+        state["wire"].connect(world_eps, timeout_s=30.0)
+    except Exception as e:
+        state["exc"] = e
+    if not _vote("mesh connect"):
+        return "gloo"
+    try:
+        hello = b"mv-tcp-hello-%d" % process_index()
+        got = state["wire"].exchange(hello, 0, timeout_s=30.0)
+        CHECK(got == [b"mv-tcp-hello-%d" % r
+                      for r in range(process_count())],
+              f"tcp wire smoke exchange returned {got!r}")
+    except Exception as e:
+        state["exc"] = e
+    if not _vote("smoke exchange"):
+        return "gloo"
+    _wire = state["wire"]
+    Log.Info("multihost: cross-host tcp wire up — %d channels x %d "
+             "KiB chunks, hosts %s (token %s)", _wire.channels,
+             _wire.chunk >> 10, sorted(set(hosts)), token)
+    return "tcp"
+
+
 def close_wire() -> None:
     """Tear the installed wire down (Zoo.Stop / net_reset). Idempotent;
     own segments are unlinked."""
@@ -355,7 +471,7 @@ def close_wire() -> None:
 
 class wire_bypass:
     """Bench/drill helper: run the body on the RAW gloo collective
-    path while a same-host wire is installed (the A/B the shm-vs-gloo
+    path while a host wire is installed (the A/B the shm/tcp-vs-gloo
     bench rows need). COLLECTIVE discipline applies: every rank must
     enter and exit at the same stream position, or the two transports'
     streams interleave divergently."""
@@ -921,9 +1037,9 @@ def capped_exchange(blob: bytes, caps: dict, key, channel: int = 0) -> list:
     if process_count() <= 1:
         return [blob]
     if _wire is not None:
-        # same-host shared-memory wire: length-framed by construction
-        # (caps unused); the whole call is the collective for the
-        # phase split — local staging inside it is memcpy-bounded
+        # installed wire (shm same-host / tcp cross-host): length-
+        # framed by construction (caps unused); the whole call is the
+        # collective for the phase split
         note_collective()
         _t0 = _time.perf_counter()
         out = _wire.exchange(blob, channel)
@@ -933,7 +1049,7 @@ def capped_exchange(blob: bytes, caps: dict, key, channel: int = 0) -> list:
         return out
     CHECK(channel == 0,
           "gloo host wire has ONE collective stream — channel "
-          f"{channel} needs the shm wire (-mv_wire)")
+          f"{channel} needs a multi-channel wire (-mv_wire=shm/tcp)")
     from jax.experimental import multihost_utils
 
     from multiverso_tpu.parallel.mesh import next_bucket

@@ -197,6 +197,26 @@ def seal_frame(body: bytes) -> bytes:
     return _seal(body)
 
 
+def seal_trailer(parts) -> bytes:
+    """The :func:`seal_frame` trailer for a body given as a SEQUENCE of
+    buffers, computed by streaming — ``seal_frame(b"".join(parts)) ==
+    b"".join(parts) + seal_trailer(parts)``, without ever concatenating
+    the parts. For single-copy frame builders (the tcp wire writes
+    header and chunk straight into its wire buffer and appends this
+    trailer; a 4 MiB chunk never exists as a third intermediate copy)."""
+    if _native() is not None:
+        crc = 0
+        for p in parts:
+            crc = crc32c(p, crc)
+        return _U32.pack(crc & 0xFFFFFFFF) + bytes((TAG_CRC32C,))
+    crc = 0
+    for p in parts:
+        view = memoryview(p)
+        for off in range(0, len(view), _ZLIB_CHUNK):
+            crc = zlib.crc32(view[off:off + _ZLIB_CHUNK], crc)
+    return _U32.pack(crc & 0xFFFFFFFF)
+
+
 def seal_frame_legacy(body: bytes) -> bytes:
     """The pre-round-19 CRC32 seal — kept for the cross-version
     round-trip drills (a new reader must open old blobs); runtime

@@ -197,6 +197,10 @@ class ShmWire:
     the same channel in the same per-channel order (the engine's SPMD
     window contract already guarantees exactly that, per shard)."""
 
+    #: transport label (multihost.wire_name reads this off the
+    #: installed instance)
+    name = "shm"
+
     def __init__(self, token: str, rank: int, nprocs: int,
                  channels: int, data_bytes: int,
                  payload_crc: bool = True):

@@ -1,0 +1,736 @@
+"""Cross-host TCP wire for the windowed engine's exchange.
+
+The reference treats transports as swappable deployment choices behind
+one ``NetInterface`` (MPI vs ZMQ, PAPER.md L2); the TPU build grew the
+same split one layer at a time — gloo (the boot allgather) is the loud
+fallback, the shm wire (parallel/shm_wire.py) is the same-host fast
+path, and THIS module is the cross-host member: one framed TCP stream
+per (channel, peer), so engine shards and replica subscribers get the
+independent exchange channels gloo's single ordered collective stream
+cannot offer, across machine boundaries.
+
+Frame grammar (per stream — a stream carries exactly one (channel,
+peer) direction pair, so frames never interleave across channels):
+
+* ``[u32 sealed_len][sealed]`` where ``sealed`` is
+  ``seal.seal_frame(header | chunk)`` — the versioned CRC32C seal
+  (parallel/seal.py) is the integrity layer, so a flipped bit anywhere
+  (length prefix, header, body, even the seal's own tag byte) surfaces
+  as a typed ``WireCorruption`` BEFORE any field is trusted, never as
+  a hang or a garbage array. A corrupted length prefix is bounded
+  structurally: ``sealed_len`` may never exceed the chunk cap, so the
+  reader refuses it instead of waiting for gigabytes that never come.
+* ``header`` packs ``(magic, sender, round, total, off, len, channel,
+  blob_crc)``. ``round`` counts exchanges per channel and both sides
+  advance it in lockstep (the exchange IS collective): a rank
+  re-entering an exchange alone surfaces as a loud round mismatch —
+  the same SEQ-stamp posture as the shm wire and the engine's window
+  blobs. ``blob_crc`` covers the WHOLE blob (seal.fast_crc), verified
+  after reassembly when ``payload_crc`` is on; the engine install
+  turns it off because its blobs arrive pre-sealed.
+* Blobs larger than the chunk cap ride multiple frames; an empty blob
+  still publishes one zero-length frame so readers always have a
+  header to consume.
+
+Liveness contract (the shm wire's lesson, restated for sockets):
+
+* a KILLED peer resets/closes its streams — EOF or ECONNRESET mid-
+  frame converts to a typed ``ActorDied`` immediately, long before any
+  collective deadline;
+* a SILENTLY dead host (no RST ever arrives) is caught by the elastic
+  lease probe: a stalled exchange consults the membership authority
+  ~4x/second and raises the typed ``MembershipChanged`` the lease
+  produces;
+* everything else is bounded by ``-mv_deadline_s`` (or the caller's
+  explicit ``timeout_s``) — expiry raises ``DeadlineExceeded`` with
+  the diagnostic bundle, marked fatal (the stream position is unsound;
+  the caller must scrap the wire, never retry the round).
+
+Mesh bring-up: each rank binds one listener per channel at
+construction; ``listen_endpoints()`` is what the install rendezvous
+allgathers (one gloo round), and ``connect()`` dials every HIGHER
+rank's listeners while a short-lived accept thread collects the
+inbound dials from LOWER ranks (rank 0 dials everyone; the highest
+rank only accepts — the fixed direction is what lets a replica reader
+bind first and wait for its publisher's dial). Every accepted stream
+must open with a sealed hello naming (channel, rank, session token);
+foreign dialers are rejected without poisoning the mesh. The accept
+thread exits once the mesh is up — steady-state exchanges run entirely
+on the caller's thread (a selectors loop interleaving sends and recvs
+across all peers, so multi-chunk frames cannot flow-control deadlock
+without any receiver threads).
+
+Selection lives in ``multihost.maybe_install_wire``: ``-mv_wire=tcp``
+forces this wire; ``auto`` picks shm when every rank shares a host and
+tcp when hosts differ AND the engine/replica asked for more than one
+channel; gloo stays the loud fallback. This module imports no jax —
+the replica reader's scale-out premise extends to the transport.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from multiverso_tpu.failsafe import deadline as fdeadline
+from multiverso_tpu.failsafe.errors import ActorDied, WireCorruption
+from multiverso_tpu.parallel import seal
+from multiverso_tpu.telemetry import metrics as tmetrics
+from multiverso_tpu.utils.log import CHECK, Log
+
+#: frame header: magic u32 | sender u32 | round u64 | total u64 |
+#: off u64 | len u32 | channel u32 | blob_crc u32
+_HDR_FMT = "<IIQQQIII"
+_HDR_LEN = struct.calcsize(_HDR_FMT)
+
+_MAGIC = 0x4D565443        # "MVTC"
+_HELLO_MAGIC = 0x4D564849  # "MVHI"
+
+#: how often a stalled exchange consults the elastic membership lease
+#: (shm_wire._PROBE_PERIOD_S rationale: detection latency far under
+#: any -mv_deadline_s worth arming)
+_PROBE_PERIOD_S = 0.25
+
+#: mesh bring-up bound when neither timeout_s nor -mv_deadline_s is
+#: set — connect() is bounded BY CONSTRUCTION (a half-up mesh must
+#: never hang the install)
+_CONNECT_TIMEOUT_S = 30.0
+
+_SEND_SLICE = 1 << 18
+_RECV_SLICE = 1 << 20
+
+#: hello frames are tiny (header + token); anything bigger is foreign
+_HELLO_CAP = 4096
+
+
+def _dial_host() -> str:
+    """The address this host advertises in listen_endpoints(). The
+    -mv_wire_hostname flag deliberately does NOT redirect this —
+    identity labels may be overridden for the loopback cross-host
+    drills, but dialing always rides a reachable address."""
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _peer_loss_probe(what: str):
+    """A stalled exchange asks the elastic authority whether a peer is
+    DEAD (lease expired). TCP catches killed processes for free (the
+    kernel sends RST/FIN), but a powered-off HOST sends nothing — the
+    probe converts that silence into a typed MembershipChanged before
+    the collective deadline. Returns the error to raise, or None."""
+    try:
+        from multiverso_tpu import elastic
+        if not elastic.enabled():
+            return None
+        return elastic.peer_loss(what)
+    except Exception:       # the deadline still bounds the wait
+        return None
+
+
+def _chaos():
+    """The active chaos injector (failsafe/chaos.py), or None. Lazy:
+    the wire must stay importable (and jax-free) without the failsafe
+    flag machinery fully configured."""
+    try:
+        from multiverso_tpu.failsafe import chaos
+        return chaos.get()
+    except Exception:
+        return None
+
+
+class TcpWire:
+    """Cross-host allgather-bytes transport over framed TCP streams.
+
+    One instance per process per world; ``exchange(blob, channel)`` is
+    collective per channel — every rank of the world must call it for
+    the same channel in the same per-channel order (the engine's SPMD
+    window contract guarantees exactly that, per shard). Construction
+    binds the listeners; ``connect()`` (after the endpoint rendezvous)
+    establishes the full mesh."""
+
+    #: transport label (multihost.wire_name reads this off the
+    #: installed instance)
+    name = "tcp"
+
+    def __init__(self, token: str, rank: int, nprocs: int,
+                 channels: int, data_bytes: int,
+                 payload_crc: bool = True):
+        CHECK(nprocs >= 2, "TcpWire needs a multi-process world")
+        CHECK(channels >= 1, "TcpWire needs at least one channel")
+        self.token = token
+        self.rank = rank
+        self.nprocs = nprocs
+        self.channels = channels
+        #: chunk cap per frame — large blobs ride multiple frames so a
+        #: corrupted length prefix can never demand an unbounded read
+        self.chunk = max(4096, min(int(data_bytes), 4 << 20))
+        self._max_frame = _HDR_LEN + self.chunk + 64
+        self.payload_crc = bool(payload_crc)
+        #: established streams: (channel, peer_rank) -> socket
+        self._conn: Dict[Tuple[int, int], socket.socket] = {}
+        #: persistent per-stream inbound buffers — one recv may pull
+        #: the tail of this round together with the head of the peer's
+        #: NEXT round; leftover bytes must survive across exchanges
+        self._inbuf: Dict[Tuple[int, int], bytearray] = {}
+        self._round = [0] * channels
+        #: reusable recv landing pads, ONE PER CHANNEL — recv()
+        #: allocating a fresh 1 MiB bytes per wakeup costs real
+        #: page-fault time at wire speed, so recv_into a persistent
+        #: scratch keeps the pages hot. Per channel, not per wire:
+        #: each channel's exchange is single-threaded, but different
+        #: channels run from different shard threads concurrently
+        #: (the sharded engine's model) and a shared pad would let one
+        #: channel's recv overwrite another's bytes mid-append
+        self._scratch = [bytearray(_RECV_SLICE) for _ in range(channels)]
+        self._closed = False
+        self._lock = threading.Lock()
+        self._accept_exc: Optional[BaseException] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self.frame_hw_bytes = 0
+        self.stall_s = 0.0
+        self._t_crc = tmetrics.counter("tcp_wire.crc_failures")
+        self._t_rounds = tmetrics.counter("tcp_wire.exchanges")
+        self._t_bytes = tmetrics.counter("tcp_wire.bytes_out")
+        self._t_stall = tmetrics.counter("tcp_wire.stall_s")
+        self._t_connects = tmetrics.counter("tcp_wire.connects")
+        self._t_hw = tmetrics.gauge("tcp_wire.frame_hw_bytes")
+        self._listeners: List[socket.socket] = []
+        self._endpoints: List[Tuple[str, int]] = []
+        host = _dial_host()
+        try:
+            for _ch in range(channels):
+                ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                ls.bind(("0.0.0.0", 0))
+                ls.listen(max(8, nprocs))
+                self._listeners.append(ls)
+                self._endpoints.append((host, ls.getsockname()[1]))
+        except OSError:
+            for ls in self._listeners:
+                ls.close()
+            raise
+
+    # -- wiring --------------------------------------------------------------
+
+    def listen_endpoints(self) -> List[Tuple[str, int]]:
+        """This rank's (host, port) per channel — what the install
+        rendezvous allgathers so every rank can dial every listener."""
+        return list(self._endpoints)
+
+    def connect(self, world_endpoints,
+                timeout_s: Optional[float] = None) -> None:
+        """Establish the full mesh: dial every HIGHER rank's listeners
+        (one stream per channel, opened with a sealed hello naming
+        (channel, rank, token)) while the accept thread collects the
+        LOWER ranks' inbound dials. ``world_endpoints`` maps rank ->
+        [(host, port) per channel]; ``None`` means wait for inbound
+        only (legal only for the highest rank — the replica reader's
+        bind-then-wait posture). Bounded by ``timeout_s`` /
+        ``-mv_deadline_s`` / a 30s floor; an incomplete mesh raises
+        instead of hanging, and the wire must then be scrapped."""
+        CHECK(not self._closed, "tcp wire used after close")
+        CHECK(world_endpoints is not None or self.rank == self.nprocs - 1,
+              f"tcp wire rank {self.rank} must dial ranks "
+              f"{self.rank + 1}..{self.nprocs - 1} but got no endpoints")
+        deadline = (timeout_s if timeout_s is not None
+                    else (fdeadline.timeout_or_none()
+                          or _CONNECT_TIMEOUT_S))
+        t_end = time.monotonic() + deadline
+        expected = self.rank * self.channels     # lower ranks dial us
+        self._accept_exc = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(expected, t_end),
+            name=f"mv-tcpwire-accept-r{self.rank}", daemon=True)
+        self._accept_thread.start()
+        try:
+            for r in range(self.rank + 1, self.nprocs):
+                eps = world_endpoints[r]
+                CHECK(len(eps) >= self.channels,
+                      f"tcp wire rank {r} advertised {len(eps)} "
+                      f"endpoints for {self.channels} channels")
+                for ch in range(self.channels):
+                    host, port = eps[ch]
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        fdeadline.raise_deadline(
+                            f"tcp wire mesh connect (dial rank {r} "
+                            f"channel {ch})", deadline, fatal=True)
+                    try:
+                        s = socket.create_connection(
+                            (host, int(port)),
+                            timeout=max(0.1, remaining))
+                    except OSError as e:
+                        raise ActorDied(
+                            f"tcp wire peer rank {r} (dial "
+                            f"{host}:{port}, channel {ch})", e)
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                    hello = struct.pack(
+                        "<III", _HELLO_MAGIC, ch, self.rank
+                    ) + self.token.encode("utf-8")
+                    sealed = seal.seal_frame(hello)
+                    s.sendall(struct.pack("<I", len(sealed)) + sealed)
+                    with self._lock:
+                        self._conn[(ch, r)] = s
+        except BaseException:
+            self.close()
+            raise
+        self._accept_thread.join(max(0.0, t_end - time.monotonic()) + 1.0)
+        total = (self.nprocs - 1) * self.channels
+        if self._accept_exc is not None or len(self._conn) != total:
+            exc = self._accept_exc
+            self.close()
+            if isinstance(exc, (WireCorruption, ActorDied)):
+                raise exc
+            fdeadline.raise_deadline(
+                f"tcp wire mesh connect: {len(self._conn)}/{total} "
+                f"streams up before the bound"
+                + (f" ({exc!r})" if exc else ""), deadline, fatal=True)
+        for (ch, r), s in self._conn.items():
+            s.setblocking(False)
+            self._inbuf.setdefault((ch, r), bytearray())
+        self._t_connects.inc(len(self._conn))
+        Log.Debug("tcp wire rank %d: mesh up — %d streams across %d "
+                  "channels", self.rank, len(self._conn), self.channels)
+
+    def _accept_loop(self, expected: int, t_end: float) -> None:
+        """Install-time only: accept ``expected`` inbound dials, map
+        each stream by its sealed hello, then close the listeners and
+        EXIT — no thread survives into steady state."""
+        sel = selectors.DefaultSelector()
+        try:
+            for ls in self._listeners:
+                ls.setblocking(False)
+                sel.register(ls, selectors.EVENT_READ)
+            got = 0
+            while got < expected:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout(
+                        f"tcp wire accept: {got}/{expected} inbound "
+                        f"streams before the connect bound")
+                for key, _ in sel.select(timeout=min(0.25, remaining)):
+                    try:
+                        conn, _addr = key.fileobj.accept()
+                    except OSError:
+                        continue
+                    conn.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    ch, r = self._read_hello(conn, t_end)
+                    if ch is None:
+                        continue        # foreign dialer, rejected
+                    with self._lock:
+                        self._conn[(ch, r)] = conn
+                    got += 1
+        except BaseException as exc:
+            self._accept_exc = exc
+        finally:
+            sel.close()
+            for ls in self._listeners:
+                try:
+                    ls.close()
+                except OSError:
+                    pass
+            self._listeners = []
+
+    def _read_hello(self, conn: socket.socket, t_end: float):
+        """Validate one inbound stream's sealed hello. A garbled or
+        foreign hello (wrong token, wrong magic, corrupt seal) closes
+        THAT stream and returns (None, None) — one stray dialer must
+        never poison the mesh."""
+        try:
+            (ln,) = struct.unpack("<I", self._recv_exact(conn, 4, t_end))
+            if ln > _HELLO_CAP:
+                raise WireCorruption(
+                    f"tcp wire hello claims {ln} bytes (cap "
+                    f"{_HELLO_CAP}) — refused unread")
+            body = seal.open_frame(self._recv_exact(conn, ln, t_end))
+            magic, ch, r = struct.unpack_from("<III", body, 0)
+            token = bytes(body[12:]).decode("utf-8", "replace")
+            if (magic != _HELLO_MAGIC or token != self.token
+                    or not 0 <= ch < self.channels
+                    or not 0 <= r < self.nprocs or r == self.rank):
+                raise WireCorruption(
+                    f"tcp wire hello is foreign: magic {magic:#x}, "
+                    f"channel {ch}, rank {r}, token match "
+                    f"{token == self.token}")
+            return ch, r
+        except (OSError, ValueError, struct.error) as exc:
+            Log.Error("tcp wire rank %d: rejected inbound dialer: %r",
+                      self.rank, exc)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return None, None
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int, t_end: float) -> bytes:
+        """Blocking bounded read of exactly ``n`` bytes (hello path
+        only — steady-state reads are non-blocking)."""
+        out = bytearray()
+        while len(out) < n:
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("tcp wire hello read timed out")
+            conn.settimeout(min(1.0, remaining))
+            data = conn.recv(n - len(out))
+            if not data:
+                raise ConnectionResetError(
+                    "tcp wire stream closed during hello")
+            out += data
+        return bytes(out)
+
+    def close(self) -> None:
+        """Close every stream and listener. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            conns = list(self._conn.values())
+            self._conn.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for ls in self._listeners:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        self._listeners = []
+        t = self._accept_thread
+        if t is not None and t.is_alive():
+            t.join(1.0)
+        self._inbuf.clear()
+
+    # -- the exchange --------------------------------------------------------
+
+    def _frames(self, blob: bytes, rnd: int, channel: int,
+                crc: int) -> "Tuple[bytearray, List[int]]":
+        """The outbound frame train — identical toward every peer —
+        built in ONE pass: header, chunk and streamed seal trailer are
+        appended straight into the wire buffer (seal.seal_trailer), so
+        the blob is copied exactly once regardless of chunk count.
+        Returns (wire buffer, per-frame byte sizes — chaos tcp.drop
+        trims the final frame off a peer's send limit)."""
+        mv = memoryview(blob)
+        plan = ([(0, 0)] if not blob else
+                [(off, min(self.chunk, len(blob) - off))
+                 for off in range(0, len(blob), self.chunk)])
+        out = bytearray()
+        sizes = []
+        for off, ln in plan:
+            hdr = struct.pack(_HDR_FMT, _MAGIC, self.rank, rnd,
+                              len(blob), off, ln, channel, crc)
+            chunk = mv[off:off + ln]
+            trailer = seal.seal_trailer((hdr, chunk))
+            flen = _HDR_LEN + ln + len(trailer)
+            out += struct.pack("<I", flen)
+            out += hdr
+            out += chunk
+            out += trailer
+            sizes.append(4 + flen)
+        return out, sizes
+
+    def exchange(self, blob: bytes, channel: int,
+                 timeout_s: Optional[float] = None) -> List[bytes]:
+        """Every rank's blob for this channel's next round, rank order.
+        Collective per channel; bounded by ``-mv_deadline_s`` or
+        ``timeout_s``. NOTE a failed exchange leaves the channel's
+        round counter advanced: the caller must scrap the wire, never
+        retry the round (the shm wire's contract, verbatim)."""
+        CHECK(not self._closed, "tcp wire used after close")
+        CHECK(0 <= channel < self.channels,
+              f"tcp wire channel {channel} out of range "
+              f"(wire has {self.channels})")
+        rnd = self._round[channel]
+        self._round[channel] += 1
+        if len(blob) > self.frame_hw_bytes:
+            self.frame_hw_bytes = len(blob)
+            self._t_hw.set(float(len(blob)))
+        crc = ((seal.fast_crc(blob) & 0xFFFFFFFF)
+               if self.payload_crc else 0)
+        peers = [r for r in range(self.nprocs) if r != self.rank]
+        inj = _chaos()
+        if inj is not None:
+            d = inj.tcp_delay()
+            if d > 0:
+                time.sleep(d)
+            if inj.tcp_partition():
+                self._partition(channel)
+        out, frame_sizes = self._frames(blob, rnd, channel, crc)
+        out_view = memoryview(out)
+        out_limit = {r: len(out) for r in peers}
+        if inj is not None and inj.tcp_drop():
+            # swallow the final frame toward the lowest peer: that
+            # peer stalls on bytes that never arrive and its lease
+            # probe / deadline converts the stall — the drill's point
+            out_limit[peers[0]] = len(out) - frame_sizes[-1]
+        st = {r: {"buf": self._inbuf.setdefault((channel, r),
+                                                bytearray()),
+                  "out_pos": 0, "asm": None, "total": None,
+                  "chunks": 0, "crc": 0, "crc_latch": 0,
+                  "done_r": False}
+              for r in peers}
+        deadline = (timeout_s if timeout_s is not None
+                    else fdeadline.timeout_or_none())
+        t0 = time.perf_counter()
+        last_probe = t0
+        stall_s = 0.0
+        sel = selectors.DefaultSelector()
+        try:
+            for r in peers:
+                s = st[r]
+                # pre-buffered bytes from the previous round's recv may
+                # already complete this peer's frame train
+                self._drain_frames(r, channel, rnd, s)
+                sock = self._conn.get((channel, r))
+                if sock is None:
+                    raise ActorDied(
+                        f"tcp wire peer rank {r} (channel {channel}, "
+                        f"round {rnd})",
+                        ConnectionResetError("stream severed"))
+                events = 0
+                if not s["done_r"]:
+                    events |= selectors.EVENT_READ
+                if s["out_pos"] < out_limit[r]:
+                    events |= selectors.EVENT_WRITE
+                if events:
+                    try:
+                        sel.register(sock, events, r)
+                    except (ValueError, OSError) as e:
+                        raise ActorDied(
+                            f"tcp wire peer rank {r} (channel "
+                            f"{channel}, round {rnd})", e)
+            while True:
+                if all(s["done_r"] and s["out_pos"] >= out_limit[r]
+                       for r, s in st.items()):
+                    break
+                iter_t0 = time.perf_counter()
+                progressed = False
+                for key, mask in sel.select(timeout=0.05):
+                    r = key.data
+                    s = st[r]
+                    sock = key.fileobj
+                    if mask & selectors.EVENT_WRITE:
+                        progressed |= self._pump_send(
+                            sock, s, out_view, out_limit[r], r,
+                            channel, rnd, sel)
+                    if mask & selectors.EVENT_READ and not s["done_r"]:
+                        progressed |= self._pump_recv(
+                            sock, s, r, channel, rnd, sel,
+                            out_limit[r])
+                now = time.perf_counter()
+                if progressed:
+                    continue
+                stall_s += now - iter_t0
+                if now - last_probe > _PROBE_PERIOD_S:
+                    last_probe = now
+                    dead = _peer_loss_probe(
+                        f"tcp wire exchange (channel {channel}, "
+                        f"round {rnd}): peer silent")
+                    if dead is not None:
+                        raise dead
+                if deadline is not None and now - t0 > deadline:
+                    fdeadline.raise_deadline(
+                        f"tcp wire exchange (channel {channel}, round "
+                        f"{rnd}): a peer never sent/consumed its "
+                        f"frame train", fatal=True)
+        finally:
+            sel.close()
+        self._t_rounds.inc()
+        self._t_bytes.inc(len(blob) * len(peers))
+        if stall_s > 0.0:
+            self.stall_s += stall_s
+            self._t_stall.inc(stall_s)
+        return [blob if r == self.rank else bytes(st[r]["asm"])
+                for r in range(self.nprocs)]
+
+    def _pump_send(self, sock, s, out_view, limit, r, channel, rnd,
+                   sel) -> bool:
+        if s["out_pos"] >= limit:
+            self._downgrade(sel, sock, s, r, limit)
+            return False
+        try:
+            n = sock.send(out_view[s["out_pos"]:
+                                   min(s["out_pos"] + _SEND_SLICE,
+                                       limit)])
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError as e:
+            raise ActorDied(
+                f"tcp wire peer rank {r} (channel {channel}, round "
+                f"{rnd}, send)", e)
+        s["out_pos"] += n
+        if s["out_pos"] >= limit:
+            self._downgrade(sel, sock, s, r, limit)
+        return n > 0
+
+    def _pump_recv(self, sock, s, r, channel, rnd, sel, limit) -> bool:
+        scratch = self._scratch[channel]
+        try:
+            n = sock.recv_into(scratch)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError as e:
+            raise ActorDied(
+                f"tcp wire peer rank {r} (channel {channel}, round "
+                f"{rnd}, recv)", e)
+        if not n:
+            raise ActorDied(
+                f"tcp wire peer rank {r} (channel {channel}, round "
+                f"{rnd})",
+                ConnectionResetError(
+                    "stream closed mid-exchange (peer died or was "
+                    "killed)"))
+        s["buf"] += memoryview(scratch)[:n]
+        self._drain_frames(r, channel, rnd, s)
+        if s["done_r"]:
+            self._downgrade(sel, sock, s, r, limit)
+        return True
+
+    @staticmethod
+    def _downgrade(sel, sock, s, r, limit) -> None:
+        """Shrink a stream's selector interest to what's still
+        pending; unregister when both directions are done (a done
+        stream must not be read — the peer's NEXT round may already be
+        arriving and belongs to the next exchange call)."""
+        events = 0
+        if not s["done_r"]:
+            events |= selectors.EVENT_READ
+        if s["out_pos"] < limit:
+            events |= selectors.EVENT_WRITE
+        try:
+            if events:
+                sel.modify(sock, events, r)
+            else:
+                sel.unregister(sock)
+        except (KeyError, ValueError):
+            pass
+
+    def _drain_frames(self, r: int, channel: int, rnd: int,
+                      s: dict) -> None:
+        """Parse complete frames out of the stream buffer. Stops as
+        soon as this round's blob is assembled — bytes beyond it
+        belong to the peer's next round and stay buffered.
+
+        Parsing rides memoryviews end to end (verify, header decode,
+        assembly memcpy) — the only copy a chunk pays is its landing in
+        ``asm``. The views live inside :meth:`_parse_frames` so the
+        buffer compaction here never trips the bytearray export
+        guard."""
+        buf = s["buf"]
+        consumed = self._parse_frames(r, channel, rnd, s,
+                                      memoryview(buf), len(buf))
+        if consumed:
+            del buf[:consumed]
+
+    def _parse_frames(self, r: int, channel: int, rnd: int, s: dict,
+                      view, size: int) -> int:
+        pos = 0
+        while not s["done_r"]:
+            if size - pos < 4:
+                return pos
+            (flen,) = struct.unpack_from("<I", view, pos)
+            if flen > self._max_frame or flen < _HDR_LEN:
+                self._t_crc.inc()
+                raise WireCorruption(
+                    f"tcp wire frame from rank {r} claims {flen} "
+                    f"bytes (cap {self._max_frame}) — a corrupted "
+                    f"length prefix is refused, never awaited")
+            if size - pos < 4 + flen:
+                return pos
+            sealed = view[pos + 4:pos + 4 + flen]
+            pos += 4 + flen
+            try:
+                body = seal.open_frame(sealed)
+            except WireCorruption:
+                self._t_crc.inc()
+                raise
+            magic, sender, frnd, total, off, ln, fch, fcrc = \
+                struct.unpack_from(_HDR_FMT, body, 0)
+            if magic != _MAGIC or sender != r or fch != channel:
+                self._t_crc.inc()
+                raise WireCorruption(
+                    f"tcp wire frame header is foreign: magic "
+                    f"{magic:#x}, sender {sender}, channel {fch} on "
+                    f"the (channel {channel}, peer {r}) stream")
+            if frnd != rnd:
+                raise WireCorruption(
+                    f"tcp wire desync on channel {channel}: rank {r} "
+                    f"is at exchange round {frnd}, rank {self.rank} "
+                    f"at {rnd} — a rank re-entered the exchange "
+                    f"alone; the stream cannot be trusted")
+            chunk = body[_HDR_LEN:]
+            if s["asm"] is None:
+                s["asm"] = bytearray(total)
+                s["total"] = total
+                s["crc_latch"] = fcrc
+            if (total != s["total"] or off + ln > s["total"]
+                    or len(chunk) != ln):
+                self._t_crc.inc()
+                raise WireCorruption(
+                    f"tcp wire frame from rank {r} truncated/"
+                    f"inconsistent: total {total} vs {s['total']}, "
+                    f"chunk [{off}:{off + ln}] carrying "
+                    f"{len(chunk)} bytes")
+            if ln:
+                s["asm"][off:off + ln] = chunk
+                if self.payload_crc:
+                    s["crc"] = seal.fast_crc(chunk, s["crc"])
+            s["chunks"] += 1
+            expect = max(1, -(-s["total"] // self.chunk))
+            if s["chunks"] >= expect:
+                if self.payload_crc and \
+                        (s["crc"] & 0xFFFFFFFF) != s["crc_latch"]:
+                    self._t_crc.inc()
+                    raise WireCorruption(
+                        f"tcp wire frame from rank {r} failed its "
+                        f"whole-blob CRC (round {rnd}, {s['total']} "
+                        f"bytes)")
+                s["done_r"] = True
+        return pos
+
+    def _partition(self, channel: int) -> None:
+        """Chaos tcp.partition: sever every stream of this channel.
+        Peers see EOF (typed ActorDied); our own next socket op fails
+        the same way."""
+        with self._lock:
+            severed = [(k, s) for k, s in self._conn.items()
+                       if k[0] == channel]
+        for k, s in severed:
+            try:
+                s.close()
+            except OSError:
+                pass
+        Log.Error("tcp wire rank %d: chaos tcp.partition severed %d "
+                  "streams on channel %d", self.rank, len(severed),
+                  channel)
+
+    # -- diagnostics ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {"token": self.token, "rank": self.rank,
+                "nprocs": self.nprocs, "channels": self.channels,
+                "chunk_bytes": self.chunk,
+                "rounds": [int(r) for r in self._round],
+                "streams": len(self._conn),
+                "endpoints": list(self._endpoints),
+                "stall_s": round(self.stall_s, 6),
+                "frame_hw_bytes": self.frame_hw_bytes}
+
+    def mem_bytes(self) -> dict:
+        """Ledger probe (telemetry/accounting.py): inbound stream
+        buffers currently held plus the frame high-watermark."""
+        return {"inbuf_bytes": sum(len(b)
+                                   for b in self._inbuf.values()),
+                "stream_count": len(self._conn),
+                "frame_hw_bytes": self.frame_hw_bytes}

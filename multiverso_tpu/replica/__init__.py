@@ -16,8 +16,10 @@ star. This package is the classic parameter-server read/update split
   hook drains each table's journal at the fenced cut, and a fan-out
   thread ships base+delta blobs to subscribed replicas — same-host
   replicas over dedicated PR 9 shm-ring channels (1.9–2.4 GB/s
-  measured), remote replicas over the PR 7 coordinator's
-  length-prefixed CRC-framed socket relay.
+  measured), cross-host replicas over a dedicated round-24 tcp wire
+  stream (the reader binds a listener before joining; the publisher
+  dials it at first ship), and relay-mode replicas through the PR 7
+  coordinator's length-prefixed CRC-framed socket mailbox.
 * :mod:`replica` — the jax-free (numpy-only import path, asserted)
   reader process: joins through the coordinator as a non-SPMD
   ``role=replica`` member with a heartbeat lease but NO verb stream,
@@ -43,7 +45,8 @@ MV_DEFINE_bool("mv_replica_fanout", False,
                "replica plane: journal per-table publish dirty sets and "
                "fan published snapshots out to subscribed replica "
                "reader processes as versioned base+delta blobs "
-               "(same-host: shm ring; remote: coordinator relay)")
+               "(same-host: shm ring; cross-host: tcp wire stream; "
+               "relay: coordinator mailbox)")
 MV_DEFINE_string("mv_replica_addr", "",
                  "replica subscription coordinator endpoint host:port. "
                  "Empty: reuse the elastic coordinator when -mv_elastic "
@@ -51,9 +54,10 @@ MV_DEFINE_string("mv_replica_addr", "",
                  "ephemeral port (single-process worlds; multi-process "
                  "worlds without -mv_elastic must name a port)")
 MV_DEFINE_int("mv_replica_ring_bytes", 8 << 20,
-              "per-subscriber shm fan-out ring capacity (same-host "
-              "replicas); frames larger than this ship as multiple "
-              "flow-controlled chunks")
+              "per-subscriber fan-out capacity: shm ring bytes "
+              "(same-host) or tcp chunk cap (cross-host); frames "
+              "larger than this ship as multiple flow-controlled "
+              "chunks")
 MV_DEFINE_double("mv_replica_lease_s", 0.0,
                  "replica heartbeat lease: a replica silent for this "
                  "long is declared dead and its subscription evicted "

@@ -12,8 +12,10 @@ Two halves, split exactly where the SPMD stream's soundness demands:
   IMMUTABLE retained snapshots (never the live tables), and ships them
   — same-host subscribers over a dedicated per-replica shm ring
   (PR 9's transport, 2-proc point-to-point, its own session token so
-  it can never collide with the engine wire's channels), remote
-  subscribers through the coordinator's relay mailbox.
+  it can never collide with the engine wire's channels), cross-host
+  subscribers over a dedicated round-24 tcp wire stream (the reader's
+  join token carries its listener endpoint; the first ship dials it),
+  and relay subscribers through the coordinator's mailbox.
 
 Failure isolation: a replica that stalls or dies costs ONE bounded
 ring wait (lease-derived ``timeout_s`` passed straight to
@@ -36,7 +38,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from multiverso_tpu.failsafe.errors import (DeadlineExceeded,
+from multiverso_tpu.failsafe.errors import (ActorDied, DeadlineExceeded,
                                             WireCorruption)
 from multiverso_tpu.parallel import compress
 from multiverso_tpu.replica import delta as rdelta
@@ -178,7 +180,7 @@ class ReplicaPublisher:
                                         daemon=True)
         self._thread.start()
 
-    def _run(self) -> None:  # mv-lint: ok(never-collective): the only reachable "collective" is ShmWire.exchange on a per-replica 2-proc fan-out ring with its own session token — a point-to-point channel to a non-SPMD reader, bounded by an explicit lease timeout; no SPMD rank ever participates, so it cannot interleave with the engine's window streams
+    def _run(self) -> None:  # mv-lint: ok(never-collective): the only reachable "collectives" are ShmWire.exchange / TcpWire.exchange on a per-replica 2-proc fan-out channel with its own session token — a point-to-point stream to a non-SPMD reader, bounded by an explicit lease timeout; no SPMD rank ever participates, so it cannot interleave with the engine's window streams
         while not self._stop.is_set():
             self._kick.wait(_POLL_S)
             self._kick.clear()
@@ -250,8 +252,8 @@ class ReplicaPublisher:
             try:
                 blob, kind = self._encode_for(rec, snap)
                 sent = self._ship(rec, st, blob, snap.version)
-            except (DeadlineExceeded, WireCorruption, OSError,
-                    ConnectionError) as exc:
+            except (ActorDied, DeadlineExceeded, WireCorruption,
+                    OSError, ConnectionError) as exc:
                 Log.Error("replica %d ship failed (%r) — evicting its "
                           "subscription", rid, exc)
                 try:
@@ -326,6 +328,25 @@ class ReplicaPublisher:
                                payload_crc=False)
                 wire.attach_peers()     # replica created its segment
                 st["wire"] = wire       # before it joined
+            wire.exchange(blob, 0,
+                          timeout_s=max(2.0 * self.lease_s, 5.0))
+            return True
+        if rec["mode"] == "tcp":
+            wire = st["wire"]
+            if wire is None:
+                # the replica's join token carries its listener
+                # endpoint verbatim: session@host:port (the reader
+                # bound BEFORE joining, so this first dial lands)
+                from multiverso_tpu.parallel.tcp_wire import TcpWire
+                session, _, ep = str(rec["token"]).partition("@")
+                host, _, port = ep.rpartition(":")
+                wire = TcpWire(session, rank=0, nprocs=2, channels=1,
+                               data_bytes=rec["ring_bytes"]
+                               or _ring_flag(),
+                               payload_crc=False)
+                wire.connect({1: [(host, int(port))]},
+                             timeout_s=max(2.0 * self.lease_s, 5.0))
+                st["wire"] = wire
             wire.exchange(blob, 0,
                           timeout_s=max(2.0 * self.lease_s, 5.0))
             return True

@@ -10,7 +10,9 @@ process:
    path is numpy-only, asserted in :func:`main` and pinned by
    tests/test_packaging.py);
 2. **receives** base+delta blobs — same-host over a dedicated shm ring
-   (PR 9 transport, 2-proc point-to-point), remote through the
+   (PR 9 transport, 2-proc point-to-point), cross-host over a
+   dedicated round-24 tcp wire stream (this reader binds the listener
+   BEFORE joining; the publisher dials it), or through the
    coordinator's relay mailbox — and applies them to local
    :class:`~multiverso_tpu.replica.delta.MirrorStore` mirrors;
 3. **installs** each applied version into its own ``SnapshotStore``
@@ -146,7 +148,8 @@ class Replica:
     def __init__(self, host: str, port: int, *, mode: str = "shm",
                  serve_port: int = 0, ring_bytes: int = 8 << 20,
                  lease_s: float = 5.0, endpoints=None):
-        CHECK(mode in ("shm", "relay"), f"unknown replica mode {mode!r}")
+        CHECK(mode in ("shm", "tcp", "relay"),
+              f"unknown replica mode {mode!r}")
         self.mode = mode
         self.ring_bytes = int(ring_bytes)
         self.lease_s = float(lease_s)
@@ -190,6 +193,26 @@ class Replica:
             self._wire = ShmWire(token, rank=1, nprocs=2, channels=1,
                                  data_bytes=self.ring_bytes,
                                  payload_crc=False)
+        elif self.mode == "tcp":
+            from multiverso_tpu.parallel.tcp_wire import TcpWire
+            session = f"{os.getpid():x}{int(time.time() * 1e3) & 0xFFFF:x}"
+            # our (rank 1) listener is bound BEFORE the join lands, so
+            # the publisher's first ship can dial immediately. The
+            # listener endpoint rides the join's token field verbatim
+            # (session@host:port) — the coordinator relays mode/token
+            # untouched, so a REMOTE subscriber needs no coordinator
+            # support beyond what shm already uses
+            # assigned through a local: self._wire must keep ONE
+            # statically inferred type (the wires share the exchange
+            # contract; a conflicting ctor assignment would poison the
+            # attribute and mv-lint's callgraph would fall back to
+            # matching every .exchange in the package)
+            wire = TcpWire(session, rank=1, nprocs=2, channels=1,
+                           data_bytes=self.ring_bytes,
+                           payload_crc=False)
+            ep_host, ep_port = wire.listen_endpoints()[0]
+            token = f"{session}@{ep_host}:{ep_port}"
+            self._wire = wire
         resp = self.client.call_retry("replica_join", attempts=50,
                                       mode=self.mode, token=token,
                                       ring_bytes=self.ring_bytes,
@@ -287,13 +310,25 @@ class Replica:
         self._die(5, f"publisher never opened the fan-out ring "
                      f"(last attach error: {last!r})")
 
+    def _await_publisher_dial(self) -> None:
+        """tcp mode: rank 1 of 2 dials nobody — wait (bounded) for the
+        publisher's inbound dial, which lands at its first ship (one
+        roster tick, ~0.25s, after our join)."""
+        try:
+            self._wire.connect(None, timeout_s=_ATTACH_TIMEOUT_S)
+        except Exception as exc:
+            self._die(5, f"publisher never dialed the tcp fan-out "
+                         f"stream ({exc!r})")
+
     def recv_loop(self) -> None:
         """Receive + apply until stopped. Runs on the main thread; the
         lookup server and heartbeats ride their own daemons."""
         if self.mode == "shm":
             self._attach_ring()
+        elif self.mode == "tcp":
+            self._await_publisher_dial()
         while not self._stop.is_set():
-            if self.mode == "shm":
+            if self.mode in ("shm", "tcp"):
                 # parked between publishes; eviction/trainer death is
                 # the heartbeat thread's exit path, not this wait's
                 blob = self._wire.exchange(b"", 0)[0]
@@ -511,9 +546,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="trainer replica coordinator endpoint list "
                         "host:port[,host:port] — primary first, "
                         "standby successor endpoints after")
-    p.add_argument("--mode", choices=("shm", "relay"), default="shm",
-                   help="fan-out transport: shm (same host) or the "
-                        "coordinator socket relay (remote)")
+    p.add_argument("--mode", choices=("shm", "tcp", "relay"),
+                   default="shm",
+                   help="fan-out transport: shm (same host), tcp "
+                        "(remote — bundles ride a direct framed "
+                        "stream from the publisher), or the "
+                        "coordinator socket relay (remote fallback)")
     p.add_argument("--serve-port", type=int, default=0,
                    help="lookup TCP port (0 = ephemeral)")
     p.add_argument("--ring-bytes", type=int, default=8 << 20)

@@ -120,8 +120,9 @@ MV_DEFINE_int("mv_engine_shards", 0,
               "agreed, no negotiation). 0 = auto: single-process "
               "worlds use min(tables, cores/4) via lazy shard spawn, "
               "multi-process worlds stay at 1 unless set explicitly "
-              "(>1 there needs the shm wire's per-shard channels — "
-              "-mv_wire — because gloo is one globally-ordered "
+              "(>1 there needs a multi-channel wire's per-shard "
+              "channels — -mv_wire=shm same-host, tcp cross-host — "
+              "because gloo is one globally-ordered "
               "collective stream). 1 = today's single engine byte-for-"
               "byte. Clamped to 1 under -sync (the BSP vector clocks "
               "count verbs across ALL tables) and -mv_elastic (the "
@@ -2558,7 +2559,8 @@ def engine_shard_cap() -> int:
     * BSP (-sync): the vector clocks count verbs across all tables;
     * elastic epochs: the coordinator relay is one ordered channel;
     * multi-process on gloo: ONE globally-ordered collective stream —
-      per-shard streams need the shm wire's channels (-mv_wire)."""
+      per-shard streams need a multi-channel wire's channels
+      (-mv_wire: shm same-host, tcp cross-host)."""
     try:
         flag = int(GetFlag("mv_engine_shards"))
     except Exception:
@@ -2582,8 +2584,9 @@ def engine_shard_cap() -> int:
             Log.Error("engine: -mv_engine_shards=%d needs %d "
                       "independent exchange channels but the active "
                       "wire offers %d (gloo is one ordered collective "
-                      "stream — run same-host worlds with -mv_wire="
-                      "auto/shm) — clamped to 1", flag, flag, channels)
+                      "stream — same-host worlds take -mv_wire=auto/"
+                      "shm, cross-host worlds -mv_wire=tcp) — clamped "
+                      "to 1", flag, flag, channels)
             return 1
         return flag
     if flag >= 1:

@@ -150,10 +150,15 @@ def correlate(paths: List[str]) -> dict:
     # not stream positions, but their durations are real local data
     # and must land in the phase totals)
     all_phase: Dict[int, List[dict]] = {}
+    #: rank -> host label from the dump header (round 24 — cross-host
+    #: worlds need verdicts that name WHICH BOX binds, not just which
+    #: rank; pre-round-24 dumps without the field fall back to "rankN")
+    hosts: Dict[int, str] = {}
     for d in dumps:
         rank = d["rank"] if d["rank"] >= 0 else len(all_phase)
         all_phase[rank] = [_window_record(e) for e in d["events"]
                            if e.get("kind") == "window.phases"]
+        hosts[rank] = str(d["header"].get("host") or "") or f"rank{rank}"
     # per-rank parsed stream windows + per-rank apply intervals (mono)
     win: Dict[int, Dict[tuple, dict]] = {}
     apply_iv: Dict[int, List[tuple]] = {}
@@ -177,7 +182,9 @@ def correlate(paths: List[str]) -> dict:
                    "seconds": round(secs, 6)}
                   for (label, verb), secs in
                   sorted(tables.items(), key=lambda kv: -kv[1])]
-    report = {"ranks": ranks, "n_windows": 0, "windows": [],
+    report = {"ranks": ranks,
+              "hosts": {r: hosts.get(r, f"rank{r}") for r in ranks},
+              "n_windows": 0, "windows": [],
               "clock_offsets_s": {r: 0.0 for r in ranks},
               "align_err_s": 0.0,
               "binding_rank_hist": {}, "binding_phase_hist": {},
@@ -306,6 +313,7 @@ def correlate(paths: List[str]) -> dict:
             ps["binding_phase_hist"].get(phase, 0) + 1)
         windows_out.append({
             "pos": list(pos), "binding_rank": binding,
+            "binding_host": hosts.get(binding, f"rank{binding}"),
             "binding_phase": phase,
             "period_s": round(period, 6) if period is not None else None,
             "unaccounted_s": (round(unacc, 6) if unacc is not None
@@ -327,6 +335,8 @@ def correlate(paths: List[str]) -> dict:
         br = s["binding_rank_hist"]
         s["dominant_phase"] = max(bp, key=bp.get)
         s["dominant_rank"] = max(br, key=br.get)
+        s["dominant_host"] = hosts.get(s["dominant_rank"],
+                                       f"rank{s['dominant_rank']}")
     report["streams"] = per_stream
     report["exchange_wait_excess_s"] = {r: round(s, 6)
                                         for r, s in wait_excess.items()}
@@ -338,7 +348,8 @@ def correlate(paths: List[str]) -> dict:
     multi = (f" across {len(per_stream)} engine streams"
              if len(per_stream) > 1 else "")
     report["note"] = (
-        f"{len(common)} windows{multi}: rank {top_rank} binds "
+        f"{len(common)} windows{multi}: rank {top_rank} "
+        f"(host {hosts.get(top_rank, f'rank{top_rank}')}) binds "
         f"{rank_hist[top_rank]}/{len(common)}, dominant binding phase "
         f"'{top_phase}' ({phase_hist[top_phase]}/{len(common)}); "
         f"alignment error <= {report['align_err_s'] * 1e3:.3f} ms")
@@ -354,9 +365,14 @@ def report_text(report: dict) -> str:
         lines.append(f"coverage: {report['coverage']}")
     if report["note"] and report["note"] != report.get("degraded"):
         lines.append(report["note"])
+    hosts = report.get("hosts", {})
+
+    def _host(r):
+        return hosts.get(r, f"rank{r}")
+
     if report["binding_rank_hist"]:
         lines.append("binding ranks: " + ", ".join(
-            f"rank {r}: {n}" for r, n in
+            f"rank {r} ({_host(r)}): {n}" for r, n in
             sorted(report["binding_rank_hist"].items())))
         lines.append("binding phases: " + ", ".join(
             f"{p}: {n}" for p, n in
@@ -366,7 +382,8 @@ def report_text(report: dict) -> str:
             for sid, s in sorted(report["streams"].items()):
                 lines.append(
                     f"  stream {sid}: {s['n_windows']} windows, "
-                    f"binding rank {s['dominant_rank']} "
+                    f"binding rank {s['dominant_rank']} on "
+                    f"{s.get('dominant_host', _host(s['dominant_rank']))} "
                     f"({s['binding_rank_hist'][s['dominant_rank']]}"
                     f"/{s['n_windows']}), dominant phase "
                     f"'{s['dominant_phase']}'")

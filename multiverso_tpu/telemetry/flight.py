@@ -209,9 +209,17 @@ def _rank() -> int:
         return 0
 
 
+def _host() -> str:
+    try:
+        from multiverso_tpu.parallel import multihost
+        return multihost.host_label()
+    except Exception:       # pragma: no cover - early interpreter state
+        return ""
+
+
 def dump(path: str) -> str:
-    """Write the ring as JSONL: a header object (rank, pid, recorded,
-    dropped), then one event object per line, oldest first. Returns
+    """Write the ring as JSONL: a header object (rank, host, pid,
+    recorded, dropped), then one event object per line, oldest first. Returns
     ``path``. Local-only — never collective (each rank dumps its own
     ring; forensics.correlate aligns them offline)."""
     recorded, dropped = RECORDER.stats()
@@ -219,6 +227,7 @@ def dump(path: str) -> str:
     # event's monotonic stamp onto this rank's wall timeline with
     # wall(tm) = dumped_at - (dumped_at_mono - tm)
     header = {"flight_header": 1, "rank": _rank(), "pid": os.getpid(),
+              "host": _host(),
               "recorded": recorded, "dropped": dropped,
               "dumped_at": time.time(),
               "dumped_at_mono": time.perf_counter()}

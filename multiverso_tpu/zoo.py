@@ -108,8 +108,9 @@ class Zoo:
         self.mesh_ctx = MeshContext.create(devices)
         if self._multihost:
             # host-wire selection BEFORE the engine exists (round 12):
-            # same-host worlds ride the shared-memory wire (-mv_wire),
-            # whose per-shard channels are what permit a sharded
+            # same-host worlds ride the shared-memory wire, cross-host
+            # worlds the framed tcp wire (round 24; -mv_wire) — either
+            # wire's per-shard channels are what permit a sharded
             # engine's concurrent window streams in multi-process mode
             from multiverso_tpu.sync.server import \
                 requested_engine_channels

@@ -1,0 +1,19 @@
+"""Fixture twin of the tcp wire: TcpWire.exchange is a sink and
+connect's mesh bring-up spawns the inventoried accept loop; the
+seeded violation is the UNBOUNDED mesh join (a dead dialer would
+park install forever instead of converting to a typed deadline)."""
+
+import threading
+
+
+class TcpWire:
+    def connect(self, world_endpoints, timeout_s=None):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        t.join()
+
+    def _accept_loop(self):
+        pass
+
+    def exchange(self, blob, channel, timeout_s=None):
+        return [blob]

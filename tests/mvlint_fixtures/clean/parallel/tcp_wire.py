@@ -1,0 +1,17 @@
+"""Fixture twin of the tcp wire: TcpWire.exchange is a sink and
+connect's mesh bring-up spawns the inventoried accept loop."""
+
+import threading
+
+
+class TcpWire:
+    def connect(self, world_endpoints, timeout_s=None):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        t.join(1.0)
+
+    def _accept_loop(self):
+        pass
+
+    def exchange(self, blob, channel, timeout_s=None):
+        return [blob]

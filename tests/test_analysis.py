@@ -380,6 +380,22 @@ class TestFixtureCatches:
                     if f.rule == "never-collective"
                     and f.path == "elastic/standby.py"]
 
+    def test_bounded_blocking_catches_tcp_wire_mesh_join(self, results):
+        """Round 24: the tcp wire's mesh bring-up is a bounded-blocking
+        scanned surface — the seeded UNBOUNDED accept-loop join in the
+        bad twin (a dead dialer would park install forever instead of
+        converting to a typed deadline) is a finding, and the clean
+        twin's bounded join passes."""
+        bad_res, clean_res = results
+        hits = [f for f in bad_res.findings
+                if f.rule == "bounded-blocking"
+                and f.path == "parallel/tcp_wire.py"]
+        assert hits and hits[0].line == 13, \
+            [f.render() for f in bad_res.findings]
+        assert not [f for f in clean_res.findings
+                    if f.path == "parallel/tcp_wire.py"], \
+            [f.render() for f in clean_res.findings]
+
     def test_policy_fixture_is_gated_from_day_one(self, results):
         """Round 20: the policy plane's thread is inventoried and its
         domain is blocking-restricted — the seeded UNBOUNDED wait in
@@ -1246,6 +1262,18 @@ class TestScannedCoveragePins:
             assert "elastic/standby.py" in checker.scanned
             assert "elastic/dialer.py" in checker.scanned
         assert "elastic/standby.py" in all_rels
+        # round 24 — the tcp wire joins the pinned wire-plane set (its
+        # install-time accept loop is an inventoried thread and its
+        # exchange/accept paths are exactly the bounded-blocking
+        # surface the rules police) and its fixture mirror exists;
+        # checkers that allow-list the module (cross-domain-state's
+        # single-owner wire posture) legitimately skip it
+        for checker in res.checkers:
+            if "parallel/tcp_wire.py" in getattr(
+                    type(checker), "ALLOW", {}):
+                continue
+            assert "parallel/tcp_wire.py" in checker.scanned, checker.name
+        assert "parallel/tcp_wire.py" in all_rels
 
 
 class TestMvlintEntryPoint:

@@ -60,6 +60,14 @@ GUARDED = {
     # round 21 — the int8 row-quantizer's encode throughput (pure numpy
     # codec math; same 0.5 memory-subsystem floor as the seal's CRC)
     "compress_int8_GB_s": 0.5,
+    # round 24 — the cross-host tcp wire on the same 2-proc matrix
+    # workload (loopback cross-host via -mv_wire_hostname). The wire's
+    # whole point is beating the ~0.3 GB/s gloo wall, and the in-run
+    # gloo leg is frozen beside it so the A/B claim itself is guarded:
+    # tcp regressing below HALF its frozen value (or gloo somehow
+    # doubling) breaks the floor before the claim quietly inverts.
+    # Same 0.5 memory-subsystem slack as the shm wire's bandwidth
+    "matrix_table_2proc_tcp_wire_MB_s": 0.5,
 }
 
 #: metric -> worst acceptable multiple of the guard value (latency:

@@ -111,7 +111,9 @@ class TestCritpathSynthetic:
         assert rep["tables_top"][0]["table"] == "matrix0"
         assert rep["tables_top"][0]["seconds"] > 0.003 - 1e-9
         text = critpath.report_text(rep)
-        assert "rank 1 binds 8/8" in text
+        # headerless synthetic dumps fall back to "rankN" host labels
+        # (round 24 — real dumps carry the host in the flight header)
+        assert "rank 1 (host rank1) binds 8/8" in text
         assert "apply" in text
 
     def test_ragged_tail_and_evicted_head_shrink_coverage(

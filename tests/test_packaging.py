@@ -69,6 +69,11 @@ class TestWheel:
         # hosts with no accelerator stack
         for mod in ("coordinator", "dialer", "standby"):
             assert f"multiverso_tpu/elastic/{mod}.py" in names, names
+        # ...and the round-24 cross-host transport: the tcp wire (and
+        # the seal it frames with) must reach remote boxes — including
+        # jax-free replica hosts — through the same wheel
+        for mod in ("tcp_wire", "shm_wire", "seal"):
+            assert f"multiverso_tpu/parallel/{mod}.py" in names, names
 
     def test_seal_verify_path_is_jax_free(self):
         """Round 19: the versioned seal (parallel/seal.py) + flat frame
@@ -159,6 +164,36 @@ class TestWheel:
                            env=env)
         assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
         assert "STANDBY-JAXFREE-OK" in r.stdout
+
+    def test_tcp_wire_import_path_is_jax_free(self):
+        """Round 24: the cross-host tcp wire is the transport a REMOTE
+        replica reader subscribes through — its import graph (wire +
+        seal + failsafe error types) must stay numpy-only, or the read
+        tier's no-jax deployment premise dies at the first cross-host
+        subscription. Constructing a wire (listeners bound) and framing
+        a sealed blob must not pull jax either."""
+        check = (
+            "import os, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from multiverso_tpu.parallel.tcp_wire import TcpWire\n"
+            "assert 'jax' not in sys.modules, 'jax entered the tcp "
+            "wire import graph'\n"
+            "w = TcpWire('t', rank=0, nprocs=2, channels=2,\n"
+            "            data_bytes=65536)\n"
+            "eps = w.listen_endpoints()\n"
+            "assert len(eps) == 2 and all(p > 0 for _, p in eps)\n"
+            "out, sizes = w._frames(b'x' * 100000, 0, 0, 0)\n"
+            "assert len(sizes) == 2 and sum(sizes) == len(out)\n"
+            "w.close()\n"
+            "assert 'jax' not in sys.modules, 'jax entered the tcp "
+            "wire runtime path'\n"
+            "print('TCP-JAXFREE-OK')\n")
+        env = dict(os.environ, PYTHONPATH=ROOT)
+        r = subprocess.run([sys.executable, "-c", check],
+                           capture_output=True, text=True, timeout=120,
+                           env=env)
+        assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-2000:])
+        assert "TCP-JAXFREE-OK" in r.stdout
 
     def test_install_and_import_in_clean_venv(self, wheel, tmp_path):
         env_dir = tmp_path / "venv"
